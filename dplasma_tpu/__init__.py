@@ -1,0 +1,27 @@
+"""dplasma_tpu — TPU-native distributed dense tile linear algebra.
+
+A brand-new framework with the capabilities of DPLASMA/PaRSEC
+(reference: therault/dplasma), designed TPU-first:
+
+- tile matrices stored as padded 2-D ``jax.Array``s with a block-cyclic
+  distribution descriptor (the analog of ``parsec_matrix_block_cyclic_t``,
+  ref tests/testing_zpotrf.c:100-103);
+- algorithms written as trace-time blocked/panelized tile programs compiled
+  under ``jit`` — XLA's static schedule + async collectives play the role of
+  the PaRSEC dataflow scheduler (ref src/zpotrf_L.jdf task graph);
+- communication is implicit: sharding constraints over a ``Mesh(P, Q)``
+  make GSPMD emit ICI collectives where the reference's JDF ``type_remote``
+  annotations drove MPI datatypes (ref src/zpotrf_L.jdf:109-114);
+- hot tile kernels are Pallas MXU kernels; the rest is jax.lax.
+
+Public API mirrors the reference wrapper layer (``dplasma_z*`` in
+src/include/dplasma/dplasma_z.h): precision-generic functions that accept
+any jnp dtype, plus s/d/c/z-prefixed aliases.
+"""
+
+from dplasma_tpu.descriptors import Dist, TileDesc, TileMatrix
+from dplasma_tpu.parallel import mesh
+
+__version__ = "0.1.0"
+
+__all__ = ["Dist", "TileDesc", "TileMatrix", "mesh"]

@@ -1,0 +1,225 @@
+"""Tile-matrix descriptors and storage.
+
+The reference distributes matrices as ``parsec_matrix_block_cyclic_t``
+(2-D block-cyclic over a P×Q grid with supertile factors KP/KQ and grid
+offsets IP/JQ — ref tests/testing_zpotrf.c:100-103, tests/common.c:79-93).
+
+TPU-native design: a :class:`TileMatrix` is ONE padded 2-D ``jax.Array``
+(global view) carrying a static :class:`TileDesc`. Tiles are static slices
+of the global array — trace-time indices, so XLA sees the whole tile DAG.
+Distribution is expressed through sharding (see ``parallel.mesh`` /
+``parallel.layout``) rather than per-rank local storage; GSPMD partitions
+the global array and inserts collectives where tiles cross rank boundaries.
+
+Padding semantics: ``data`` has shape (MT*mb, NT*nb). The region beyond
+(M, N) is *owned by the framework*: generators write zeros there, and
+factorization entry points that need a nonsingular padded diagonal
+(Cholesky/TRSM/LU) install an identity pad via :meth:`TileMatrix.pad_diag`.
+All residual checks slice back to (M, N).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _ceildiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+@dataclasses.dataclass(frozen=True)
+class Dist:
+    """Block-cyclic distribution descriptor.
+
+    Mirrors the parameters of ``parsec_matrix_block_cyclic_init``
+    (ref tests/testing_zpotrf.c:100-103): process grid P×Q, supertile
+    (k-cyclic) factors kp/kq, grid offsets ip/jq.
+    """
+
+    P: int = 1
+    Q: int = 1
+    kp: int = 1
+    kq: int = 1
+    ip: int = 0
+    jq: int = 0
+
+    def __post_init__(self):
+        if self.P < 1 or self.Q < 1 or self.kp < 1 or self.kq < 1:
+            raise ValueError(f"invalid distribution {self}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TileDesc:
+    """Static shape/tiling metadata for a tile matrix."""
+
+    M: int
+    N: int
+    mb: int
+    nb: int
+    dist: Dist = Dist()
+
+    def __post_init__(self):
+        if self.M < 0 or self.N < 0 or self.mb < 1 or self.nb < 1:
+            raise ValueError(f"invalid descriptor {self}")
+
+    @property
+    def MT(self) -> int:
+        return max(1, _ceildiv(self.M, self.mb))
+
+    @property
+    def NT(self) -> int:
+        return max(1, _ceildiv(self.N, self.nb))
+
+    @property
+    def Mp(self) -> int:
+        """Padded row count."""
+        return self.MT * self.mb
+
+    @property
+    def Np(self) -> int:
+        """Padded column count."""
+        return self.NT * self.nb
+
+    @property
+    def KT(self) -> int:
+        """Number of diagonal tiles."""
+        return min(self.MT, self.NT)
+
+    def with_shape(self, M: int, N: int) -> "TileDesc":
+        return dataclasses.replace(self, M=M, N=N)
+
+    def transposed(self) -> "TileDesc":
+        d = self.dist
+        dist_t = Dist(d.Q, d.P, d.kq, d.kp, d.jq, d.ip)
+        return TileDesc(self.N, self.M, self.nb, self.mb, dist_t)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TileMatrix:
+    """A tiled (optionally distributed) matrix: padded global 2-D storage.
+
+    ``data`` has shape ``(desc.Mp, desc.Np)``; entries beyond ``(M, N)``
+    are padding (see module docstring).
+    """
+
+    data: jax.Array
+    desc: TileDesc = dataclasses.field(metadata=dict(static=True))
+
+    # -- construction -------------------------------------------------
+    @staticmethod
+    def zeros(M: int, N: int, mb: int, nb: int, dtype=jnp.float32,
+              dist: Dist = Dist()) -> "TileMatrix":
+        d = TileDesc(M, N, mb, nb, dist)
+        return TileMatrix(jnp.zeros((d.Mp, d.Np), dtype=dtype), d)
+
+    @staticmethod
+    def from_dense(a, mb: int, nb: int, dist: Dist = Dist()) -> "TileMatrix":
+        a = jnp.asarray(a)
+        M, N = a.shape
+        d = TileDesc(M, N, mb, nb, dist)
+        data = jnp.zeros((d.Mp, d.Np), dtype=a.dtype).at[:M, :N].set(a)
+        return TileMatrix(data, d)
+
+    def like(self, data: jax.Array) -> "TileMatrix":
+        assert data.shape == self.data.shape, (data.shape, self.data.shape)
+        return TileMatrix(data, self.desc)
+
+    # -- basic properties ---------------------------------------------
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def shape(self):
+        return (self.desc.M, self.desc.N)
+
+    @property
+    def MT(self) -> int:
+        return self.desc.MT
+
+    @property
+    def NT(self) -> int:
+        return self.desc.NT
+
+    @property
+    def mb(self) -> int:
+        return self.desc.mb
+
+    @property
+    def nb(self) -> int:
+        return self.desc.nb
+
+    # -- views ---------------------------------------------------------
+    def to_dense(self) -> jax.Array:
+        return self.data[: self.desc.M, : self.desc.N]
+
+    def tile(self, i: int, j: int) -> jax.Array:
+        """Tile (i, j) as an (mb, nb) array. Static trace-time indices."""
+        mb, nb = self.desc.mb, self.desc.nb
+        return self.data[i * mb:(i + 1) * mb, j * nb:(j + 1) * nb]
+
+    def set_tile(self, i: int, j: int, val) -> "TileMatrix":
+        mb, nb = self.desc.mb, self.desc.nb
+        return self.like(
+            self.data.at[i * mb:(i + 1) * mb, j * nb:(j + 1) * nb].set(val))
+
+    def block(self, i0: int, i1: int, j0: int, j1: int) -> jax.Array:
+        """Rows of tiles [i0, i1) × cols of tiles [j0, j1) as a 2-D array."""
+        mb, nb = self.desc.mb, self.desc.nb
+        return self.data[i0 * mb: i1 * mb, j0 * nb: j1 * nb]
+
+    def set_block(self, i0: int, i1: int, j0: int, j1: int, val) -> "TileMatrix":
+        mb, nb = self.desc.mb, self.desc.nb
+        return self.like(
+            self.data.at[i0 * mb: i1 * mb, j0 * nb: j1 * nb].set(val))
+
+    def add_block(self, i0: int, i1: int, j0: int, j1: int, val) -> "TileMatrix":
+        mb, nb = self.desc.mb, self.desc.nb
+        return self.like(
+            self.data.at[i0 * mb: i1 * mb, j0 * nb: j1 * nb].add(val))
+
+    # -- padding management -------------------------------------------
+    def zero_pad(self) -> "TileMatrix":
+        """Force the padding region to zero."""
+        M, N = self.desc.M, self.desc.N
+        Mp, Np = self.desc.Mp, self.desc.Np
+        if Mp == M and Np == N:
+            return self
+        data = self.data
+        if Mp > M:
+            data = data.at[M:, :].set(0)
+        if Np > N:
+            data = data.at[:M, N:].set(0)
+        return self.like(data)
+
+    def pad_diag(self, value=1.0) -> "TileMatrix":
+        """Set the padded diagonal to ``value`` (and pad off-diag to zero).
+
+        Makes padded square factorizations well-posed: chol/LU/trsm of
+        blkdiag(A, value*I) leave the (M, N) region exact.
+        """
+        d = self.desc
+        K = min(d.M, d.N)
+        Kp = min(d.Mp, d.Np)
+        if Kp == K:
+            return self.zero_pad()
+        out = self.zero_pad()
+        idx = jnp.arange(K, Kp)
+        data = out.data.at[idx, idx].set(jnp.asarray(value, self.dtype))
+        return self.like(data)
+
+    # -- conversion ----------------------------------------------------
+    def astype(self, dtype) -> "TileMatrix":
+        return self.like(self.data.astype(dtype))
+
+    def __repr__(self):
+        d = self.desc
+        return (f"TileMatrix({d.M}x{d.N}, tiles {d.mb}x{d.nb} "
+                f"[{d.MT}x{d.NT}], dist P={d.dist.P} Q={d.dist.Q}, "
+                f"{self.data.dtype})")

@@ -1,0 +1,3 @@
+from dplasma_tpu.kernels import blas
+
+__all__ = ["blas"]

@@ -1,0 +1,163 @@
+"""Tile-level compute kernels (the CORE_z* substrate).
+
+The reference's sequential CPU tile kernels (``src/cores/*.c``, PLASMA
+descended: CORE_zgemm/ztrsm/zherk/zpotrf — ref src/cores/CMakeLists.txt)
+become, on TPU:
+
+- MXU matmuls via ``jax.lax.dot_general`` with explicit precision control
+  (bf16x3/x6 passes for f32, "highest" for correctness-critical paths);
+- ``lax.linalg`` primitives for small dense factorizations on a tile;
+- Pallas kernels (``kernels/pallas``) for the hot fused paths.
+
+Everything here is shape-static and jit-traceable; matrix-level blocked
+algorithms in ``ops/`` compose these over tiles/panels.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Global matmul precision for f32 inputs on TPU. "highest" = full f32
+# accumulate via multi-pass bf16 (correctness first; benches may lower it).
+_PRECISION = lax.Precision.HIGHEST
+
+
+def set_precision(p):
+    global _PRECISION
+    _PRECISION = p
+
+
+def get_precision():
+    return _PRECISION
+
+
+def _acc_type(dtype):
+    """Accumulator type for MXU products."""
+    if dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return dtype
+
+
+def dot(a, b, ta: bool = False, tb: bool = False, conj_a: bool = False,
+        conj_b: bool = False):
+    """op(a) @ op(b) with precision/accumulator control.
+
+    ``ta``/``tb`` transpose; ``conj_*`` conjugate (for the C/Z cases the
+    reference enumerates as dplasmaNoTrans/Trans/ConjTrans).
+    """
+    res_dtype = jnp.result_type(a.dtype, b.dtype)
+    a = a.astype(res_dtype)
+    b = b.astype(res_dtype)
+    if conj_a:
+        a = a.conj()
+    if conj_b:
+        b = b.conj()
+    if ta:
+        a = a.T
+    if tb:
+        b = b.T
+    out = jnp.matmul(a, b, precision=_PRECISION,
+                     preferred_element_type=_acc_type(res_dtype))
+    return out.astype(res_dtype)
+
+
+def gemm(alpha, a, b, beta, c, ta=False, tb=False, conj_a=False, conj_b=False):
+    """C = alpha op(A) op(B) + beta C (CORE_zgemm semantics)."""
+    return alpha * dot(a, b, ta, tb, conj_a, conj_b) + beta * c
+
+
+def potrf(a, lower: bool = True):
+    """Cholesky of one tile (CORE_zpotrf). Returns the triangular factor
+    with the opposite triangle zeroed."""
+    if lower:
+        return lax.linalg.cholesky(a)
+    # upper: A = U^H U ; chol returns lower L with A = L L^H, U = L^H
+    return lax.linalg.cholesky(a).conj().T
+
+
+def trsm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
+    """Triangular solve: solves op(A) X = alpha B (side=L) or
+    X op(A) = alpha B (side=R). CORE_ztrsm semantics."""
+    transpose = trans in ("T", "C")
+    conj = trans == "C"
+    x = lax.linalg.triangular_solve(
+        a, alpha * b,
+        left_side=(side == "L"),
+        lower=lower,
+        transpose_a=transpose,
+        conjugate_a=conj,
+        unit_diagonal=unit,
+    )
+    return x
+
+
+def trmm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
+    """Triangular matrix multiply B = alpha op(A) B (or B op(A))."""
+    m = a.shape[0]
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if unit:
+        tri = tri - jnp.diag(jnp.diag(tri)) + jnp.eye(m, dtype=a.dtype)
+    if trans == "T":
+        tri = tri.T
+    elif trans == "C":
+        tri = tri.conj().T
+    if side == "L":
+        return alpha * dot(tri, b)
+    return alpha * dot(b, tri)
+
+
+def syrk(alpha, a, beta, c, *, lower=True, trans="N"):
+    """C = alpha A A^T + beta C, symmetric rank-k (triangle-correct on the
+    full tile; callers keep only the relevant triangle)."""
+    if trans == "N":
+        upd = dot(a, a, tb=True)
+    else:
+        upd = dot(a, a, ta=True)
+    return alpha * upd + beta * c
+
+
+def herk(alpha, a, beta, c, *, lower=True, trans="N"):
+    """C = alpha A A^H + beta C (Hermitian rank-k)."""
+    if trans == "N":
+        upd = dot(a, a, tb=True, conj_b=True)
+    else:
+        upd = dot(a, a, ta=True, conj_a=True)
+    return alpha * upd + beta * c
+
+
+def getrf_nopiv(a):
+    """LU without pivoting of one tile (CORE_zgetrf_nopiv): returns packed
+    L\\U (unit L implicit)."""
+    n = a.shape[0]
+
+    def body(k, m):
+        col = m[:, k]
+        piv = m[k, k]
+        scale = jnp.where(jnp.arange(m.shape[0]) > k, 1.0 / piv, 0.0)
+        l = col * scale.astype(m.dtype)
+        row = jnp.where(jnp.arange(m.shape[1]) > k, m[k, :], 0.0)
+        m = m - jnp.outer(l, row).astype(m.dtype)
+        m = m.at[:, k].set(jnp.where(jnp.arange(m.shape[0]) > k, l, m[:, k]))
+        return m
+
+    return lax.fori_loop(0, min(a.shape), body, a)
+
+
+def lauum(a, lower: bool = True):
+    """Tile LAUUM: L^H L (lower) or U U^H (upper) of triangular tile."""
+    if lower:
+        t = jnp.tril(a)
+        return dot(t, t, ta=True, conj_a=True)
+    t = jnp.triu(a)
+    return dot(t, t, tb=True, conj_b=True)
+
+
+def trtri(a, *, lower=True, unit=False):
+    """Tile triangular inverse via solve against identity."""
+    n = a.shape[0]
+    eye = jnp.eye(n, dtype=a.dtype)
+    return lax.linalg.triangular_solve(
+        a, eye, left_side=True, lower=lower, unit_diagonal=unit)

@@ -1,0 +1,3 @@
+from dplasma_tpu.ops import aux, checks, generators, map as map_ops, norms
+
+__all__ = ["aux", "checks", "generators", "map_ops", "norms"]

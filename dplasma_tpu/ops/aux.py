@@ -1,0 +1,101 @@
+"""Elementwise / Level-2 auxiliary operations on tile matrices.
+
+Reference: the map-framework clients — dplasma_zlacpy, zlaset, zgeadd,
+ztradd, zlascal, zger(u/c) (ref src/zgeadd_wrapper.c, src/zger.jdf,
+SURVEY §2.2 "Level-2/aux BLAS"). All are single fused XLA ops here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dplasma_tpu.descriptors import TileMatrix
+
+
+def _tri_mask(M, N, uplo: str, dtype):
+    r = jnp.arange(M)[:, None]
+    c = jnp.arange(N)[None, :]
+    u = uplo.upper()
+    if u == "L":
+        return (r >= c)
+    if u == "U":
+        return (r <= c)
+    return jnp.ones((M, N), dtype=bool)
+
+
+def lacpy(A: TileMatrix, uplo: str = "A") -> TileMatrix:
+    """Copy general/lower/upper part of A into a fresh matrix
+    (dplasma_zlacpy)."""
+    x = A.zero_pad()
+    if uplo.upper() in ("A", "G"):
+        return x.like(x.data)
+    m = _tri_mask(x.desc.Mp, x.desc.Np, uplo, x.dtype)
+    return x.like(jnp.where(m, x.data, jnp.zeros((), x.dtype)))
+
+
+def laset(A: TileMatrix, alpha, beta, uplo: str = "A") -> TileMatrix:
+    """Set off-diagonal to alpha, diagonal to beta (dplasma_zlaset)."""
+    d = A.desc
+    r = jnp.arange(d.Mp)[:, None]
+    c = jnp.arange(d.Np)[None, :]
+    a = jnp.asarray(alpha, A.dtype)
+    b = jnp.asarray(beta, A.dtype)
+    v = jnp.where(r == c, b, a)
+    u = uplo.upper()
+    if u == "L":
+        v = jnp.where(r >= c, v, A.data)
+    elif u == "U":
+        v = jnp.where(r <= c, v, A.data)
+    out = A.like(jnp.broadcast_to(v, A.data.shape))
+    return out.zero_pad()
+
+
+def geadd(A: TileMatrix, B: TileMatrix, alpha=1.0, beta=1.0,
+          trans: str = "N") -> TileMatrix:
+    """B = alpha op(A) + beta B (dplasma_zgeadd)."""
+    x = A.to_dense()
+    if trans == "T":
+        x = x.T
+    elif trans == "C":
+        x = x.conj().T
+    a = jnp.asarray(alpha, B.dtype)
+    b = jnp.asarray(beta, B.dtype)
+    newb = a * x + b * B.to_dense()
+    return TileMatrix.from_dense(newb, B.desc.mb, B.desc.nb, B.desc.dist)
+
+
+def tradd(A: TileMatrix, B: TileMatrix, alpha=1.0, beta=1.0,
+          uplo: str = "L", trans: str = "N") -> TileMatrix:
+    """Triangular add: the uplo triangle of B gets alpha op(A) + beta B;
+    the rest of B is untouched (dplasma_ztradd)."""
+    x = A.to_dense()
+    if trans == "T":
+        x = x.T
+    elif trans == "C":
+        x = x.conj().T
+    m = _tri_mask(B.desc.M, B.desc.N, uplo, B.dtype)
+    bd = B.to_dense()
+    a = jnp.asarray(alpha, B.dtype)
+    b = jnp.asarray(beta, B.dtype)
+    newb = jnp.where(m, a * x + b * bd, bd)
+    return TileMatrix.from_dense(newb, B.desc.mb, B.desc.nb, B.desc.dist)
+
+
+def lascal(A: TileMatrix, alpha, uplo: str = "A") -> TileMatrix:
+    """Scale (a triangle of) A by alpha (dplasma_zlascal)."""
+    a = jnp.asarray(alpha, A.dtype)
+    if uplo.upper() in ("A", "G"):
+        return A.like(A.data * a)
+    m = _tri_mask(A.desc.Mp, A.desc.Np, uplo, A.dtype)
+    return A.like(jnp.where(m, A.data * a, A.data))
+
+
+def ger(alpha, x, y, A: TileMatrix, conj_y: bool = True) -> TileMatrix:
+    """Rank-1 update A += alpha x y^{H or T} (dplasma_zgerc / zgeru,
+    ref src/zger.jdf)."""
+    x = jnp.asarray(x, A.dtype)
+    y = jnp.asarray(y, A.dtype)
+    yv = y.conj() if conj_y else y
+    upd = jnp.zeros_like(A.data)
+    upd = upd.at[: x.shape[0], : y.shape[0]].set(
+        jnp.asarray(alpha, A.dtype) * jnp.outer(x, yv))
+    return A.like(A.data + upd)

@@ -1,0 +1,3 @@
+from dplasma_tpu.parallel import layout, mesh
+
+__all__ = ["layout", "mesh"]

@@ -1,0 +1,3 @@
+from dplasma_tpu.utils import flops
+
+__all__ = ["flops"]

@@ -1,0 +1,38 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's CI strategy of simulating multi-node with
+oversubscribed local ranks (ref: .github/workflows/build_cmake.yml:36,
+tests/Testings.cmake:168-274) — here via XLA's host-platform device count.
+"""
+import os
+
+# NOTE: this image imports jax from sitecustomize before conftest runs,
+# so plain env vars are too late for jax's import-time config read; the
+# XLA_FLAGS below still work because backends initialize lazily, and
+# jax_platforms is forced via config.update as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(3872)
