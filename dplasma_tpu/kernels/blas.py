@@ -69,13 +69,27 @@ def gemm(alpha, a, b, beta, c, ta=False, tb=False, conj_a=False, conj_b=False):
     return alpha * dot(a, b, ta, tb, conj_a, conj_b) + beta * c
 
 
+def tri(x, lower: bool = True, unit: bool = False):
+    """Extract the named triangle (optionally with unit diagonal),
+    non-square safe. Shared by trmm/trsm/lantr/blas3."""
+    t = jnp.tril(x) if lower else jnp.triu(x)
+    if unit:
+        r = jnp.arange(x.shape[0])[:, None]
+        c = jnp.arange(x.shape[1])[None, :]
+        t = jnp.where(r == c, jnp.ones((), x.dtype), t)
+    return t
+
+
 def potrf(a, lower: bool = True):
-    """Cholesky of one tile (CORE_zpotrf). Returns the triangular factor
+    """Cholesky of one tile (CORE_zpotrf). Reads ONLY the ``lower``/upper
+    triangle of ``a`` (the opposite triangle may hold scratch, per the
+    reference's stored-triangle contract); returns the triangular factor
     with the opposite triangle zeroed."""
     if lower:
-        return lax.linalg.cholesky(a)
-    # upper: A = U^H U ; chol returns lower L with A = L L^H, U = L^H
-    return lax.linalg.cholesky(a).conj().T
+        return lax.linalg.cholesky(a, symmetrize_input=False)
+    # upper storage: the Hermitian matrix's lower representation is a^H;
+    # A = U^H U with U = chol(a^H)^H
+    return lax.linalg.cholesky(a.conj().T, symmetrize_input=False).conj().T
 
 
 def trsm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
@@ -96,17 +110,14 @@ def trsm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
 
 def trmm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
     """Triangular matrix multiply B = alpha op(A) B (or B op(A))."""
-    m = a.shape[0]
-    tri = jnp.tril(a) if lower else jnp.triu(a)
-    if unit:
-        tri = tri - jnp.diag(jnp.diag(tri)) + jnp.eye(m, dtype=a.dtype)
+    t = tri(a, lower=lower, unit=unit)
     if trans == "T":
-        tri = tri.T
+        t = t.T
     elif trans == "C":
-        tri = tri.conj().T
+        t = t.conj().T
     if side == "L":
-        return alpha * dot(tri, b)
-    return alpha * dot(b, tri)
+        return alpha * dot(t, b)
+    return alpha * dot(b, t)
 
 
 def syrk(alpha, a, beta, c, *, lower=True, trans="N"):

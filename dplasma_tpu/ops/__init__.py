@@ -1,3 +1,5 @@
-from dplasma_tpu.ops import aux, checks, generators, map as map_ops, norms
+from dplasma_tpu.ops import (aux, blas3, checks, generators, info,
+                             map as map_ops, norms, potrf)
 
-__all__ = ["aux", "checks", "generators", "map_ops", "norms"]
+__all__ = ["aux", "blas3", "checks", "generators", "info", "map_ops",
+           "norms", "potrf"]

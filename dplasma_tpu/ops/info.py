@@ -1,0 +1,33 @@
+"""INFO propagation — the factorization failure-detection path.
+
+Reference: local ``iinfo`` scaled by tile offset, reduced across ranks
+with ``MPI_Allreduce(MAX)`` (src/zpotrf_L.jdf:176-187,
+src/zpotrf_wrapper.c:327-333). That is the reference's ONLY "failure"
+subsystem (SURVEY §5.3): no checkpointing, no elasticity.
+
+TPU-native design: inside a jit program a failed tile factorization
+yields NaN/Inf in the factor (sqrt of a negative pivot, division by
+zero). The INFO equivalent is a post-hoc device-side scan: the first
+row whose entries are non-finite, reduced with a global argmin — under
+a mesh this lowers to the same MAX/MIN collective the reference issued
+explicitly. Returns 0 for success, i+1 for first bad row (LAPACK
+convention).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dplasma_tpu.descriptors import TileMatrix
+
+
+def factor_info(F: TileMatrix, uplo: str = "L") -> jnp.ndarray:
+    """LAPACK-style INFO from a computed factor: 0 if every entry of the
+    stored triangle is finite, else 1-based index of the first bad row."""
+    x = F.to_dense()
+    r = jnp.arange(x.shape[0])[:, None]
+    c = jnp.arange(x.shape[1])[None, :]
+    m = (r >= c) if uplo.upper() == "L" else (r <= c)
+    bad = (~jnp.isfinite(x)) & m
+    bad_row = jnp.where(bad.any(axis=1), r[:, 0], x.shape[0])
+    first = bad_row.min()
+    return jnp.where(first == x.shape[0], 0, first + 1).astype(jnp.int32)

@@ -61,11 +61,9 @@ def lansy(A: TileMatrix, norm: str = "F", uplo: str = "L"):
 
 def lantr(A: TileMatrix, norm: str = "F", uplo: str = "L", diag: str = "N"):
     """Triangular matrix norm (dplasma_zlantr)."""
-    x = A.to_dense()
-    t = jnp.tril(x) if uplo.upper() == "L" else jnp.triu(x)
-    if diag.upper() == "U":
-        t = t - jnp.diag(jnp.diag(t)) + jnp.eye(A.desc.M, A.desc.N,
-                                                dtype=t.dtype)
+    from dplasma_tpu.kernels import blas as _k
+    t = _k.tri(A.to_dense(), lower=(uplo.upper() == "L"),
+               unit=(diag.upper() == "U"))
     return _norm2d(t, norm)
 
 

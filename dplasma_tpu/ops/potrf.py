@@ -1,0 +1,124 @@
+"""Cholesky factorization family: POTRF / POTRS / POSV / TRTRI / LAUUM /
+POTRI / POINV.
+
+Reference: the right-looking tile Cholesky DAG — tasks potrf_zpotrf(k),
+potrf_ztrsm(m,k), potrf_zherk(k,m), potrf_zgemm(m,n,k) with cubic
+critical-path priorities (src/zpotrf_L.jdf:58-69, 116, 219) and the
+wrapper triple New/blocking/Destruct (src/zpotrf_wrapper.c:175-226);
+POTRS/POSV/POTRI/POINV compositions (src/zpotrs_wrapper.c,
+zposv_wrapper.c, zpotri_wrapper.c, ztrtri_*.jdf, zlauum_*.jdf,
+zpoinv_*.jdf).
+
+TPU-native design: a trace-time unrolled right-looking sweep. Iteration k
+emits THREE large ops — tile Cholesky, one batched panel TRSM, one
+trailing-matrix HERK-shaped matmul on a *shrinking static shape* — so the
+whole factorization is O(KT) MXU-sized XLA ops instead of O(KT³) tile
+tasks. XLA's scheduler overlaps the trailing update with the next panel
+the way PaRSEC's priorities forced lookahead; under a mesh, GSPMD
+partitions each trailing update and emits the panel-broadcast
+collectives that the reference's comm engine derived from
+``type_remote`` annotations (zpotrf_L.jdf:109-114).
+
+Semantics: only the ``uplo`` triangle of the result is meaningful (the
+reference never touches the opposite triangle; we may write scratch
+there). INFO (non-SPD detection) surfaces as NaNs in the factor;
+:func:`dplasma_tpu.ops.info.factor_info` performs the explicit INFO
+reduction (the MPI_Allreduce(MAX) analog).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.kernels import blas as k
+from dplasma_tpu.ops import blas3
+from dplasma_tpu.ops.aux import _tri_mask
+from dplasma_tpu.parallel import mesh as pmesh
+
+
+def potrf(A: TileMatrix, uplo: str = "L") -> TileMatrix:
+    """Tile Cholesky: A = L L^H (uplo=L) or A = U^H U (uplo=U)."""
+    assert A.desc.mb == A.desc.nb, "potrf needs square tiles"
+    assert A.desc.M == A.desc.N, "potrf needs a square matrix"
+    nt = A.desc.KT
+    mb = A.desc.mb
+    lower = uplo.upper() == "L"
+    X = A.pad_diag().data
+
+    for kk in range(nt):
+        s = kk * mb
+        e = (kk + 1) * mb
+        lkk = k.potrf(X[s:e, s:e], lower=lower)
+        X = X.at[s:e, s:e].set(lkk)
+        if kk + 1 == nt:
+            break
+        if lower:
+            # panel: L21 = A21 L11^{-H}   (one batched TRSM)
+            pan = k.trsm(lkk, X[e:, s:e], side="R", lower=True, trans="C")
+            X = X.at[e:, s:e].set(pan)
+            # trailing: A22 -= L21 L21^H  (one MXU matmul; only the lower
+            # triangle is meaningful downstream)
+            X = X.at[e:, e:].add(-k.dot(pan, pan, tb=True, conj_b=True))
+        else:
+            pan = k.trsm(lkk, X[s:e, e:], side="L", lower=False, trans="C")
+            X = X.at[s:e, e:].set(pan)
+            X = X.at[e:, e:].add(-k.dot(pan, pan, ta=True, conj_a=True))
+        X = pmesh.constrain2d(X)
+    return TileMatrix(X, A.desc)
+
+
+def potrs(A: TileMatrix, B: TileMatrix, uplo: str = "L") -> TileMatrix:
+    """Solve A X = B given the Cholesky factor (dplasma_zpotrs:
+    two blocked TRSM sweeps)."""
+    if uplo.upper() == "L":
+        y = blas3.trsm(1.0, A, B, side="L", uplo="L", trans="N")
+        return blas3.trsm(1.0, A, y, side="L", uplo="L", trans="C")
+    y = blas3.trsm(1.0, A, B, side="L", uplo="U", trans="C")
+    return blas3.trsm(1.0, A, y, side="L", uplo="U", trans="N")
+
+
+def posv(A: TileMatrix, B: TileMatrix, uplo: str = "L"):
+    """Factor + solve (dplasma_zposv). Returns (factor, X)."""
+    L = potrf(A, uplo)
+    return L, potrs(L, B, uplo)
+
+
+def trtri(A: TileMatrix, uplo: str = "L", diag: str = "N") -> TileMatrix:
+    """Triangular inverse (dplasma_ztrtri, ztrtri_{L,U}.jdf): blocked
+    solve against the identity."""
+    eye = TileMatrix.from_dense(
+        jnp.eye(A.desc.M, A.desc.N, dtype=A.dtype),
+        A.desc.mb, A.desc.nb, A.desc.dist)
+    inv = blas3.trsm(1.0, A, eye, side="L", uplo=uplo, trans="N", diag=diag)
+    # keep only the triangle (inverse of triangular is triangular)
+    m = _tri_mask(inv.desc.Mp, inv.desc.Np, uplo, inv.dtype)
+    return inv.like(jnp.where(m, inv.data, jnp.zeros((), inv.dtype)))
+
+
+def lauum(A: TileMatrix, uplo: str = "L") -> TileMatrix:
+    """L^H L (lower) or U U^H (upper) of a triangular factor
+    (dplasma_zlauum, zlauum_{L,U}.jdf) — one MXU matmul, result stored
+    in the ``uplo`` triangle."""
+    x = A.to_dense()
+    if uplo.upper() == "L":
+        t = jnp.tril(x)
+        prod = k.dot(t, t, ta=True, conj_a=True)
+    else:
+        t = jnp.triu(x)
+        prod = k.dot(t, t, tb=True, conj_b=True)
+    m = _tri_mask(A.desc.M, A.desc.N, uplo, A.dtype)
+    out = jnp.where(m, prod, x)
+    return TileMatrix.from_dense(out, A.desc.mb, A.desc.nb, A.desc.dist)
+
+
+def potri(A: TileMatrix, uplo: str = "L") -> TileMatrix:
+    """A^{-1} from the Cholesky factor (dplasma_zpotri = trtri ∘ lauum,
+    src/zpotri_wrapper.c)."""
+    return lauum(trtri(A, uplo), uplo)
+
+
+def poinv(A: TileMatrix, uplo: str = "L") -> TileMatrix:
+    """Direct SPD inverse (dplasma_zpoinv, zpoinv_{L,U}.jdf): the
+    reference fuses potrf+trtri+lauum into one DAG; under XLA the fused
+    schedule falls out of composing the three sweeps in one jit scope."""
+    return potri(potrf(A, uplo), uplo)
