@@ -1,0 +1,87 @@
+"""Headline benchmark: DPOTRF GFlop/s on the available accelerator.
+
+Mirrors the reference's measurement semantics: LAWN-41 flop formulas and
+``gflops = flops/1e9 / sync_time_elapsed`` (ref tests/common.h:136-145,
+src/flops.h:12-22). The reference publishes no absolute numbers
+(BASELINE.md), so ``vs_baseline`` is reported against the north-star
+target of 70% machine peak (BASELINE.json): we self-measure peak with a
+GEMM microbench (the reference's tools/gemmpeak analog) and report
+``(potrf_pct_peak / 0.70)`` — 1.0 means the target is met.
+
+Prints exactly ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.kernels import blas as k
+from dplasma_tpu.ops import generators, potrf as potrf_mod
+from dplasma_tpu.utils import flops as lawn41
+
+
+def _sync(x):
+    # On some transports block_until_ready returns before remote execution
+    # completes; a (tiny) device fetch is a true sync barrier.
+    np.asarray(x.ravel()[:1])
+
+
+def _time_best(fn, *args, reps=3):
+    _sync(fn(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _gemm_peak(n=None, chain=4, dtype=jnp.float32):
+    """Machine-peak GEMM microbench (tools/gemmpeak analog). Chains
+    ``chain`` dependent matmuls in one dispatch to amortize per-call
+    transport latency."""
+    n = n or (8192 if jax.default_backend() == "tpu" else 1024)
+    a = jnp.ones((n, n), dtype)
+    b = jnp.ones((n, n), dtype)
+
+    def f(x, y):
+        for _ in range(chain):
+            y = k.dot(x, y)
+        return y
+
+    t = _time_best(jax.jit(f), a, b)
+    return chain * lawn41.gemm(n, n, n) / 1e9 / t
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    N, nb = (16384, 2048) if on_tpu else (4096, 512)
+    dtype = jnp.float32
+
+    A0 = generators.plghe(float(N), N, nb, seed=3872, dtype=dtype)
+
+    def run(data):
+        A = TileMatrix(data, A0.desc)
+        return potrf_mod.potrf(A, "L").data
+
+    f = jax.jit(run)
+    t = _time_best(f, A0.data)
+    gflops = lawn41.potrf(N) / 1e9 / t
+
+    peak = _gemm_peak(dtype=dtype)
+    pct_peak = gflops / peak if peak > 0 else 0.0
+    print(json.dumps({
+        "metric": f"dpotrf_gflops_n{N}_{jax.default_backend()}",
+        "value": round(gflops, 2),
+        "unit": "GFlop/s",
+        "vs_baseline": round(pct_peak / 0.70, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

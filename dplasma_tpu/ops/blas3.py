@@ -94,6 +94,8 @@ def syrk(alpha, A: TileMatrix, beta, C: TileMatrix, uplo: str = "L",
          trans: str = "N") -> TileMatrix:
     """C_tri = alpha A A^T + beta C (zsyrk; 4 uplo×trans JDFs in the
     reference)."""
+    if trans not in ("N", "T"):
+        raise ValueError(f"syrk trans must be N or T, got {trans!r}")
     a = A.to_dense()
     upd = k.dot(a, a, tb=True) if trans == "N" else k.dot(a, a, ta=True)
     return _rank_k_update(alpha, upd, beta, C, uplo)
@@ -102,6 +104,8 @@ def syrk(alpha, A: TileMatrix, beta, C: TileMatrix, uplo: str = "L",
 def herk(alpha, A: TileMatrix, beta, C: TileMatrix, uplo: str = "L",
          trans: str = "N") -> TileMatrix:
     """C_tri = alpha A A^H + beta C (zherk)."""
+    if trans not in ("N", "C"):
+        raise ValueError(f"herk trans must be N or C, got {trans!r}")
     a = A.to_dense()
     if trans == "N":
         upd = k.dot(a, a, tb=True, conj_b=True)
@@ -113,6 +117,8 @@ def herk(alpha, A: TileMatrix, beta, C: TileMatrix, uplo: str = "L",
 def syr2k(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
           uplo: str = "L", trans: str = "N") -> TileMatrix:
     """C_tri = alpha A B^T + alpha B A^T + beta C (zsyr2k)."""
+    if trans not in ("N", "T"):
+        raise ValueError(f"syr2k trans must be N or T, got {trans!r}")
     a, b = A.to_dense(), B.to_dense()
     if trans == "N":
         upd = k.dot(a, b, tb=True) + k.dot(b, a, tb=True)
@@ -124,6 +130,8 @@ def syr2k(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
 def her2k(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
           uplo: str = "L", trans: str = "N") -> TileMatrix:
     """C_tri = alpha A B^H + conj(alpha) B A^H + beta C (zher2k)."""
+    if trans not in ("N", "C"):
+        raise ValueError(f"her2k trans must be N or C, got {trans!r}")
     a, b = A.to_dense(), B.to_dense()
     al = jnp.asarray(alpha, C.dtype)
     if trans == "N":
@@ -171,13 +179,12 @@ def trsm(alpha, A: TileMatrix, B: TileMatrix, side: str = "L",
     def dtile(kk):
         return Ap[kk * mb:(kk + 1) * mb, kk * mb:(kk + 1) * mb]
 
-    # Effective triangular orientation of op(A):
-    #  (L, N) / (U, T/C) -> forward substitution
-    #  (U, N) / (L, T/C) -> backward substitution
-    forward = (u == "L") == (tchar == "N")
-    order = range(nt) if forward else range(nt - 1, -1, -1)
-
     if side.upper() == "L":
+        # Effective triangular orientation of op(A):
+        #  (L, N) / (U, T/C) -> forward substitution
+        #  (U, N) / (L, T/C) -> backward substitution
+        forward = (u == "L") == (tchar == "N")
+        order = range(nt) if forward else range(nt - 1, -1, -1)
         for kk in order:
             xk = k.trsm(dtile(kk), X[kk * mb:(kk + 1) * mb, :],
                         side="L", lower=(u == "L"), trans=tchar, unit=unit)

@@ -18,16 +18,15 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.ops.aux import _tri_mask
 
 
 def factor_info(F: TileMatrix, uplo: str = "L") -> jnp.ndarray:
     """LAPACK-style INFO from a computed factor: 0 if every entry of the
     stored triangle is finite, else 1-based index of the first bad row."""
     x = F.to_dense()
-    r = jnp.arange(x.shape[0])[:, None]
-    c = jnp.arange(x.shape[1])[None, :]
-    m = (r >= c) if uplo.upper() == "L" else (r <= c)
+    m = _tri_mask(x.shape[0], x.shape[1], uplo, x.dtype)
     bad = (~jnp.isfinite(x)) & m
-    bad_row = jnp.where(bad.any(axis=1), r[:, 0], x.shape[0])
+    bad_row = jnp.where(bad.any(axis=1), jnp.arange(x.shape[0]), x.shape[0])
     first = bad_row.min()
     return jnp.where(first == x.shape[0], 0, first + 1).astype(jnp.int32)

@@ -100,12 +100,7 @@ def lauum(A: TileMatrix, uplo: str = "L") -> TileMatrix:
     (dplasma_zlauum, zlauum_{L,U}.jdf) — one MXU matmul, result stored
     in the ``uplo`` triangle."""
     x = A.to_dense()
-    if uplo.upper() == "L":
-        t = jnp.tril(x)
-        prod = k.dot(t, t, ta=True, conj_a=True)
-    else:
-        t = jnp.triu(x)
-        prod = k.dot(t, t, tb=True, conj_b=True)
+    prod = k.lauum(x, lower=(uplo.upper() == "L"))
     m = _tri_mask(A.desc.M, A.desc.N, uplo, A.dtype)
     out = jnp.where(m, prod, x)
     return TileMatrix.from_dense(out, A.desc.mb, A.desc.nb, A.desc.dist)
