@@ -1,0 +1,109 @@
+"""Householder reflector kernels — the QR/LQ tile substrate.
+
+The reference's QR cores are the PLASMA TS/TT kernel family:
+``CORE_zgeqrt`` (tile QR with inner blocking IB), ``CORE_ztsqrt`` /
+``CORE_zttqrt`` (couple a triangle with a square/triangular tile),
+and the appliers ``CORE_zunmqr`` / ``CORE_ztsmqr`` / ``CORE_zttmqr``
+built on ``CORE_zpamm/zparfb`` (ref src/cores/CMakeLists.txt:4-80,
+SURVEY §2.2 "CPU core kernels").
+
+TPU-native design: every kernel is the *compact-WY block reflector*
+Q = I - V T V^H applied with three MXU matmuls — no inner IB blocking
+(IB exists on CPUs to fit cache; on TPU the MXU wants the full panel).
+The structured TS/TT couplings become one generic "stacked QR" on the
+concatenated tiles: XLA sees only dense matmuls + one panel geqrf.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from dplasma_tpu.kernels import blas as k
+
+
+def geqrf_packed(a):
+    """LAPACK-style packed QR: returns (packed, taus). Public surface in
+    this JAX is ``qr(mode='raw')``, which hands back the transposed
+    packed array."""
+    h, taus = jnp.linalg.qr(a, mode="raw")
+    return h.mT, taus
+
+
+def split_qr(packed):
+    """Split a LAPACK-packed geqrf result into (V, R).
+
+    V is unit lower-trapezoidal (ones on the diagonal, zeros above),
+    R upper triangular, shapes (m, n) and (n, n) for m >= n.
+    """
+    n = packed.shape[1]
+    r = jnp.triu(packed[:n, :])
+    v = k.tri(packed, lower=True, unit=True)
+    return v, r
+
+
+def larft(v, taus):
+    """Form the upper-triangular T of the compact-WY representation
+    (CORE_zlarft analog): Q = I - V T V^H.
+
+    Closed form (replaces LAPACK's column recurrence — one MXU matmul
+    plus one triangular solve): with B = strict_upper(V^H V) and
+    D = diag(tau), T = (I + D B)^{-1} D.
+    """
+    n = taus.shape[0]
+    s = k.dot(v, v, ta=True, conj_a=True)
+    b = jnp.triu(s, 1)
+    taus = taus.astype(v.dtype)
+    m = jnp.eye(n, dtype=v.dtype) + taus[:, None] * b
+    rhs = jnp.diag(taus)
+    return lax.linalg.triangular_solve(
+        m, rhs, left_side=True, lower=False, unit_diagonal=True)
+
+
+def geqrt(a):
+    """Tile/panel QR (CORE_zgeqrt analog): returns (packed, V, T) where
+    ``packed`` stores R on/above the diagonal and the Householder
+    vectors V below it, and T is the compact-WY triangle."""
+    packed, taus = geqrf_packed(a)
+    v, _ = split_qr(packed)
+    return packed, v, larft(v, taus)
+
+
+def apply_q(v, t, c, *, trans: str = "C"):
+    """C ← op(Q) C with Q = I - V T V^H (CORE_zunmqr left-side analog).
+
+    trans='C' applies Q^H (factorization sweep), 'N' applies Q.
+    """
+    tt = t.conj().T if trans == "C" else t
+    w = k.dot(v, c, ta=True, conj_a=True)
+    return c - k.dot(v, k.dot(tt, w))
+
+
+def apply_q_right(v, t, c, *, trans: str = "N"):
+    """C ← C op(Q) (CORE_zunmqr right-side analog)."""
+    tt = t.conj().T if trans == "C" else t
+    w = k.dot(c, v)
+    return c - k.dot(k.dot(w, tt), v, tb=True, conj_b=True)
+
+
+def stacked_qr(top, bot):
+    """QR of the vertical couple [top; bot] — the generic TS/TT kernel
+    (CORE_ztsqrt / CORE_zttqrt analog; both reduce to one dense QR of
+    the stacked tiles on TPU).
+
+    Returns (r, v, t): new top triangle R, Householder vectors V of the
+    stacked panel (unit lower-trapezoidal, (m_top+m_bot) × n), and T.
+    """
+    n = top.shape[1]
+    stacked = jnp.concatenate([top, bot], axis=0)
+    packed, taus = geqrf_packed(stacked)
+    v, r = split_qr(packed)
+    return r[:n, :], v, larft(v, taus)
+
+
+def stacked_apply(v, t, c_top, c_bot, *, trans: str = "C"):
+    """Apply the stacked-couple reflector to the vertical pair
+    [c_top; c_bot] (CORE_ztsmqr / CORE_zttmqr analog)."""
+    m_top = c_top.shape[0]
+    c = jnp.concatenate([c_top, c_bot], axis=0)
+    c = apply_q(v, t, c, trans=trans)
+    return c[:m_top, :], c[m_top:, :]

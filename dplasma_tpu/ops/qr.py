@@ -1,0 +1,194 @@
+"""QR / LQ factorization family (flat tile algorithm).
+
+Reference surface: ``dplasma_zgeqrf`` / ``zgelqf`` / ``zungqr`` /
+``zunglq`` / ``zunmqr`` (4 side×trans cases) / ``zunmlq`` /
+``zgeqrs`` / ``zgelqs`` / ``zgels`` — src/zgeqrf.jdf (609 lines of
+geqrt/tsqrt/unmqr/tsmqr task DAG), src/zgeqrf_wrapper.c,
+src/zgels_wrapper.c (SURVEY §2.2 "QR/LQ flat").
+
+TPU-native design: a trace-time blocked Householder sweep. Where the
+reference decomposes each panel into MT tile tasks chained by TS
+kernels (cache-sized work units for CPU cores), the TPU wants the
+whole panel in one MXU-friendly geqrf and the whole trailing update
+as three large matmuls (compact-WY): per panel k we emit O(1) big XLA
+ops on shrinking static shapes. The T factors live in a (nb × KT·nb)
+tile matrix — the analog of the reference's TS matrix
+(tests/testing_zgeqrf.c T descriptor).
+
+Storage convention (LAPACK/PLASMA compatible): the returned factor
+stores R on/above the diagonal and the Householder vectors V below
+it; LQ stores L on/below and V above.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.kernels import blas as k
+from dplasma_tpu.kernels import householder as hh
+from dplasma_tpu.ops import blas3
+from dplasma_tpu.parallel import mesh as pmesh
+
+
+def _check_square_tiles(A: TileMatrix, who: str):
+    assert A.desc.mb == A.desc.nb, f"{who} needs square tiles"
+
+
+def t_desc(A: TileMatrix) -> TileMatrix:
+    """Allocate the T-factor matrix for A: one nb×nb triangle per panel
+    (the reference's TS/TT descriptor, tests/testing_zgeqrf.c)."""
+    nb = A.desc.nb
+    return TileMatrix.zeros(nb, A.desc.KT * nb, nb, nb, dtype=A.dtype,
+                            dist=A.desc.dist)
+
+
+# -- QR ----------------------------------------------------------------
+
+def geqrf(A: TileMatrix) -> tuple[TileMatrix, TileMatrix]:
+    """A = Q R (dplasma_zgeqrf). Returns (packed factor, T factors)."""
+    _check_square_tiles(A, "geqrf")
+    nb = A.desc.nb
+    KT = A.desc.KT
+    X = A.zero_pad().data
+    Np = A.desc.Np
+    Tm = t_desc(A)
+    Td = Tm.data
+
+    for kk in range(KT):
+        s, e = kk * nb, (kk + 1) * nb
+        packed, v, T = hh.geqrt(X[s:, s:e])
+        X = X.at[s:, s:e].set(packed)
+        Td = Td.at[:, s:e].set(T)
+        if e < Np:
+            X = X.at[s:, e:].set(hh.apply_q(v, T, X[s:, e:], trans="C"))
+        X = pmesh.constrain2d(X)
+    return TileMatrix(X, A.desc), TileMatrix(Td, Tm.desc)
+
+
+def _qr_panels(Af: TileMatrix, Tf: TileMatrix):
+    """Yield (row_start, V, T) per panel from a geqrf result."""
+    nb = Af.desc.nb
+    out = []
+    for kk in range(Af.desc.KT):
+        s, e = kk * nb, (kk + 1) * nb
+        v, _ = hh.split_qr(Af.data[s:, s:e])
+        out.append((s, v, Tf.data[:, s:e]))
+    return out
+
+
+def unmqr(side: str, trans: str, Af: TileMatrix, Tf: TileMatrix,
+          C: TileMatrix) -> TileMatrix:
+    """C ← op(Q) C or C op(Q) (dplasma_zunmqr, zunmqr_{LN,LC,RN,RC}.jdf).
+
+    Q is the factor implicit in (Af, Tf) from :func:`geqrf`.
+    """
+    side = side.upper()
+    trans = trans.upper()
+    assert side in ("L", "R") and trans in ("N", "C", "T")
+    if trans == "T":  # real-case alias of ConjTrans
+        trans = "C"
+    panels = _qr_panels(Af, Tf)
+    # Q = Q_0 Q_1 … Q_{K-1}; applying Q left ⇒ reverse panel order,
+    # Q^H left ⇒ forward; right side mirrors.
+    forward = (side == "L") == (trans != "N")
+    if not forward:
+        panels = panels[::-1]
+    Y = C.zero_pad().data
+    for s, v, T in panels:
+        if side == "L":
+            Y = Y.at[s:, :].set(hh.apply_q(v, T, Y[s:, :], trans=trans))
+        else:
+            Y = Y.at[:, s:].set(
+                hh.apply_q_right(v, T, Y[:, s:], trans=trans))
+        Y = pmesh.constrain2d(Y)
+    return TileMatrix(Y, C.desc)
+
+
+def ungqr(Af: TileMatrix, Tf: TileMatrix, K: int | None = None) -> TileMatrix:
+    """Form the first K (default N) columns of Q explicitly
+    (dplasma_zungqr, zungqr.jdf)."""
+    M = Af.desc.M
+    K = min(M, Af.desc.N) if K is None else K
+    nb = Af.desc.nb
+    E = TileMatrix.from_dense(jnp.eye(M, K, dtype=Af.dtype), nb, nb,
+                              Af.desc.dist)
+    return unmqr("L", "N", Af, Tf, E)
+
+
+def geqrs(Af: TileMatrix, Tf: TileMatrix, B: TileMatrix) -> TileMatrix:
+    """Least-squares solve from a QR factorization (dplasma_zgeqrs):
+    X = R^{-1} (Q^H B)[:N]."""
+    N = Af.desc.N
+    nb = Af.desc.nb
+    Y = unmqr("L", "C", Af, Tf, B)
+    R = TileMatrix.from_dense(Af.to_dense()[:N, :N], nb, nb, Af.desc.dist)
+    Yt = TileMatrix.from_dense(Y.to_dense()[:N, :], nb, nb, B.desc.dist)
+    return blas3.trsm(1.0, R, Yt, side="L", uplo="U", trans="N")
+
+
+# -- LQ ----------------------------------------------------------------
+
+def gelqf(A: TileMatrix) -> tuple[TileMatrix, TileMatrix]:
+    """A = L Q (dplasma_zgelqf): the QR dual, factored as row panels.
+
+    Returns (packed factor, T factors): L on/below the diagonal, V^H
+    above it (LAPACK gelqf storage).
+    """
+    _check_square_tiles(A, "gelqf")
+    At = A.zero_pad().data.conj().T
+    desc_t = A.desc.transposed()
+    Bf, Tf = geqrf(TileMatrix(At, desc_t))
+    return TileMatrix(Bf.data.conj().T, A.desc), Tf
+
+
+def unmlq(side: str, trans: str, Af: TileMatrix, Tf: TileMatrix,
+          C: TileMatrix) -> TileMatrix:
+    """C ← op(Q) C or C op(Q) for the LQ factor (dplasma_zunmlq).
+
+    With A = L Q and A^H = Q' R (our gelqf internals), Q = Q'^H, so
+    e.g. (Q C)^H = C^H Q': conjugate-transpose C, flip the side, keep
+    trans, and conjugate-transpose back.
+    """
+    side = side.upper()
+    trans = trans.upper()
+    assert side in ("L", "R") and trans in ("N", "C", "T")
+    if trans == "T":
+        trans = "C"
+    AfT = TileMatrix(Af.data.conj().T, Af.desc.transposed())
+    CT = TileMatrix(C.zero_pad().data.conj().T, C.desc.transposed())
+    out = unmqr("R" if side == "L" else "L", trans, AfT, Tf, CT)
+    return TileMatrix(out.data.conj().T, C.desc)
+
+
+def unglq(Af: TileMatrix, Tf: TileMatrix, K: int | None = None) -> TileMatrix:
+    """Form the first K (default M) rows of Q from an LQ factorization
+    (dplasma_zunglq)."""
+    N = Af.desc.N
+    K = min(N, Af.desc.M) if K is None else K
+    nb = Af.desc.nb
+    E = TileMatrix.from_dense(jnp.eye(K, N, dtype=Af.dtype), nb, nb,
+                              Af.desc.dist)
+    return unmlq("R", "N", Af, Tf, E)
+
+
+def gelqs(Af: TileMatrix, Tf: TileMatrix, B: TileMatrix) -> TileMatrix:
+    """Minimum-norm solve from an LQ factorization (dplasma_zgelqs):
+    X = Q^H L^{-1} B."""
+    M, N = Af.desc.M, Af.desc.N
+    nb = Af.desc.nb
+    L = TileMatrix.from_dense(Af.to_dense()[:M, :M], nb, nb, Af.desc.dist)
+    Y = blas3.trsm(1.0, L, B, side="L", uplo="L", trans="N")
+    Z = TileMatrix.from_dense(
+        jnp.zeros((N, B.desc.N), B.dtype).at[:M, :].set(Y.to_dense()),
+        nb, nb, B.desc.dist)
+    return unmlq("L", "C", Af, Tf, Z)
+
+
+def gels(A: TileMatrix, B: TileMatrix) -> TileMatrix:
+    """Least-squares / minimum-norm driver (dplasma_zgels,
+    src/zgels_wrapper.c): QR path for M >= N, LQ path for M < N."""
+    if A.desc.M >= A.desc.N:
+        Af, Tf = geqrf(A)
+        return geqrs(Af, Tf, B)
+    Af, Tf = gelqf(A)
+    return gelqs(Af, Tf, B)

@@ -1,0 +1,118 @@
+"""QR/LQ flat family — the testing_zgeqrf/zgelqf/zgels equivalents
+(ref tests/testing_zgeqrf.c, testing_zgelqf.c, testing_zgels.c):
+factorize, form Q, check orthogonality and reconstruction residuals."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.ops import checks, generators, qr
+from dplasma_tpu.parallel import mesh
+
+
+def _qr_parts(Af, Tf):
+    N = min(Af.desc.M, Af.desc.N)
+    Q = qr.ungqr(Af, Tf).to_dense()
+    R = jnp.triu(Af.to_dense()[:N, :])
+    return Q, R
+
+
+@pytest.mark.parametrize("M,N,nb", [(130, 130, 32), (147, 93, 25),
+                                    (93, 147, 25), (64, 64, 64)])
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_geqrf_residual_orthogonality(M, N, nb, dtype):
+    A0 = generators.plrnt(M, N, nb, nb, seed=3872, dtype=dtype)
+    Af, Tf = jax.jit(qr.geqrf)(A0)
+    Q, R = _qr_parts(Af, Tf)
+    r, ok = checks.check_qr(A0, Q, R)
+    assert ok, f"|A-QR| residual {r}"
+    ro, oko = checks.check_orthogonality(Q)
+    assert oko, f"orthogonality residual {ro}"
+
+
+@pytest.mark.parametrize("side", ["L", "R"])
+@pytest.mark.parametrize("trans", ["N", "C"])
+def test_unmqr_matches_explicit_q(side, trans):
+    M, N, nb = 96, 64, 16
+    dtype = jnp.complex128
+    A0 = generators.plrnt(M, N, nb, nb, seed=51, dtype=dtype)
+    Af, Tf = qr.geqrf(A0)
+    Qfull = qr.ungqr(Af, Tf, K=M).to_dense()  # square M×M Q
+    q = Qfull.conj().T if trans == "C" else Qfull
+    shp = (M, 48) if side == "L" else (48, M)
+    C = generators.plrnt(*shp, nb, nb, seed=7, dtype=dtype)
+    out = qr.unmqr(side, trans, Af, Tf, C).to_dense()
+    ref = q @ C.to_dense() if side == "L" else C.to_dense() @ q
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-10)
+
+
+@pytest.mark.parametrize("M,N,nb", [(130, 130, 32), (93, 147, 25),
+                                    (147, 93, 25)])
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_gelqf_residual_orthogonality(M, N, nb, dtype):
+    A0 = generators.plrnt(M, N, nb, nb, seed=13, dtype=dtype)
+    Af, Tf = jax.jit(qr.gelqf)(A0)
+    K = min(M, N)
+    L = jnp.tril(Af.to_dense()[:, :K])
+    Qr = qr.unglq(Af, Tf).to_dense()  # K×N orthonormal rows
+    r, ok = checks.check_qr(A0, L, Qr)
+    assert ok, f"|A-LQ| residual {r}"
+    g = Qr @ Qr.conj().T
+    assert np.allclose(np.asarray(g), np.eye(K), atol=1e-10)
+
+
+def test_gels_tall_least_squares():
+    M, N, nrhs, nb = 150, 70, 9, 25
+    A0 = generators.plrnt(M, N, nb, nb, seed=3872, dtype=jnp.float64)
+    B = generators.plrnt(M, nrhs, nb, nb, seed=2354, dtype=jnp.float64)
+    X = qr.gels(A0, B)
+    ref, *_ = np.linalg.lstsq(np.asarray(A0.to_dense()),
+                              np.asarray(B.to_dense()), rcond=None)
+    assert np.allclose(np.asarray(X.to_dense()), ref, atol=1e-8)
+
+
+def test_gels_wide_minimum_norm():
+    M, N, nrhs, nb = 70, 150, 9, 25
+    A0 = generators.plrnt(M, N, nb, nb, seed=3872, dtype=jnp.float64)
+    B = generators.plrnt(M, nrhs, nb, nb, seed=2354, dtype=jnp.float64)
+    X = qr.gels(A0, B)
+    ref, *_ = np.linalg.lstsq(np.asarray(A0.to_dense()),
+                              np.asarray(B.to_dense()), rcond=None)
+    assert np.allclose(np.asarray(X.to_dense()), ref, atol=1e-8)
+
+
+def test_geqrf_on_mesh(devices8):
+    M, N, nb = 128, 64, 16
+    m = mesh.make_mesh(2, 2, devices8[:4])
+    A0 = generators.plrnt(M, N, nb, nb, seed=7, dtype=jnp.float32)
+    with mesh.use_grid(m):
+        A0s = A0.like(mesh.device_put2d(A0.data))
+        Af, Tf = jax.jit(qr.geqrf)(A0s)
+    Q, R = _qr_parts(Af, Tf)
+    r, ok = checks.check_qr(A0, Q, R)
+    assert ok, f"residual {r}"
+
+
+def test_stacked_qr_ts_tt_kernels():
+    """TS/TT coupling kernel: QR of [R_top; tile] reconstructs the stack
+    and the applier reproduces Q^H on a coupled pair (CORE_ztsqrt/ztsmqr
+    semantics)."""
+    from dplasma_tpu.kernels import householder as hh
+    rng = np.random.default_rng(3872)
+    n = 24
+    top = jnp.triu(jnp.asarray(rng.normal(size=(n, n))))
+    bot = jnp.asarray(rng.normal(size=(n, n)))
+    r, v, t = hh.stacked_qr(top, bot)
+    # reconstruction: Q [r; 0] == [top; bot], with Q = I - V T V^H
+    stack = jnp.concatenate([top, bot], axis=0)
+    rz = jnp.concatenate([r, jnp.zeros((n, n))], axis=0)
+    rec = hh.apply_q(v, t, rz, trans="N")
+    assert np.allclose(np.asarray(rec), np.asarray(stack), atol=1e-12)
+    # applier: stacked_apply == apply_q on the concatenation
+    c1 = jnp.asarray(rng.normal(size=(n, 8)))
+    c2 = jnp.asarray(rng.normal(size=(n, 8)))
+    o1, o2 = hh.stacked_apply(v, t, c1, c2, trans="C")
+    ref = hh.apply_q(v, t, jnp.concatenate([c1, c2], axis=0), trans="C")
+    assert np.allclose(np.asarray(jnp.concatenate([o1, o2], axis=0)),
+                       np.asarray(ref), atol=1e-12)
