@@ -1,5 +1,5 @@
-from dplasma_tpu.ops import (aux, blas3, checks, generators, info,
+from dplasma_tpu.ops import (aux, blas3, checks, generators, hqr, info,
                              map as map_ops, norms, potrf, qr)
 
-__all__ = ["aux", "blas3", "checks", "generators", "info", "map_ops",
-           "norms", "potrf", "qr"]
+__all__ = ["aux", "blas3", "checks", "generators", "hqr", "info",
+           "map_ops", "norms", "potrf", "qr"]

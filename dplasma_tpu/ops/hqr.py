@@ -1,0 +1,506 @@
+"""Hierarchical QR (HQR): reduction-tree-parameterized tile QR/LQ.
+
+Reference surface: ``dplasma_zgeqrf_param`` and friends, parameterized
+by a ``dplasma_qrtree_t`` vtable (getnbgeqrf/getm/geti/gettype/
+currpiv/nextpiv/prevpiv — ref src/include/dplasma/qr_param.h:36-118)
+whose generators live in src/dplasma_hqr.c (2728 LoC): low-level trees
+FLAT/GREEDY/FIBONACCI/BINARY/GREEDY1P within each of ``p`` distribution
+domains, TS-domain size ``a``, a high-level FLAT/GREEDY tree across
+domains, plus domino coupling and TS round-robin; systolic
+(dplasma_systolic_init) and svd-ratio (dplasma_svd_init) variants.
+
+TPU-native design: a tree is **pure trace-time index algebra** (the
+reference's key property — tree functions are evaluated identically on
+every rank, SURVEY §3.3). Here it materializes an *elimination
+schedule*: per panel k, rounds of disjoint (pivot, victim, kind)
+triples. The factorization replays the schedule with the generic
+stacked-couple kernel (kernels/householder.stacked_qr); each
+elimination updates the ENTIRE trailing row-slab of both rows in one
+MXU op, so the trace is O(KT · MT) large ops. Round structure is
+metadata: XLA's dataflow scheduling extracts the same parallelism the
+rounds describe (and the reference's domino pipelining falls out of
+tile-level dependences — independent panels overlap automatically).
+
+Storage (mirrors the reference's TS/TT split): the factored matrix
+holds R in the panel triangle, GEQRT V's below leaders' diagonals, TS
+victims' dense V2 in their tile, TT victims' triangular V2 in their
+upper triangle; T factors live in two A-shaped tile matrices (Tts for
+GEQRT/TS kills, Ttt for TT kills) — the analogs of the reference's TS
+and TT descriptors (tests/testing_zgeqrf_hqr.c).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Literal
+
+import jax.numpy as jnp
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.kernels import householder as hh
+from dplasma_tpu.parallel import mesh as pmesh
+
+LowTree = Literal["flat", "greedy", "fibonacci", "binary", "greedy1p"]
+HighTree = Literal["flat", "greedy"]
+
+TS = 0   # victim eliminated by a TS kernel (dense square tile)
+TT = 1   # victim eliminated by a TT kernel (triangularized tile)
+
+
+@dataclasses.dataclass(frozen=True)
+class Elim:
+    """One elimination: ``piv`` absorbs ``victim`` (kind TS or TT)."""
+    piv: int
+    victim: int
+    kind: int
+    round: int
+
+
+def _fib_counts():
+    """Fibonacci round sizes 1, 1, 2, 3, 5, … (callers cap by the
+    live-set size)."""
+    a, b = 1, 1
+    while True:
+        yield a
+        a, b = b, a + b
+
+
+def _reduce_rounds(rows: list[int], kind: str, base_round: int,
+                   elim_kind: int) -> tuple[list[Elim], int]:
+    """Reduce ``rows`` (ascending) to its head with the named tree.
+
+    Returns (eliminations, next free round index). Every tree keeps the
+    smallest row as the survivor, pairing pivots strictly above their
+    victims — the invariant the pivgen checker enforces.
+    """
+    elims: list[Elim] = []
+    live = list(rows)
+    r = base_round
+    if len(live) <= 1:
+        return elims, r
+    if kind == "flat":
+        head = live[0]
+        for v in live[1:]:
+            elims.append(Elim(head, v, elim_kind, r))
+            r += 1
+        return elims, r
+    if kind == "binary":
+        # standard distance-doubling reduction on the ascending list
+        alive = list(live)
+        while len(alive) > 1:
+            nxt = []
+            for i in range(0, len(alive), 2):
+                if i + 1 < len(alive):
+                    elims.append(Elim(alive[i], alive[i + 1], elim_kind, r))
+                nxt.append(alive[i])
+            alive = nxt
+            r += 1
+        return elims, r
+    if kind in ("greedy", "greedy1p"):
+        # greedy1p is the reference's greedy tree specialized for p==1
+        # grids (dplasma_hqr.c GREEDY1P); the reduction shape is the
+        # same — kept as an accepted alias for interface parity.
+        alive = list(live)
+        while len(alive) > 1:
+            c = len(alive) // 2
+            keep = len(alive) - c
+            for i in range(c):
+                elims.append(Elim(alive[keep - c + i], alive[keep + i],
+                                  elim_kind, r))
+            alive = alive[:keep]
+            r += 1
+        return elims, r
+    if kind == "fibonacci":
+        alive = list(live)
+        fib = _fib_counts()
+        while len(alive) > 1:
+            c = min(next(fib), len(alive) // 2 or 1, len(alive) - 1)
+            keep = len(alive) - c
+            for i in range(c):
+                elims.append(Elim(alive[keep - c + i], alive[keep + i],
+                                  elim_kind, r))
+            alive = alive[:keep]
+            r += 1
+        return elims, r
+    raise ValueError(f"unknown tree kind {kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class QRTree:
+    """The qrtree vtable (dplasma_qrtree_t analog) for an MT-row matrix.
+
+    Construction parameters mirror dplasma_hqr_init
+    (src/include/dplasma/qr_param.h:129-133): low-level tree ``llvl``
+    within each of ``p`` domains, TS-domain size ``a``, high-level tree
+    ``hlvl`` across domains. ``domino``/``tsrr`` are accepted for
+    interface parity: domino's pipeline coupling is subsumed by XLA's
+    tile-level dataflow scheduling, and tsrr only permutes the order of
+    already-parallel TS kills.
+    """
+
+    MT: int
+    a: int = 1
+    p: int = 1
+    llvl: LowTree = "flat"
+    hlvl: HighTree = "flat"
+    domino: bool = False
+    tsrr: bool = False
+
+    def __post_init__(self):
+        assert self.MT >= 1 and self.a >= 1 and self.p >= 1
+
+    # -- schedule -----------------------------------------------------
+    @functools.lru_cache(maxsize=None)
+    def schedule(self, k: int) -> list[Elim]:
+        """Elimination schedule for panel k over rows [k, MT)."""
+        rows = list(range(k, self.MT))
+        # domains by block-cyclic row owner (m % p), matching the
+        # reference's distribution-aligned domains
+        elims: list[Elim] = []
+        domain_heads = []
+        r_after_ts = 0
+        per_domain = []
+        for d in range(self.p):
+            dom = [m for m in rows if m % self.p == (k + d) % self.p]
+            if not dom:
+                continue
+            # TS groups of size a; group leaders
+            leaders = []
+            for g0 in range(0, len(dom), self.a):
+                group = dom[g0:g0 + self.a]
+                leaders.append(group[0])
+                for j, v in enumerate(group[1:]):
+                    elims.append(Elim(group[0], v, TS, 1 + j))
+                    r_after_ts = max(r_after_ts, 2 + j)
+            per_domain.append(leaders)
+            domain_heads.append(leaders[0])
+        # low-level tree per domain (parallel across domains)
+        r_low = r_after_ts or 1
+        r_max = r_low
+        for leaders in per_domain:
+            e, r_end = _reduce_rounds(leaders, self.llvl, r_low, TT)
+            elims.extend(e)
+            r_max = max(r_max, r_end)
+        # high-level tree across domain heads; row k is the global head
+        e, _ = _reduce_rounds(sorted(domain_heads), self.hlvl, r_max, TT)
+        elims.extend(e)
+        return sorted(elims, key=lambda x: x.round)
+
+    # -- vtable (dplasma_qrtree_t semantics) --------------------------
+    def _kills(self, k: int) -> dict[int, Elim]:
+        return {e.victim: e for e in self.schedule(k)}
+
+    @functools.lru_cache(maxsize=None)
+    def leaders(self, k: int) -> list[int]:
+        """Rows that run GEQRT in panel k (type != TS in the reference)."""
+        kills = self._kills(k)
+        return [m for m in range(k, self.MT)
+                if m not in kills or kills[m].kind == TT]
+
+    def getnbgeqrf(self, k: int) -> int:
+        return len(self.leaders(k))
+
+    def getm(self, k: int, i: int) -> int:
+        return self.leaders(k)[i]
+
+    def geti(self, k: int, m: int) -> int:
+        return self.leaders(k).index(m)
+
+    def gettype(self, k: int, m: int) -> int:
+        kills = self._kills(k)
+        if m in kills and kills[m].kind == TS:
+            return 0
+        return 1
+
+    def currpiv(self, k: int, m: int) -> int:
+        """The row that eliminates m in panel k."""
+        return self._kills(k)[m].piv
+
+    def _victims_of(self, k: int, piv: int) -> list[int]:
+        return [e.victim for e in self.schedule(k) if e.piv == piv]
+
+    def nextpiv(self, k: int, piv: int, m: int) -> int:
+        """Next row killed by ``piv`` after m (m == MT → first);
+        returns MT when exhausted (reference sentinel semantics)."""
+        vs = self._victims_of(k, piv)
+        if m == self.MT:
+            return vs[0] if vs else self.MT
+        i = vs.index(m)
+        return vs[i + 1] if i + 1 < len(vs) else self.MT
+
+    def prevpiv(self, k: int, piv: int, m: int) -> int:
+        """Row killed by ``piv`` before m (m == MT → last)."""
+        vs = self._victims_of(k, piv)
+        if m == self.MT:
+            return vs[-1] if vs else self.MT
+        i = vs.index(m)
+        return vs[i - 1] if i - 1 >= 0 else self.MT
+
+
+def hqr_tree(MT: int, llvl: LowTree = "greedy", hlvl: HighTree = "flat",
+             a: int = 4, p: int = 1, domino: bool = False,
+             tsrr: bool = False) -> QRTree:
+    """dplasma_hqr_init analog."""
+    return QRTree(MT=MT, a=a, p=p, llvl=llvl, hlvl=hlvl, domino=domino,
+                  tsrr=tsrr)
+
+
+def systolic_tree(MT: int, p: int = 1, q: int = 1) -> QRTree:
+    """dplasma_systolic_init analog: flat TS chains of depth q within p
+    domains, flat coupling (src/dplasma_systolic_qr.c semantics)."""
+    return QRTree(MT=MT, a=max(q, 1), p=max(p, 1), llvl="flat",
+                  hlvl="flat")
+
+
+def svd_tree(MT: int, p: int = 1, ratio: int = 2) -> QRTree:
+    """dplasma_svd_init analog: greedy trees with TS-domain size set by
+    the perf ratio between TS and TT kernels (qr_param.h:125-127)."""
+    return QRTree(MT=MT, a=max(ratio, 1), p=max(p, 1), llvl="greedy",
+                  hlvl="greedy")
+
+
+# -- combinatorial pivgen checker (dplasma_qrtree_check analog) --------
+
+def check_tree(tree: QRTree) -> None:
+    """Validate the reduction-tree invariants for every panel
+    (ref dplasma_qrtree_check, qr_param.h:138, dplasma_hqr_dbg.c):
+    every non-head row killed exactly once by a live pivot above it;
+    TS victims are never leaders; vtable functions consistent with the
+    schedule. Raises AssertionError on violation."""
+    MT = tree.MT
+    for k in range(MT):
+        sched = tree.schedule(k)
+        victims = [e.victim for e in sched]
+        assert sorted(victims) == list(range(k + 1, MT)), (
+            f"panel {k}: victims {sorted(victims)}")
+        assert k not in victims, f"panel {k}: head row killed"
+        dead: set[int] = set()
+        pos = {}
+        for idx, e in enumerate(sched):
+            assert e.piv < e.victim, f"panel {k}: pivot below victim {e}"
+            assert e.piv >= k and e.victim < MT, f"panel {k}: range {e}"
+            assert e.piv not in dead, f"panel {k}: dead pivot {e}"
+            dead.add(e.victim)
+            pos[e.victim] = idx
+        # rounds are consistent: an elimination's pivot must not be
+        # killed in an earlier-or-equal round
+        kills = {e.victim: e for e in sched}
+        for e in sched:
+            if e.piv in kills:
+                assert kills[e.piv].round > e.round, (
+                    f"panel {k}: pivot {e.piv} killed in round "
+                    f"{kills[e.piv].round} but used in round {e.round}")
+        # TS victims must not be leaders; leaders bijection
+        leaders = tree.leaders(k)
+        for e in sched:
+            if e.kind == TS:
+                assert e.victim not in leaders
+            else:
+                assert e.victim in leaders
+        for i, m in enumerate(leaders):
+            assert tree.getm(k, i) == m and tree.geti(k, m) == i
+        # currpiv/nextpiv/prevpiv walk the schedule
+        for e in sched:
+            assert tree.currpiv(k, e.victim) == e.piv
+        for piv in {e.piv for e in sched}:
+            vs = [e.victim for e in sched if e.piv == piv]
+            walk, m = [], MT
+            while True:
+                m = tree.nextpiv(k, piv, m)
+                if m == MT:
+                    break
+                walk.append(m)
+            assert walk == vs, f"panel {k}: nextpiv walk {walk} != {vs}"
+            walk, m = [], MT
+            while True:
+                m = tree.prevpiv(k, piv, m)
+                if m == MT:
+                    break
+                walk.append(m)
+            assert walk == vs[::-1], f"panel {k}: prevpiv walk"
+
+
+# -- factorization -----------------------------------------------------
+
+def geqrf_param(tree: QRTree, A: TileMatrix):
+    """Tree-parameterized tile QR (dplasma_zgeqrf_param).
+
+    Returns (factored TileMatrix, Tts, Ttt) — see module docstring for
+    the storage contract.
+    """
+    assert A.desc.mb == A.desc.nb, "geqrf_param needs square tiles"
+    nb = A.desc.nb
+    MT, NT, KT = A.desc.MT, A.desc.NT, A.desc.KT
+    assert tree.MT == MT, f"tree built for MT={tree.MT}, matrix has {MT}"
+    X = A.zero_pad().data
+    Np = A.desc.Np
+    Tts = jnp.zeros_like(X)
+    Ttt = jnp.zeros_like(X)
+
+    def rows(m):
+        return slice(m * nb, (m + 1) * nb)
+
+    for k in range(KT):
+        s, e = k * nb, (k + 1) * nb
+        sched = tree.schedule(k)
+        # 1) GEQRT every leader tile
+        for m in tree.leaders(k):
+            packed, v, T = hh.geqrt(X[rows(m), s:e])
+            X = X.at[rows(m), s:e].set(packed)
+            Tts = Tts.at[rows(m), s:e].set(T)
+            if e < Np:
+                X = X.at[rows(m), e:].set(
+                    hh.apply_q(v, T, X[rows(m), e:], trans="C"))
+        # 2) replay eliminations in schedule order
+        for el in sched:
+            rp, rv = rows(el.piv), rows(el.victim)
+            r_top = jnp.triu(X[rp, s:e])
+            if el.kind == TS:
+                bot = X[rv, s:e]
+            else:
+                bot = jnp.triu(X[rv, s:e])
+            r_new, v, T = hh.stacked_qr(r_top, bot)
+            v2 = v[nb:, :]
+            # the pivot tile keeps its GEQRT V below the diagonal; only
+            # its R triangle is replaced by the couple's new R
+            X = X.at[rp, s:e].set(jnp.tril(X[rp, s:e], -1) + r_new)
+            if el.kind == TS:
+                X = X.at[rv, s:e].set(v2)
+                Tts = Tts.at[rv, s:e].set(T)
+            else:
+                # keep the victim's GEQRT V below the diagonal; V2 of a
+                # TT couple is upper triangular (UPPER_TILE remote type,
+                # zgeqrf_param.jdf:80-85)
+                keep = jnp.tril(X[rv, s:e], -1)
+                X = X.at[rv, s:e].set(keep + jnp.triu(v2))
+                Ttt = Ttt.at[rv, s:e].set(T)
+            if e < Np:
+                ct, cb = hh.stacked_apply(v, T, X[rp, e:], X[rv, e:],
+                                          trans="C")
+                X = X.at[rp, e:].set(ct)
+                X = X.at[rv, e:].set(cb)
+        X = pmesh.constrain2d(X)
+    return (TileMatrix(X, A.desc),
+            TileMatrix(Tts, A.desc), TileMatrix(Ttt, A.desc))
+
+
+def _panel_ops(tree: QRTree, Af: TileMatrix, Tts: TileMatrix,
+               Ttt: TileMatrix, k: int):
+    """Reconstruct panel k's reflector sequence [(kind, args…)] in
+    factorization order from the stored pieces."""
+    nb = Af.desc.nb
+    s, e = k * nb, (k + 1) * nb
+
+    def rows(m):
+        return slice(m * nb, (m + 1) * nb)
+
+    ops = []
+    for m in tree.leaders(k):
+        v, _ = hh.split_qr(Af.data[rows(m), s:e])
+        ops.append(("geqrt", m, v, Tts.data[rows(m), s:e]))
+    for el in tree.schedule(k):
+        if el.kind == TS:
+            v2 = Af.data[rows(el.victim), s:e]
+            T = Tts.data[rows(el.victim), s:e]
+        else:
+            v2 = jnp.triu(Af.data[rows(el.victim), s:e])
+            T = Ttt.data[rows(el.victim), s:e]
+        v = jnp.concatenate([jnp.eye(nb, dtype=v2.dtype), v2], axis=0)
+        ops.append(("couple", el.piv, el.victim, v, T))
+    return ops
+
+
+def unmqr_param(tree: QRTree, side: str, trans: str, Af: TileMatrix,
+                Tts: TileMatrix, Ttt: TileMatrix,
+                C: TileMatrix) -> TileMatrix:
+    """Apply op(Q) from a geqrf_param factorization
+    (dplasma_zunmqr_param, 4 side×trans JDFs). Left side only applies
+    panels over matching row tiles; right side via the transpose dual."""
+    side = side.upper()
+    trans = trans.upper()
+    assert side in ("L", "R") and trans in ("N", "C", "T")
+    if trans == "T":
+        trans = "C"
+    if side == "R":
+        # C op(Q) = (op(Q)^H C^H)^H
+        CT = TileMatrix(C.zero_pad().data.conj().T, C.desc.transposed())
+        flip = "C" if trans == "N" else "N"
+        out = unmqr_param(tree, "L", flip, Af, Tts, Ttt, CT)
+        return TileMatrix(out.data.conj().T, C.desc)
+
+    nb = Af.desc.nb
+    KT = Af.desc.KT
+    Y = C.zero_pad().data
+
+    def rows(m):
+        return slice(m * nb, (m + 1) * nb)
+
+    panel_range = range(KT) if trans == "C" else range(KT - 1, -1, -1)
+    for k in panel_range:
+        ops = _panel_ops(tree, Af, Tts, Ttt, k)
+        if trans == "N":
+            ops = ops[::-1]
+        for op in ops:
+            if op[0] == "geqrt":
+                _, m, v, T = op
+                Y = Y.at[rows(m), :].set(
+                    hh.apply_q(v, T, Y[rows(m), :], trans=trans))
+            else:
+                _, piv, victim, v, T = op
+                ct, cb = hh.stacked_apply(v, T, Y[rows(piv), :],
+                                          Y[rows(victim), :], trans=trans)
+                Y = Y.at[rows(piv), :].set(ct)
+                Y = Y.at[rows(victim), :].set(cb)
+        Y = pmesh.constrain2d(Y)
+    return TileMatrix(Y, C.desc)
+
+
+def ungqr_param(tree: QRTree, Af: TileMatrix, Tts: TileMatrix,
+                Ttt: TileMatrix, K: int | None = None) -> TileMatrix:
+    """Form Q explicitly from a geqrf_param factorization
+    (dplasma_zungqr_param)."""
+    M = Af.desc.M
+    K = min(M, Af.desc.N) if K is None else K
+    nb = Af.desc.nb
+    E = TileMatrix.from_dense(jnp.eye(M, K, dtype=Af.dtype), nb, nb,
+                              Af.desc.dist)
+    return unmqr_param(tree, "L", "N", Af, Tts, Ttt, E)
+
+
+# -- LQ duals ----------------------------------------------------------
+
+def gelqf_param(tree: QRTree, A: TileMatrix):
+    """Tree-parameterized LQ (dplasma_zgelqf_param): QR dual of A^H."""
+    assert A.desc.mb == A.desc.nb
+    At = TileMatrix(A.zero_pad().data.conj().T, A.desc.transposed())
+    Bf, Tts, Ttt = geqrf_param(tree, At)
+    return (TileMatrix(Bf.data.conj().T, A.desc),
+            TileMatrix(Tts.data, Bf.desc), TileMatrix(Ttt.data, Bf.desc))
+
+
+def unmlq_param(tree: QRTree, side: str, trans: str, Af: TileMatrix,
+                Tts: TileMatrix, Ttt: TileMatrix,
+                C: TileMatrix) -> TileMatrix:
+    """Apply op(Q) of a gelqf_param factorization (dplasma_zunmlq_param):
+    conjugate-transpose C, flip the side, keep trans (see ops.qr.unmlq)."""
+    side = side.upper()
+    trans = trans.upper()
+    assert side in ("L", "R") and trans in ("N", "C", "T")
+    if trans == "T":
+        trans = "C"
+    AfT = TileMatrix(Af.data.conj().T, Af.desc.transposed())
+    CT = TileMatrix(C.zero_pad().data.conj().T, C.desc.transposed())
+    out = unmqr_param(tree, "R" if side == "L" else "L", trans,
+                      AfT, Tts, Ttt, CT)
+    return TileMatrix(out.data.conj().T, C.desc)
+
+
+def unglq_param(tree: QRTree, Af: TileMatrix, Tts: TileMatrix,
+                Ttt: TileMatrix, K: int | None = None) -> TileMatrix:
+    """Form Q rows from a gelqf_param factorization (dplasma_zunglq_param)."""
+    N = Af.desc.N
+    K = min(N, Af.desc.M) if K is None else K
+    nb = Af.desc.nb
+    E = TileMatrix.from_dense(jnp.eye(K, N, dtype=Af.dtype), nb, nb,
+                              Af.desc.dist)
+    return unmlq_param(tree, "R", "N", Af, Tts, Ttt, E)

@@ -1,0 +1,98 @@
+"""Hierarchical QR — tree generators (pivgen combinatorial checks, ref
+tests/TestsQRPivgen.cmake / dplasma_qrtree_check) and the parameterized
+factorization (testing_zgeqrf_hqr equivalents)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.ops import checks, generators, hqr
+from dplasma_tpu.parallel import mesh
+
+
+@pytest.mark.parametrize("llvl", ["flat", "greedy", "fibonacci", "binary",
+                                  "greedy1p"])
+@pytest.mark.parametrize("hlvl", ["flat", "greedy"])
+@pytest.mark.parametrize("a,p", [(1, 1), (2, 1), (3, 2), (1, 3), (4, 4)])
+@pytest.mark.parametrize("MT", [1, 2, 5, 8, 13])
+def test_pivgen(llvl, hlvl, a, p, MT):
+    tree = hqr.hqr_tree(MT, llvl=llvl, hlvl=hlvl, a=a, p=p)
+    hqr.check_tree(tree)
+
+
+@pytest.mark.parametrize("MT,p,q", [(7, 2, 3), (9, 3, 1), (5, 1, 2)])
+def test_pivgen_systolic(MT, p, q):
+    hqr.check_tree(hqr.systolic_tree(MT, p, q))
+
+
+@pytest.mark.parametrize("MT,p,ratio", [(7, 2, 2), (11, 3, 4)])
+def test_pivgen_svd(MT, p, ratio):
+    hqr.check_tree(hqr.svd_tree(MT, p, ratio))
+
+
+TREES = [
+    dict(llvl="flat", hlvl="flat", a=1, p=1),
+    dict(llvl="greedy", hlvl="flat", a=2, p=2),
+    dict(llvl="binary", hlvl="greedy", a=1, p=3),
+    dict(llvl="fibonacci", hlvl="greedy", a=3, p=2),
+]
+
+
+@pytest.mark.parametrize("cfg", TREES)
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_geqrf_param_residual(cfg, dtype):
+    M, N, nb = 112, 80, 16  # MT=7, NT=5
+    A0 = generators.plrnt(M, N, nb, nb, seed=3872, dtype=dtype)
+    tree = hqr.hqr_tree(A0.desc.MT, **cfg)
+    Af, Tts, Ttt = jax.jit(hqr.geqrf_param, static_argnums=0)(tree, A0)
+    Q = hqr.ungqr_param(tree, Af, Tts, Ttt).to_dense()
+    R = jnp.triu(Af.to_dense()[:N, :])
+    r, ok = checks.check_qr(A0, Q, R)
+    assert ok, f"|A-QR| residual {r}"
+    ro, oko = checks.check_orthogonality(Q)
+    assert oko, f"orthogonality {ro}"
+
+
+@pytest.mark.parametrize("side,trans", [("L", "N"), ("L", "C"),
+                                        ("R", "N"), ("R", "C")])
+def test_unmqr_param_matches_explicit_q(side, trans):
+    M, N, nb = 80, 48, 16
+    dtype = jnp.complex128
+    A0 = generators.plrnt(M, N, nb, nb, seed=51, dtype=dtype)
+    tree = hqr.hqr_tree(A0.desc.MT, llvl="greedy", a=2, p=2)
+    Af, Tts, Ttt = hqr.geqrf_param(tree, A0)
+    Qfull = hqr.ungqr_param(tree, Af, Tts, Ttt, K=M).to_dense()
+    q = Qfull.conj().T if trans == "C" else Qfull
+    shp = (M, 32) if side == "L" else (32, M)
+    C = generators.plrnt(*shp, nb, nb, seed=7, dtype=dtype)
+    out = hqr.unmqr_param(tree, side, trans, Af, Tts, Ttt, C).to_dense()
+    ref = q @ C.to_dense() if side == "L" else C.to_dense() @ q
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-10)
+
+
+def test_gelqf_param_residual():
+    M, N, nb = 64, 112, 16
+    A0 = generators.plrnt(M, N, nb, nb, seed=13, dtype=jnp.float64)
+    tree = hqr.hqr_tree(A0.desc.NT, llvl="greedy", a=2, p=2)
+    Af, Tts, Ttt = hqr.gelqf_param(tree, A0)
+    K = min(M, N)
+    L = jnp.tril(Af.to_dense()[:, :K])
+    Qr = hqr.unglq_param(tree, Af, Tts, Ttt).to_dense()
+    r, ok = checks.check_qr(A0, L, Qr)
+    assert ok, f"|A-LQ| residual {r}"
+    assert np.allclose(np.asarray(Qr @ Qr.conj().T), np.eye(K), atol=1e-10)
+
+
+def test_geqrf_param_on_mesh(devices8):
+    M, N, nb = 128, 64, 16
+    m = mesh.make_mesh(2, 4, devices8)
+    A0 = generators.plrnt(M, N, nb, nb, seed=7, dtype=jnp.float32)
+    tree = hqr.hqr_tree(A0.desc.MT, llvl="greedy", hlvl="greedy", a=2, p=2)
+    with mesh.use_grid(m):
+        A0s = A0.like(mesh.device_put2d(A0.data))
+        Af, Tts, Ttt = jax.jit(hqr.geqrf_param, static_argnums=0)(tree, A0s)
+    Q = hqr.ungqr_param(tree, Af, Tts, Ttt).to_dense()
+    R = jnp.triu(Af.to_dense()[:N, :])
+    r, ok = checks.check_qr(A0, Q, R)
+    assert ok, f"residual {r}"
