@@ -31,7 +31,7 @@ and TT descriptors (tests/testing_zgeqrf_hqr.c).
 from __future__ import annotations
 
 import dataclasses
-import functools
+
 from typing import Literal
 
 import jax.numpy as jnp
@@ -148,11 +148,17 @@ class QRTree:
 
     def __post_init__(self):
         assert self.MT >= 1 and self.a >= 1 and self.p >= 1
+        # per-instance memo (a module-level lru_cache would pin every
+        # tree ever built for process lifetime)
+        object.__setattr__(self, "_sched_cache", {})
+        object.__setattr__(self, "_leader_cache", {})
 
     # -- schedule -----------------------------------------------------
-    @functools.lru_cache(maxsize=None)
     def schedule(self, k: int) -> list[Elim]:
         """Elimination schedule for panel k over rows [k, MT)."""
+        hit = self._sched_cache.get(k)
+        if hit is not None:
+            return hit
         rows = list(range(k, self.MT))
         # domains by block-cyclic row owner (m % p), matching the
         # reference's distribution-aligned domains
@@ -184,18 +190,24 @@ class QRTree:
         # high-level tree across domain heads; row k is the global head
         e, _ = _reduce_rounds(sorted(domain_heads), self.hlvl, r_max, TT)
         elims.extend(e)
-        return sorted(elims, key=lambda x: x.round)
+        out = sorted(elims, key=lambda x: x.round)
+        self._sched_cache[k] = out
+        return out
 
     # -- vtable (dplasma_qrtree_t semantics) --------------------------
     def _kills(self, k: int) -> dict[int, Elim]:
         return {e.victim: e for e in self.schedule(k)}
 
-    @functools.lru_cache(maxsize=None)
     def leaders(self, k: int) -> list[int]:
         """Rows that run GEQRT in panel k (type != TS in the reference)."""
+        hit = self._leader_cache.get(k)
+        if hit is not None:
+            return hit
         kills = self._kills(k)
-        return [m for m in range(k, self.MT)
-                if m not in kills or kills[m].kind == TT]
+        out = [m for m in range(k, self.MT)
+               if m not in kills or kills[m].kind == TT]
+        self._leader_cache[k] = out
+        return out
 
     def getnbgeqrf(self, k: int) -> int:
         return len(self.leaders(k))
@@ -275,13 +287,11 @@ def check_tree(tree: QRTree) -> None:
             f"panel {k}: victims {sorted(victims)}")
         assert k not in victims, f"panel {k}: head row killed"
         dead: set[int] = set()
-        pos = {}
-        for idx, e in enumerate(sched):
+        for e in sched:
             assert e.piv < e.victim, f"panel {k}: pivot below victim {e}"
             assert e.piv >= k and e.victim < MT, f"panel {k}: range {e}"
             assert e.piv not in dead, f"panel {k}: dead pivot {e}"
             dead.add(e.victim)
-            pos[e.victim] = idx
         # rounds are consistent: an elimination's pivot must not be
         # killed in an earlier-or-equal round
         kills = {e.victim: e for e in sched}
@@ -330,7 +340,7 @@ def geqrf_param(tree: QRTree, A: TileMatrix):
     """
     assert A.desc.mb == A.desc.nb, "geqrf_param needs square tiles"
     nb = A.desc.nb
-    MT, NT, KT = A.desc.MT, A.desc.NT, A.desc.KT
+    MT, KT = A.desc.MT, A.desc.KT
     assert tree.MT == MT, f"tree built for MT={tree.MT}, matrix has {MT}"
     X = A.zero_pad().data
     Np = A.desc.Np

@@ -1,0 +1,432 @@
+"""LU factorization family — the reference's five variants plus solvers.
+
+Reference surface (SURVEY §2.2 "LU variants (5)"):
+- ``dplasma_zgetrf_nopiv``  (zgetrf_nopiv.jdf) — no pivoting;
+- ``dplasma_zgetrf_1d``     (zgetrf_1d.jdf + wrapper) — partial
+  pivoting over the whole column, 1-D panel distribution, IPIV as a
+  tiled vector (zgetrf_1d_wrapper.c:55-97), pivots applied by
+  ``dplasma_zlaswp`` (zlaswp.jdf);
+- ``dplasma_zgetrf_incpiv`` (zgetrf_incpiv.jdf + ztrsmpl_incpiv.jdf)
+  — tile-incremental pivoting: couples [U_kk; A_mk] factored with
+  pivoting confined to the couple;
+- ``dplasma_zgetrf_ptgpanel`` (zgetrf_ptgpanel.jdf, 1076 lines) —
+  distributed parallel panel with partial pivoting;
+- ``dplasma_zgetrf_qrf``    (zgetrf_qrf.jdf, 1368 lines) — hybrid
+  LU/QR: per-panel choice between an unpivoted LU panel and a QR
+  panel by numerical criteria (Higham sum/max/moy, MUMPS, random,
+  alternating — zgetrf_qrf_wrapper.c:115-201), recorded in ``lu_tab``.
+
+TPU-native design:
+- the multithreaded recursive CPU panel (CORE_zgetrf_rectil) becomes
+  one ``lax.linalg.lu`` on the whole (Mp-s)×nb panel — XLA's blocked
+  LU is the MXU-friendly panel kernel, and under a mesh GSPMD
+  distributes it (which is exactly what ptgpanel hand-built over MPI);
+- pivoting is kept as a *global row permutation vector* (semantics
+  ``A[perm] = L U``) instead of LAPACK swap-format IPIV: on TPU a
+  permutation is one gather, while sequential swaps serialize;
+  :func:`laswp` applies it, :func:`perm_to_ipiv`/:func:`ipiv_to_perm`
+  convert to/from the reference's format;
+- the qrf hybrid's data-dependent panel choice is a branchless
+  ``lax.cond`` over both panel kernels (both traced once), per
+  SURVEY §7 "hard parts" #3; data-independent criteria (random,
+  alternating) resolve at trace time instead.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.kernels import blas as k
+from dplasma_tpu.kernels import householder as hh
+from dplasma_tpu.ops import blas3
+from dplasma_tpu.parallel import mesh as pmesh
+
+
+# -- pivot bookkeeping -------------------------------------------------
+
+def perm_to_ipiv(perm):
+    """Convert a permutation vector (A[perm] = LU) to LAPACK-style
+    sequential swap indices (0-based): swapping rows i and ipiv[i] for
+    i = 0..n-1 reproduces the permutation."""
+    import numpy as np
+    target = np.asarray(perm)
+    n = target.shape[0]
+    cur = np.arange(n)            # cur[i] = original row now at slot i
+    where = np.arange(n)          # where[r] = slot currently holding r
+    ipiv = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        j = int(where[target[i]])
+        ipiv[i] = j
+        ri, rj = cur[i], cur[j]
+        cur[i], cur[j] = rj, ri
+        where[ri], where[rj] = j, i
+    return jnp.asarray(ipiv)
+
+
+def ipiv_to_perm(ipiv):
+    """Inverse of :func:`perm_to_ipiv`."""
+    import numpy as np
+    iv = np.asarray(ipiv)
+    n = iv.shape[0]
+    perm = np.arange(n)
+    for i in range(n):
+        j = int(iv[i])
+        if j != i:
+            perm[i], perm[j] = perm[j], perm[i]
+    return jnp.asarray(perm)
+
+
+def laswp(A: TileMatrix, perm, inverse: bool = False) -> TileMatrix:
+    """Apply a global row permutation (dplasma_zlaswp analog): one
+    gather instead of the reference's sequential row swaps."""
+    if inverse:
+        inv = jnp.zeros_like(perm).at[perm].set(
+            jnp.arange(perm.shape[0], dtype=perm.dtype))
+        perm = inv
+    return A.like(A.data[perm, :])
+
+
+# -- no-pivoting LU ----------------------------------------------------
+
+def getrf_nopiv(A: TileMatrix) -> TileMatrix:
+    """Blocked right-looking LU without pivoting
+    (dplasma_zgetrf_nopiv). Returns packed L\\U (unit L implicit)."""
+    assert A.desc.mb == A.desc.nb, "getrf needs square tiles"
+    nb = A.desc.nb
+    KT = A.desc.KT
+    X = A.pad_diag().data
+    Np = A.desc.Np
+    for kk in range(KT):
+        s, e = kk * nb, (kk + 1) * nb
+        d = k.getrf_nopiv(X[s:e, s:e])
+        X = X.at[s:e, s:e].set(d)
+        if e < Np:
+            u12 = k.trsm(d, X[s:e, e:], side="L", lower=True, unit=True)
+            X = X.at[s:e, e:].set(u12)
+        if e < X.shape[0]:
+            l21 = k.trsm(d, X[e:, s:e], side="R", lower=False)
+            X = X.at[e:, s:e].set(l21)
+            if e < Np:
+                X = X.at[e:, e:].add(-k.dot(l21, u12))
+        X = pmesh.constrain2d(X)
+    return TileMatrix(X, A.desc)
+
+
+# -- partial pivoting (1d / ptgpanel) ----------------------------------
+
+def getrf_1d(A: TileMatrix):
+    """Partial-pivoting blocked LU (dplasma_zgetrf_1d). Returns
+    (packed L\\U, perm) with semantics ``A[perm] = L U``.
+
+    The reference's parallel panel (CORE_zgetrf_rectil on a 1-D
+    distribution) is one ``lax.linalg.lu`` per panel here; pivot
+    search over the full column is XLA's argmax reduce inside it.
+    """
+    assert A.desc.mb == A.desc.nb, "getrf needs square tiles"
+    nb = A.desc.nb
+    KT = A.desc.KT
+    X = A.pad_diag().data
+    Mp, Np = X.shape
+    perm_g = jnp.arange(Mp)
+    for kk in range(KT):
+        s, e = kk * nb, (kk + 1) * nb
+        lu, _, perm = lax.linalg.lu(X[s:, s:e])
+        X = X.at[s:, s:e].set(lu)
+        if s > 0:
+            X = X.at[s:, :s].set(X[s:, :s][perm, :])
+        if e < Np:
+            right = X[s:, e:][perm, :]
+            d = lu[:nb, :]
+            u12 = k.trsm(d, right[:nb, :], side="L", lower=True, unit=True)
+            X = X.at[s:e, e:].set(u12)
+            if e < Mp:
+                X = X.at[e:, e:].set(
+                    right[nb:, :] - k.dot(lu[nb:, :], u12))
+        perm_g = perm_g.at[s:].set(perm_g[s:][perm])
+        X = pmesh.constrain2d(X)
+    return TileMatrix(X, A.desc), perm_g
+
+
+def getrf_ptgpanel(A: TileMatrix):
+    """dplasma_zgetrf_ptgpanel parity entry: same math as getrf_1d —
+    the reference's hand-distributed panel (zgetrf_ptgpanel.jdf) is
+    what GSPMD does to the panel ``lu`` under a mesh."""
+    return getrf_1d(A)
+
+
+def trsmpl_ptgpanel(LU: TileMatrix, perm, B: TileMatrix) -> TileMatrix:
+    """Apply pivots + L^{-1} to B (dplasma_ztrsmpl_ptgpanel)."""
+    Bp = laswp(B.zero_pad(), perm)
+    return blas3.trsm(1.0, LU, Bp, side="L", uplo="L", trans="N", diag="U")
+
+
+def getrs(trans: str, LU: TileMatrix, perm, B: TileMatrix) -> TileMatrix:
+    """Solve op(A) X = B from a pivoted factorization
+    (dplasma_zgetrs)."""
+    trans = trans.upper()
+    if trans == "N":
+        Y = trsmpl_ptgpanel(LU, perm, B)
+        return blas3.trsm(1.0, LU, Y, side="L", uplo="U", trans="N")
+    # op(A) = A^T/A^H: U^x L^x P x = b
+    Y = blas3.trsm(1.0, LU, B, side="L", uplo="U", trans=trans)
+    Z = blas3.trsm(1.0, LU, Y, side="L", uplo="L", trans=trans, diag="U")
+    return laswp(Z, perm, inverse=True)
+
+
+def gesv_1d(A: TileMatrix, B: TileMatrix):
+    """Factor + solve (dplasma_zgesv_1d). Returns (LU, perm, X)."""
+    LU, perm = getrf_1d(A)
+    return LU, perm, getrs("N", LU, perm, B)
+
+
+# -- incremental pivoting ----------------------------------------------
+
+def getrf_incpiv(A: TileMatrix):
+    """Tile-incremental-pivoting LU (dplasma_zgetrf_incpiv):
+    pivoting is confined to [U_kk; A_mk] couples, trading numerical
+    strength for tile-local data movement (the reference's original
+    out-of-cache motivation; on TPU it demonstrates the couple-kernel
+    schedule — partial pivoting via getrf_1d is the stronger default).
+
+    Returns (factored, Lc, piv): ``factored`` holds U above the
+    diagonal and couple L21 blocks below; ``Lc`` holds the couples'
+    L11 blocks at tile (m, k) (the reference's separate L descriptor,
+    tests/testing_zgetrf_incpiv.c); ``piv[k, m]`` is the couple's
+    2nb-row permutation (row k of piv holds the diagonal tile's).
+    """
+    assert A.desc.mb == A.desc.nb
+    nb = A.desc.nb
+    MT, KT = A.desc.MT, A.desc.KT
+    X = A.pad_diag().data
+    Np = A.desc.Np
+    Lc = jnp.zeros_like(X)
+    piv = jnp.tile(jnp.arange(2 * nb, dtype=jnp.int32), (KT, MT, 1))
+
+    def rows(m):
+        return slice(m * nb, (m + 1) * nb)
+
+    for kk in range(KT):
+        s, e = kk * nb, (kk + 1) * nb
+        lu, _, perm = lax.linalg.lu(X[s:e, s:e])
+        X = X.at[s:e, s:e].set(lu)
+        piv = piv.at[kk, kk, :nb].set(perm.astype(jnp.int32))
+        if e < Np:
+            rk = X[s:e, e:][perm, :]
+            X = X.at[s:e, e:].set(
+                k.trsm(lu, rk, side="L", lower=True, unit=True))
+        for m in range(kk + 1, MT):
+            stack = jnp.concatenate(
+                [jnp.triu(X[s:e, s:e]), X[rows(m), s:e]], axis=0)
+            lu2, _, perm2 = lax.linalg.lu(stack)
+            u_new = jnp.triu(lu2[:nb, :])
+            l11c = jnp.tril(lu2[:nb, :], -1)
+            l21c = lu2[nb:, :]
+            X = X.at[s:e, s:e].set(jnp.tril(X[s:e, s:e], -1) + u_new)
+            X = X.at[rows(m), s:e].set(l21c)
+            Lc = Lc.at[rows(m), s:e].set(l11c)
+            piv = piv.at[kk, m, :].set(perm2.astype(jnp.int32))
+            if e < Np:
+                top, bot = _ssssm(l11c, l21c, perm2,
+                                  X[s:e, e:], X[rows(m), e:])
+                X = X.at[s:e, e:].set(top)
+                X = X.at[rows(m), e:].set(bot)
+        X = pmesh.constrain2d(X)
+    return TileMatrix(X, A.desc), TileMatrix(Lc, A.desc), piv
+
+
+def _ssssm(l11c, l21c, perm, c_top, c_bot):
+    """Apply a couple's L^{-1} P to the vertical pair (CORE_zssssm):
+    y1 = L11c^{-1} (P c)[:nb]; y2 = (P c)[nb:] - L21c y1."""
+    nb = l11c.shape[0]
+    cstack = jnp.concatenate([c_top, c_bot], axis=0)[perm, :]
+    y1 = k.trsm(l11c, cstack[:nb, :], side="L", lower=True, unit=True)
+    y2 = cstack[nb:, :] - k.dot(l21c, y1)
+    return y1, y2
+
+
+def trsmpl_incpiv(LU: TileMatrix, Lc: TileMatrix, piv,
+                  B: TileMatrix) -> TileMatrix:
+    """Replay the incpiv panel transformations on B
+    (dplasma_ztrsmpl_incpiv)."""
+    nb = LU.desc.nb
+    MT, KT = LU.desc.MT, LU.desc.KT
+    Y = B.zero_pad().data
+
+    def rows(m):
+        return slice(m * nb, (m + 1) * nb)
+
+    for kk in range(KT):
+        s, e = kk * nb, (kk + 1) * nb
+        perm = piv[kk, kk, :nb]
+        d = LU.data[s:e, s:e]
+        Y = Y.at[s:e, :].set(
+            k.trsm(d, Y[s:e, :][perm, :], side="L", lower=True, unit=True))
+        for m in range(kk + 1, MT):
+            top, bot = _ssssm(Lc.data[rows(m), s:e],
+                              LU.data[rows(m), s:e],
+                              piv[kk, m, :], Y[s:e, :], Y[rows(m), :])
+            Y = Y.at[s:e, :].set(top)
+            Y = Y.at[rows(m), :].set(bot)
+        Y = pmesh.constrain2d(Y)
+    return TileMatrix(Y, B.desc)
+
+
+def getrs_incpiv(LU: TileMatrix, Lc: TileMatrix, piv,
+                 B: TileMatrix) -> TileMatrix:
+    """Solve from an incpiv factorization (dplasma_zgetrs_incpiv)."""
+    Y = trsmpl_incpiv(LU, Lc, piv, B)
+    return blas3.trsm(1.0, LU, Y, side="L", uplo="U", trans="N")
+
+
+def gesv_incpiv(A: TileMatrix, B: TileMatrix):
+    """dplasma_zgesv_incpiv. Returns (LU, Lc, piv, X)."""
+    LU, Lc, piv = getrf_incpiv(A)
+    return LU, Lc, piv, getrs_incpiv(LU, Lc, piv, B)
+
+
+# -- hybrid LU/QR ------------------------------------------------------
+
+CRITERIA = ("higham_sum", "higham_max", "higham_moy", "mumps",
+            "random", "alternating")
+
+
+def _panel_criterion(criterion: str, panel, nb: int, alpha: float):
+    """Data-dependent LU-acceptability test for one panel (the
+    reference's Higham/MUMPS criteria, zgetrf_qrf_wrapper.c:115-201,
+    src/include/dplasma/lu_qr.h). Returns a traced bool: True → the
+    unpivoted LU panel is numerically acceptable."""
+    d = jnp.abs(jnp.diagonal(panel[:nb, :]))
+    col = jnp.abs(panel)
+    if criterion == "higham_sum":
+        growth = jnp.sum(col, axis=0)
+    elif criterion == "higham_max":
+        growth = jnp.max(col, axis=0)
+    elif criterion == "higham_moy":
+        growth = jnp.mean(col, axis=0) * panel.shape[0]
+    elif criterion == "mumps":
+        # diagonal dominance within the diagonal block
+        off = jnp.sum(jnp.abs(panel[:nb, :]), axis=0) - d
+        return jnp.all(d >= alpha * off)
+    else:
+        raise ValueError(criterion)
+    safe = jnp.where(d > 0, d, jnp.finfo(col.dtype).tiny)
+    return jnp.all(growth <= alpha * safe)
+
+
+def getrf_qrf(A: TileMatrix, criterion: str = "higham_sum",
+              alpha: float | None = None, seed: int = 3872):
+    """Hybrid LU/QR factorization (dplasma_zgetrf_qrf): per panel,
+    factor with an unpivoted LU panel when the criterion accepts it,
+    else with a QR panel (pivot-free stability via orthogonality).
+
+    Returns (factored, T, lu_tab): lu_tab[k] ∈ {1 (LU), 0 (QR)} — the
+    reference's ``lu_tab``; T holds compact-WY triangles for QR
+    panels. Solve with :func:`trsmpl_qrf` + upper trsm (the final
+    factor is upper triangular either way).
+    """
+    assert A.desc.mb == A.desc.nb
+    assert criterion in CRITERIA, criterion
+    nb = A.desc.nb
+    KT = A.desc.KT
+    X = A.pad_diag().data
+    Mp, Np = X.shape
+    if alpha is None:
+        # Higham-style criteria accept LU when growth <= alpha*|diag|
+        # (larger alpha = more LU); mumps accepts when the diagonal
+        # dominates alpha*|offdiag| (larger alpha = less LU) — the
+        # defaults reflect the opposite polarity.
+        alpha = 0.5 if criterion == "mumps" else float(Mp)
+    Tm = jnp.zeros_like(X)
+    lu_tab = jnp.zeros((KT,), jnp.int32)
+
+    for kk in range(KT):
+        s, e = kk * nb, (kk + 1) * nb
+        panel = X[s:, s:e]
+
+        def lu_branch(Xk):
+            pan = Xk[s:, s:e]
+            d = k.getrf_nopiv(pan[:nb, :])
+            l21 = k.trsm(d, pan[nb:, :], side="R", lower=False)
+            Xk = Xk.at[s:e, s:e].set(d)
+            Xk = Xk.at[e:, s:e].set(l21)
+            if e < Np:
+                u12 = k.trsm(d, Xk[s:e, e:], side="L", lower=True,
+                             unit=True)
+                Xk = Xk.at[s:e, e:].set(u12)
+                Xk = Xk.at[e:, e:].add(-k.dot(l21, u12))
+            return Xk, jnp.zeros((Mp - s, nb), Xk.dtype)
+
+        def qr_branch(Xk):
+            packed, v, T = hh.geqrt(Xk[s:, s:e])
+            Xk = Xk.at[s:, s:e].set(packed)
+            if e < Np:
+                Xk = Xk.at[s:, e:].set(
+                    hh.apply_q(v, T, Xk[s:, e:], trans="C"))
+            Tfull = jnp.zeros((Mp - s, nb), Xk.dtype).at[:nb, :].set(T)
+            return Xk, Tfull
+
+        if criterion == "random":
+            use_lu = (hash((seed, kk)) % 2) == 0
+        elif criterion == "alternating":
+            use_lu = (kk % 2) == 0
+        else:
+            use_lu = _panel_criterion(criterion, panel, nb, alpha)
+
+        if isinstance(use_lu, bool):  # trace-time choice
+            X, Tpan = (lu_branch if use_lu else qr_branch)(X)
+            flag = jnp.int32(1 if use_lu else 0)
+        else:  # data-dependent: branchless lax.cond over both kernels
+            X, Tpan = lax.cond(use_lu, lu_branch, qr_branch, X)
+            flag = use_lu.astype(jnp.int32)
+        Tm = Tm.at[s:, s:e].set(Tpan)
+        lu_tab = lu_tab.at[kk].set(flag)
+        X = pmesh.constrain2d(X)
+    return TileMatrix(X, A.desc), TileMatrix(Tm, A.desc), lu_tab
+
+
+def trsmpl_qrf(LU: TileMatrix, Tm: TileMatrix, lu_tab,
+               B: TileMatrix) -> TileMatrix:
+    """Apply the qrf panel transformations to B (dplasma_ztrsmpl_qrf):
+    L^{-1} for LU panels, Q^H for QR panels, selected by lu_tab."""
+    nb = LU.desc.nb
+    KT = LU.desc.KT
+    Y = B.zero_pad().data
+    for kk in range(KT):
+        s, e = kk * nb, (kk + 1) * nb
+        pan = LU.data[s:, s:e]
+
+        def lu_apply(y):
+            d = pan[:nb, :]
+            y1 = k.trsm(d, y[:nb, :], side="L", lower=True, unit=True)
+            y2 = y[nb:, :] - k.dot(pan[nb:, :], y1)
+            return jnp.concatenate([y1, y2], axis=0)
+
+        def qr_apply(y):
+            v = k.tri(pan, lower=True, unit=True)
+            T = Tm.data[s:s + nb, s:e]
+            return hh.apply_q(v, T, y, trans="C")
+
+        Y = Y.at[s:, :].set(
+            lax.cond(lu_tab[kk] == 1, lu_apply, qr_apply, Y[s:, :]))
+        Y = pmesh.constrain2d(Y)
+    return TileMatrix(Y, B.desc)
+
+
+def getrs_qrf(LU: TileMatrix, Tm: TileMatrix, lu_tab,
+              B: TileMatrix) -> TileMatrix:
+    """Solve from a qrf factorization."""
+    Y = trsmpl_qrf(LU, Tm, lu_tab, B)
+    return blas3.trsm(1.0, LU, Y, side="L", uplo="U", trans="N")
+
+
+def gerfs(A: TileMatrix, LU: TileMatrix, perm, B: TileMatrix,
+          X: TileMatrix, iters: int = 1) -> TileMatrix:
+    """Iterative refinement of a getrf_1d solve (dplasma_zgerfs):
+    r = B - A X; X += A^{-1} r, repeated ``iters`` times."""
+    for _ in range(iters):
+        R = B.like(B.zero_pad().data
+                   - k.dot(A.zero_pad().data, X.zero_pad().data))
+        D = getrs("N", LU, perm, R)
+        X = X.like(X.data + D.data)
+    return X

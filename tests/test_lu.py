@@ -1,0 +1,175 @@
+"""LU family — the testing_zgetrf*/zgesv* equivalents: seeded
+generation, factorization, |b - Ax| residuals (ref
+tests/testing_zgetrf.c, testing_zgesv_incpiv.c, testing_zgetrf_qrf.c)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.ops import checks, generators, lu
+from dplasma_tpu.parallel import mesh
+
+
+def _diag_dominant(N, nb, dtype=jnp.float64, seed=3872):
+    """Diagonally dominant test matrix (safe for nopiv variants) —
+    the reference's zplrnt(..., diagdom) path."""
+    A = generators.plrnt(N, N, nb, nb, seed=seed, dtype=dtype)
+    d = jnp.eye(N, dtype=dtype) * (2.0 * N)
+    return TileMatrix.from_dense(A.to_dense() + d, nb, nb, A.desc.dist)
+
+
+def _lu_dense(LU: TileMatrix):
+    x = LU.to_dense()
+    M, N = x.shape
+    K = min(M, N)
+    l = jnp.tril(x[:, :K], -1) + jnp.eye(M, K, dtype=x.dtype)
+    u = jnp.triu(x[:K, :])
+    return l, u
+
+
+@pytest.mark.parametrize("N,nb", [(96, 16), (117, 25)])
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_getrf_nopiv(N, nb, dtype):
+    A0 = _diag_dominant(N, nb, dtype)
+    LU = jax.jit(lu.getrf_nopiv)(A0)
+    l, u = _lu_dense(LU)
+    rec = l @ u
+    r = np.abs(np.asarray(rec - A0.to_dense())).max()
+    scale = np.abs(np.asarray(A0.to_dense())).max() * N
+    assert r / scale < 1e-12, r
+
+
+@pytest.mark.parametrize("N,nb", [(96, 16), (117, 25)])
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_getrf_1d_residual(N, nb, dtype):
+    A0 = generators.plrnt(N, N, nb, nb, seed=51, dtype=dtype)
+    LU, perm = jax.jit(lu.getrf_1d)(A0)
+    l, u = _lu_dense(LU)
+    ap = np.asarray(TileMatrix(A0.pad_diag().data, A0.desc).data)[
+        np.asarray(perm)]
+    r = np.abs(ap - np.asarray(
+        (jnp.tril(LU.data, -1) + jnp.eye(LU.data.shape[0])) @
+        jnp.triu(LU.data))).max()
+    assert r < 1e-11 * N, r
+    # growth bounded: partial pivoting keeps |L| <= 1 (complex pivot
+    # search uses cabs1 = |Re|+|Im|, so the modulus bound is sqrt(2))
+    bound = np.sqrt(2.0) if jnp.issubdtype(dtype, jnp.complexfloating) else 1.0
+    assert np.abs(np.asarray(jnp.tril(LU.data, -1))).max() <= bound + 1e-12
+
+
+@pytest.mark.parametrize("trans", ["N", "T", "C"])
+def test_getrs_trans(trans):
+    N, nrhs, nb = 80, 7, 16
+    dtype = jnp.complex128
+    A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=dtype)
+    B = generators.plrnt(N, nrhs, nb, nb, seed=2354, dtype=dtype)
+    LU, perm = lu.getrf_1d(A0)
+    X = lu.getrs(trans, LU, perm, B)
+    a = np.asarray(A0.to_dense())
+    op = {"N": a, "T": a.T, "C": a.conj().T}[trans]
+    r = np.abs(op @ np.asarray(X.to_dense()) -
+               np.asarray(B.to_dense())).max()
+    assert r < 1e-9, r
+
+
+def test_gesv_1d_axmb():
+    N, nrhs, nb = 117, 13, 25
+    A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=jnp.float64)
+    B = generators.plrnt(N, nrhs, nb, nb, seed=2354, dtype=jnp.float64)
+    _, _, X = lu.gesv_1d(A0, B)
+    r, ok = checks.check_axmb(A0, B, X)
+    assert ok, f"residual {r}"
+
+
+def test_gesv_incpiv_axmb():
+    N, nrhs, nb = 96, 9, 16
+    A0 = generators.plrnt(N, N, nb, nb, seed=7, dtype=jnp.float64)
+    B = generators.plrnt(N, nrhs, nb, nb, seed=11, dtype=jnp.float64)
+    _, _, _, X = lu.gesv_incpiv(A0, B)
+    r, ok = checks.check_axmb(A0, B, X)
+    assert ok, f"residual {r}"
+
+
+def test_getrf_incpiv_reconstruction():
+    """incpiv factorization solves correctly even when tiles need
+    pivoting (top-left tile made singular-ish)."""
+    N, nb = 64, 16
+    A0 = generators.plrnt(N, N, nb, nb, seed=13, dtype=jnp.float64)
+    a = A0.to_dense().at[0, 0].set(0.0)  # force a pivot in tile (0,0)
+    A0 = TileMatrix.from_dense(a, nb, nb, A0.desc.dist)
+    B = generators.plrnt(N, 5, nb, nb, seed=17, dtype=jnp.float64)
+    LU, Lc, piv = jax.jit(lu.getrf_incpiv)(A0)
+    X = lu.getrs_incpiv(LU, Lc, piv, B)
+    r, ok = checks.check_axmb(A0, B, X)
+    assert ok, f"residual {r}"
+
+
+def test_laswp_ipiv_roundtrip():
+    N, nb = 48, 16
+    A0 = generators.plrnt(N, N, nb, nb, seed=5, dtype=jnp.float64)
+    perm = jnp.asarray(np.random.default_rng(0).permutation(A0.desc.Mp))
+    Ap = lu.laswp(A0, perm)
+    back = lu.laswp(Ap, perm, inverse=True)
+    assert np.allclose(np.asarray(back.data), np.asarray(A0.data))
+    ipiv = lu.perm_to_ipiv(perm)
+    perm2 = lu.ipiv_to_perm(ipiv)
+    assert np.array_equal(np.asarray(perm), np.asarray(perm2))
+
+
+@pytest.mark.parametrize("criterion", list(lu.CRITERIA))
+def test_getrf_qrf_solve(criterion):
+    N, nrhs, nb = 96, 7, 16
+    A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=jnp.float64)
+    B = generators.plrnt(N, nrhs, nb, nb, seed=2354, dtype=jnp.float64)
+    LU, Tm, lu_tab = jax.jit(
+        lu.getrf_qrf, static_argnames=("criterion",))(A0,
+                                                      criterion=criterion)
+    X = lu.getrs_qrf(LU, Tm, lu_tab, B)
+    r, ok = checks.check_axmb(A0, B, X)
+    assert ok, f"criterion {criterion}: residual {r}, lu_tab {lu_tab}"
+
+
+def test_getrf_qrf_falls_back_to_qr():
+    """A matrix that defeats unpivoted LU (tiny diagonal) must route
+    panels to QR under a strict criterion and still solve."""
+    N, nb = 64, 16
+    A0 = generators.plrnt(N, N, nb, nb, seed=13, dtype=jnp.float64)
+    a = A0.to_dense() - jnp.diag(jnp.diagonal(A0.to_dense()))  # zero diag
+    A0 = TileMatrix.from_dense(a, nb, nb, A0.desc.dist)
+    B = generators.plrnt(N, 3, nb, nb, seed=17, dtype=jnp.float64)
+    LU, Tm, lu_tab = lu.getrf_qrf(A0, criterion="higham_max", alpha=10.0)
+    assert int(lu_tab.sum()) < LU.desc.KT  # at least one QR panel
+    X = lu.getrs_qrf(LU, Tm, lu_tab, B)
+    r, ok = checks.check_axmb(A0, B, X)
+    assert ok, f"residual {r}"
+
+
+def test_getrf_1d_on_mesh(devices8):
+    N, nb = 128, 16
+    m = mesh.make_mesh(2, 4, devices8)
+    A0 = generators.plrnt(N, N, nb, nb, seed=7, dtype=jnp.float32)
+    B = generators.plrnt(N, 8, nb, nb, seed=9, dtype=jnp.float32)
+    with mesh.use_grid(m):
+        A0s = A0.like(mesh.device_put2d(A0.data))
+        LU, perm = jax.jit(lu.getrf_1d)(A0s)
+        assert LU.data.sharding.spec == jax.sharding.PartitionSpec("p", "q")
+    X = lu.getrs("N", LU, perm, B)
+    r, ok = checks.check_axmb(A0, B, X)
+    assert ok, f"residual {r}"
+
+
+def test_gerfs_refinement():
+    N, nrhs, nb = 80, 5, 16
+    A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=jnp.float64)
+    B = generators.plrnt(N, nrhs, nb, nb, seed=2354, dtype=jnp.float64)
+    LU, perm = lu.getrf_1d(A0)
+    X0 = lu.getrs("N", LU, perm, B)
+    # perturb the solution; refinement must pull it back
+    Xbad = X0.like(X0.data + 1e-6)
+    Xref = lu.gerfs(A0, LU, perm, B, Xbad, iters=2)
+    r0 = np.abs(np.asarray(A0.to_dense() @ Xbad.to_dense()
+                           - B.to_dense())).max()
+    r1 = np.abs(np.asarray(A0.to_dense() @ Xref.to_dense()
+                           - B.to_dense())).max()
+    assert r1 < 1e-6 * r0, (r0, r1)
