@@ -1,0 +1,100 @@
+"""LDL^H factorization (Hermitian-indefinite, no pivoting) — the
+reference's prototype HETRF family.
+
+Reference surface: ``dplasma_zhetrf`` (zhetrf.jdf, prototype per
+README.rst:20), ``dplasma_zhetrs``, ``dplasma_ztrdsm`` (ztrdsm.jdf),
+``ztrmdm.jdf``, with tile kernels core_zhetrf*_nopiv.c / core_zhedrk.c
+(SURVEY §2.2 "LDL^T (prototype)").
+
+TPU-native design: blocked right-looking sweep like potrf/getrf_nopiv —
+per panel one unblocked tile LDL^H (fori_loop of masked rank-1
+updates), one batched TRSM + diagonal scale, and one HEDRK-shaped
+trailing update L21 D L21^H as a single MXU matmul pair. D is kept on
+the diagonal of the packed factor (LAPACK convention); L is unit
+lower. Like the reference, no pivoting — pair with the random
+butterfly transform (ops.rbt) for stability on indefinite systems.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.kernels import blas as k
+from dplasma_tpu.ops import blas3
+from dplasma_tpu.parallel import mesh as pmesh
+
+
+def hetrf_tile(a):
+    """Unblocked LDL^H of one Hermitian tile (core_zhetrf_nopiv
+    analog): returns packed L\\D (unit L implicit, D on the diagonal).
+    Only the lower triangle of ``a`` is read."""
+    n = a.shape[0]
+    a = jnp.tril(a)
+
+    def body(j, m):
+        d = m[j, j]
+        mask = jnp.arange(n) > j
+        col = jnp.where(mask, m[:, j], 0.0)
+        l = col / d
+        # rank-1 Hermitian update on the trailing block
+        m = m - jnp.where(mask[:, None] & mask[None, :],
+                          jnp.outer(l, l.conj()) * d,
+                          jnp.zeros((), m.dtype))
+        m = m.at[:, j].set(jnp.where(mask, l, m[:, j]))
+        return m
+
+    return lax.fori_loop(0, n, body, a)
+
+
+def hetrf(A: TileMatrix, uplo: str = "L") -> TileMatrix:
+    """Blocked LDL^H: A = L D L^H (dplasma_zhetrf, lower storage).
+    Returns the packed factor (strict lower = L, diagonal = D)."""
+    assert uplo.upper() == "L", "reference hetrf is lower-storage"
+    assert A.desc.mb == A.desc.nb and A.desc.M == A.desc.N
+    nb = A.desc.nb
+    KT = A.desc.KT
+    X = A.pad_diag().data
+    Mp = X.shape[0]
+    for kk in range(KT):
+        s, e = kk * nb, (kk + 1) * nb
+        d = hetrf_tile(X[s:e, s:e])
+        X = X.at[s:e, s:e].set(d)
+        if e < Mp:
+            dd = jnp.real(jnp.diagonal(d)).astype(X.dtype)
+            # L21 = A21 L11^{-H} D^{-1}
+            l21 = k.trsm(d, X[e:, s:e], side="R", lower=True, trans="C",
+                         unit=True) / dd[None, :]
+            X = X.at[e:, s:e].set(l21)
+            # trailing HEDRK: A22 -= L21 D L21^H (core_zhedrk)
+            X = X.at[e:, e:].add(
+                -k.dot(l21 * dd[None, :], l21, tb=True, conj_b=True))
+        X = pmesh.constrain2d(X)
+    return TileMatrix(X, A.desc)
+
+
+def trdsm(F: TileMatrix, B: TileMatrix) -> TileMatrix:
+    """Diagonal solve B ← D^{-1} B against the D of a packed LDL^H
+    factor (dplasma_ztrdsm analog)."""
+    d = jnp.real(jnp.diagonal(F.data)).astype(F.dtype)
+    return B.like(B.zero_pad().data / d[:, None])
+
+
+def trmdm(F: TileMatrix, B: TileMatrix) -> TileMatrix:
+    """Diagonal multiply B ← D B (ztrmdm analog)."""
+    d = jnp.real(jnp.diagonal(F.data)).astype(F.dtype)
+    return B.like(B.zero_pad().data * d[:, None])
+
+
+def hetrs(F: TileMatrix, B: TileMatrix) -> TileMatrix:
+    """Solve L D L^H x = b from a hetrf factor (dplasma_zhetrs):
+    unit-lower TRSM, diagonal solve, unit-lower^H TRSM."""
+    y = blas3.trsm(1.0, F, B, side="L", uplo="L", trans="N", diag="U")
+    y = trdsm(F, y)
+    return blas3.trsm(1.0, F, y, side="L", uplo="L", trans="C", diag="U")
+
+
+def hesv(A: TileMatrix, B: TileMatrix):
+    """Factor + solve. Returns (factor, X)."""
+    F = hetrf(A)
+    return F, hetrs(F, B)

@@ -1,0 +1,138 @@
+"""Random Butterfly Transform (RBT) — pivoting avoidance.
+
+Reference surface: ``dplasma_zhebut`` / ``dplasma_zgebut`` /
+``dplasma_zgebmm`` (zhebut.jdf 591 LoC, zgebut.jdf, zgebmm.jdf) with
+``butterfly_map.c`` computing the recursive two-level segmentation and
+``parsec_rbt_calculate_constants`` the per-level U vectors
+(zhebut_wrapper.c:110-143; SURVEY §2.2 "Random Butterfly Transform").
+The transform Ã = U^T A U (Hermitian) / U^T A V (general) randomizes
+A so the subsequent factorization needs no pivoting.
+
+TPU-native design: a depth-d butterfly is d levels of segment-halving
+mixes — each level one scale + one pairwise add/sub over rows, pure
+VPU elementwise work fused by XLA. The random diagonals are
+trace-time constants derived from a seed (the analog of the
+reference's precomputed U vectors); segmentation of non-power-of-two
+sizes keeps the unpaired middle row as a pass-through (the
+butterfly_map segment algebra). U is real orthogonal-up-to-scaling
+with U^{-1} = R^{-1} S (S is involutive), so solves replay cheaply.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.ops import ldl
+
+_SQRT2 = np.sqrt(2.0)
+
+
+def _rdiag(seed: int, lvl: int, idx: int, n: int):
+    """Deterministic random diagonal for one segment (trace-time
+    constant, like the reference's rbt constants): exp(u/10)/sqrt(2)
+    with u ~ U[-1, 1]."""
+    rng = np.random.default_rng(
+        np.random.SeedSequence([seed & 0x7FFFFFFF, lvl, idx]))
+    return np.exp(rng.uniform(-1.0, 1.0, size=n) * 0.1) / _SQRT2
+
+
+def _rows_apply(x, seed: int, depth: int, mode: str):
+    """Apply U (mode 'N'), U^T (mode 'T') or U^{-1} (mode 'I') to the
+    rows of x. U = S·R recursively: U = B ∘ blockdiag(U₁, U₂)."""
+    assert mode in ("N", "T", "I")
+
+    def coarse(seg, lvl, idx, n):
+        h1 = (n + 1) // 2
+        h2 = n - h1
+        if h2 == 0:
+            return seg
+        r1 = jnp.asarray(_rdiag(seed, lvl, 2 * idx, h1), x.dtype)
+        r2 = jnp.asarray(_rdiag(seed, lvl, 2 * idx + 1, h2), x.dtype)
+        if mode == "I":
+            # paired rows invert through S^{-1} = S/2; the unpaired
+            # middle pass-through row inverts as 1/r alone
+            r1 = jnp.concatenate([1.0 / (2.0 * r1[:h2]), 1.0 / r1[h2:]])
+            r2 = 1.0 / (2.0 * r2)
+
+        def mix(top, bot):
+            t, b = top[:h2], bot
+            return (jnp.concatenate([t + b, top[h2:]], axis=0),
+                    t - b)
+
+        top, bot = seg[:h1], seg[h1:]
+        if mode == "N":        # S (R seg)
+            top = top * r1[:, None]
+            bot = bot * r2[:, None]
+            top, bot = mix(top, bot)
+        else:                  # R (S seg) — S is symmetric/involutive
+            top, bot = mix(top, bot)
+            top = top * r1[:, None]
+            bot = bot * r2[:, None]
+        return jnp.concatenate([top, bot], axis=0)
+
+    def rec(seg, lvl, idx, n):
+        if lvl >= depth or n < 2:
+            return seg
+        h1 = (n + 1) // 2
+        if mode == "N":
+            s1 = rec(seg[:h1], lvl + 1, 2 * idx, h1)
+            s2 = rec(seg[h1:], lvl + 1, 2 * idx + 1, n - h1)
+            return coarse(jnp.concatenate([s1, s2], axis=0),
+                          lvl, idx, n)
+        seg = coarse(seg, lvl, idx, n)
+        s1 = rec(seg[:h1], lvl + 1, 2 * idx, h1)
+        s2 = rec(seg[h1:], lvl + 1, 2 * idx + 1, n - h1)
+        return jnp.concatenate([s1, s2], axis=0)
+
+    return rec(x, 0, 0, x.shape[0])
+
+
+def gebmm(B: TileMatrix, seed: int = 3872, depth: int = 2,
+          trans: str = "N") -> TileMatrix:
+    """Multiply rows of B by the butterfly: op(U) B (dplasma_zgebmm).
+
+    The butterfly is sized to the TRUE row count M (the reference's
+    butterfly_map segments the actual matrix, not the tile grid);
+    padding rows pass through untouched.
+    """
+    M = B.desc.M
+    X = B.zero_pad().data
+    y = _rows_apply(X[:M, :], seed, depth, trans)
+    return B.like(X.at[:M, :].set(y))
+
+
+def hebut(A: TileMatrix, seed: int = 3872, depth: int = 2) -> TileMatrix:
+    """Two-sided Hermitian butterfly Ã = U^T A U (dplasma_zhebut).
+    U is real, so hermitian-ness is preserved."""
+    N = A.desc.M
+    X = A.zero_pad().data
+    sub = X[:N, :N]
+    sub = _rows_apply(sub, seed, depth, "T")
+    sub = _rows_apply(sub.conj().T, seed, depth, "T").conj().T
+    return A.like(X.at[:N, :N].set(sub))
+
+
+def gebut(A: TileMatrix, seed_u: int = 3872, seed_v: int = 2354,
+          depth: int = 2) -> TileMatrix:
+    """General two-sided butterfly Ã = U^T A V (dplasma_zgebut)."""
+    M, N = A.desc.M, A.desc.N
+    X = A.zero_pad().data
+    sub = X[:M, :N]
+    sub = _rows_apply(sub, seed_u, depth, "T")
+    sub = _rows_apply(sub.T, seed_v, depth, "N").T
+    return A.like(X.at[:M, :N].set(sub))
+
+
+def hesv_rbt(A: TileMatrix, B: TileMatrix, uplo: str = "L",
+             seed: int = 3872, depth: int = 2):
+    """Solve a Hermitian-indefinite system without pivoting via
+    RBT + LDL^H (the reference's hebut → hetrf → backtransform flow,
+    tests/testing_zhebut.c): Ã = U^T A U; x = U Ã^{-1} U^T b.
+    A must store BOTH triangles (or be densified by the caller) since
+    the butterfly mixes them. Returns (factor, X)."""
+    At = hebut(A, seed, depth)
+    F = ldl.hetrf(At, uplo)
+    y = gebmm(B, seed, depth, trans="T")
+    z = ldl.hetrs(F, y)
+    return F, gebmm(z, seed, depth, trans="N")

@@ -1,0 +1,99 @@
+"""Eigen/SVD chains — the testing_zheev/zhetrd/zgesvd equivalents:
+reduction correctness vs numpy eigensolvers (ref tests/testing_zheev.c,
+testing_zgesvd.c)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.ops import eig, generators
+from dplasma_tpu.ops.norms import _sym_full
+from dplasma_tpu.parallel import mesh
+
+
+@pytest.mark.parametrize("N,nb", [(64, 16), (117, 25)])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_herbt_band_and_spectrum(N, nb, uplo, dtype):
+    A0 = generators.plghe(0.0, N, nb, seed=3872, dtype=dtype)
+    Bm, _, _ = jax.jit(eig.herbt, static_argnames="uplo")(A0, uplo=uplo)
+    b = np.asarray(Bm.to_dense())
+    # band structure: zero outside bandwidth 2*nb-1
+    for d in range(2 * nb, N):
+        assert np.abs(np.diagonal(b, -d)).max() < 1e-12
+    # similarity: spectrum preserved
+    a = np.asarray(_sym_full(A0, uplo, conj=True))
+    wa = np.linalg.eigvalsh(a)
+    wb = np.linalg.eigvalsh(b)
+    assert np.allclose(wa, wb, atol=1e-10 * N)
+
+
+@pytest.mark.parametrize("N,nb", [(64, 16), (90, 25)])
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_heev_eigenvalues(N, nb, dtype):
+    A0 = generators.plghe(0.0, N, nb, seed=51, dtype=dtype)
+    w = eig.heev(A0)
+    a = np.asarray(_sym_full(A0, "L", conj=True))
+    ref = np.linalg.eigvalsh(a)
+    assert np.allclose(np.sort(np.asarray(w)), ref, atol=1e-9 * N)
+
+
+def test_hetrd_tridiagonal_spectrum():
+    N, nb = 64, 16
+    A0 = generators.plghe(0.0, N, nb, seed=7, dtype=jnp.complex128)
+    d, e = eig.hetrd(A0)
+    assert d.shape == (N,) and e.shape == (N - 1,)
+    t = np.diag(np.asarray(d)) + np.diag(np.asarray(e), 1) \
+        + np.diag(np.asarray(e), -1)
+    a = np.asarray(_sym_full(A0, "L", conj=True))
+    assert np.allclose(np.linalg.eigvalsh(t), np.linalg.eigvalsh(a),
+                       atol=1e-9 * N)
+
+
+def test_band_to_rect():
+    N, nb = 48, 16
+    A0 = generators.plghe(0.0, N, nb, seed=5, dtype=jnp.float64)
+    Bm, _, _ = eig.herbt(A0)
+    rect = eig.band_to_rect(Bm, 2 * nb - 1)
+    assert rect.shape == (2 * nb, A0.desc.Mp)
+    b = np.asarray(Bm.to_dense())
+    assert np.allclose(np.asarray(rect[0][:N]), np.diagonal(b))
+    assert np.allclose(np.asarray(rect[1][:N - 1]), np.diagonal(b, -1))
+
+
+@pytest.mark.parametrize("M,N,nb", [(80, 80, 16), (96, 64, 16),
+                                    (64, 96, 16)])
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_gesvd_singular_values(M, N, nb, dtype):
+    A0 = generators.plrnt(M, N, nb, nb, seed=3872, dtype=dtype)
+    s = eig.gesvd(A0)
+    ref = np.linalg.svd(np.asarray(A0.to_dense()), compute_uv=False)
+    assert np.allclose(np.asarray(s), ref, atol=1e-8 * max(M, N))
+
+
+def test_gebrd_ge2gb_band_structure():
+    M, N, nb = 96, 96, 16
+    A0 = generators.plrnt(M, N, nb, nb, seed=13, dtype=jnp.float64)
+    B = eig.gebrd_ge2gb(A0)
+    b = np.asarray(B.to_dense())
+    # lower triangle zero below the diagonal block; upper band <= 2nb
+    assert np.abs(np.tril(b, -1)).max() < 1e-12
+    for d in range(2 * nb, N):
+        assert np.abs(np.diagonal(b, d)).max() < 1e-12
+    # singular values preserved by the orthogonal two-sided reduction
+    sa = np.linalg.svd(np.asarray(A0.to_dense()), compute_uv=False)
+    sb = np.linalg.svd(b, compute_uv=False)
+    assert np.allclose(sa, sb, atol=1e-9 * N)
+
+
+def test_heev_on_mesh(devices8):
+    N, nb = 64, 8
+    m = mesh.make_mesh(2, 2, devices8[:4])
+    A0 = generators.plghe(0.0, N, nb, seed=7, dtype=jnp.float32)
+    with mesh.use_grid(m):
+        A0s = A0.like(mesh.device_put2d(A0.data))
+        w = jax.jit(eig.heev)(A0s)
+    a = np.asarray(_sym_full(A0, "L", conj=True))
+    ref = np.linalg.eigvalsh(a)
+    assert np.allclose(np.sort(np.asarray(w)), ref, atol=1e-2)
