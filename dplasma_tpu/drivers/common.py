@@ -1,0 +1,375 @@
+"""Shared driver harness — the analog of the reference's tests/common.c/h.
+
+Every ``testing_<prec><algo>`` driver accepts the reference CLI vocabulary
+(ref tests/common.c:73-259): sizes ``-N/-M/-K``, tile shape ``-t/-T``,
+process grid ``-p/-q`` with k-cyclic supertiles ``--kp/--kq``, inner
+blocking ``-i``, checks ``-x/-X``, verbosity ``-v[=n]``, HQR tree knobs
+(``--qr_a/--qr_p/--treel/--treeh/-d/-r``), LU/QR criteria
+(``--criteria/-a``), butterfly level ``-y``, seed/nruns, scheduler/cores/
+gpus/vpmap accepted-and-recorded (scheduling is XLA's job here), and
+``--dot`` for the trace-time DAG dump.
+
+Timing/printing mirrors tests/common.h:233-288 — the ``[****] TIME(s)``
+line with ``PxQxg= .. NB= .. N= .. : .. gflops`` so existing log parsers
+work unchanged, the ENQ/PROG/DEST phase breakdown (here: trace+compile /
+device execution / teardown), and the CDash ``DartMeasurement`` XML at
+verbosity >= 5.
+"""
+from __future__ import annotations
+
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+
+PRECISIONS = {"s": "float32", "d": "float64", "c": "complex64",
+              "z": "complex128"}
+
+SCHEDULERS = ("LFQ", "LTQ", "AP", "LHQ", "GD", "PBQ", "IP", "RND")
+
+
+@dataclass
+class IParam:
+    """Driver parameter block (the iparam[] array of tests/common.c)."""
+    rank: int = 0
+    nodes: int = 1
+    P: int = 1
+    Q: int = 1
+    kp: int = 1
+    kq: int = 1
+    M: int = 0
+    N: int = 0
+    K: int = 1          # NRHS for solves, K for gemm
+    LDA: int = 0
+    LDB: int = 0
+    LDC: int = 0
+    IB: int = 32
+    MB: int = 0
+    NB: int = 0
+    HMB: int = 0        # recursive inner blocking (-z/--HNB)
+    HNB: int = 0
+    check: bool = False
+    check_inv: bool = False
+    sync: bool = False
+    loud: int = 1       # verbosity ladder (-v[=n])
+    seed: int = 3872
+    mtx: int = 0
+    nruns: int = 1
+    # HQR trees (--qr_a/--qr_p/--treel/--treeh/-d/-r)
+    qr_a: int = -1
+    qr_p: int = -1
+    lowlvl_tree: int = -1
+    highlvl_tree: int = -1
+    qr_domino: int = -1
+    qr_tsrr: int = 0
+    # LU/QR hybrid (--criteria/-a)
+    criteria: int = 0
+    alpha: float = -1.0
+    # butterfly (-y)
+    butterfly_level: int = 0
+    # accepted-for-compat knobs (scheduling/threads are XLA's job on TPU)
+    cores: int = 0
+    gpus: int = 0
+    scheduler: str = "LFQ"
+    thread_multi: bool = False
+    dot: Optional[str] = None
+    extra: list = field(default_factory=list)   # args after `--` (MCA-style)
+
+    @property
+    def prec_dtype(self):
+        import jax.numpy as jnp
+        return getattr(jnp, PRECISIONS[self.prec])
+
+    prec: str = "d"
+
+
+_USAGE = """\
+Mandatory argument:
+ -N                : dimension (N) of the matrices
+Optional arguments:
+ -p -P --grid-rows : rows (P) in the PxQ device grid (default: 1)
+ -q -Q --grid-cols : columns (Q) in the PxQ device grid (default: 1;
+                     the single-device path needs no mesh)
+ -M                : dimension (M) of the matrices (default: N)
+ -K --NRHS         : dimension (K) / right-hand-side count (default: 1)
+ -A --LDA -B --LDB -C --LDC : leading dimensions (recorded)
+ -i --IB           : inner blocking (default: 32)
+ -t --MB           : rows in a tile (default: autotuned)
+ -T --NB           : columns in a tile (default: MB)
+ -s --SMB --kp     : row k-cyclicity (supertiles) (default: 1)
+ -S --SNB --kq     : column k-cyclicity (supertiles) (default: 1)
+ -z --HNB --HMB    : inner NB/MB for recursive algorithms
+ -x --check        : verify the results
+ -X --check_inv    : verify against the inverse
+ -b --sync         : step-by-step (synchronous) variant
+ --qr_a --qr_p     : HQR TS-domain size / high-level tree size
+ -d --domino -r --tsrr : HQR domino / TS round-robin toggles
+ --treel --treeh   : HQR low/high level tree (0 flat 1 greedy 2 fibonacci 3 binary 4 greedy1p)
+ --criteria -a --alpha : LU/QR switch criteria and threshold
+ --seed --mtx      : generator seed / matrix kind
+ -y --butlvl       : butterfly level
+ --nruns           : number of timed runs
+ -v --verbose[=n]  : verbosity ladder
+ -c --cores -g --gpus -o --scheduler -V --vpmap -m : accepted for
+                     compatibility (scheduling is compiled into XLA)
+ --dot[=file]      : dump the trace-time tile DAG as graphviz
+ -h --help         : this message
+ENVIRONMENT
+  [SDCZ]<FUNCTION> : per-precision priority limit (recorded, trace-time)
+"""
+
+
+def _int(v: str) -> int:
+    return int(v, 0)
+
+
+# option name -> (iparam field, converter or None-for-flag)
+_LONG = {
+    "grid-rows": ("P", _int), "grid-cols": ("Q", _int),
+    "P": ("P", _int), "Q": ("Q", _int),
+    "N": ("N", _int), "M": ("M", _int), "K": ("K", _int),
+    "NRHS": ("K", _int),
+    "LDA": ("LDA", _int), "LDB": ("LDB", _int), "LDC": ("LDC", _int),
+    "IB": ("IB", _int), "MB": ("MB", _int), "NB": ("NB", _int),
+    "SMB": ("kp", _int), "SNB": ("kq", _int),
+    "kp": ("kp", _int), "kq": ("kq", _int),
+    "HNB": ("HNB", _int), "HMB": ("HMB", _int),
+    "check": ("check", None), "check_inv": ("check_inv", None),
+    "sync": ("sync", None),
+    "qr_a": ("qr_a", _int), "qr_p": ("qr_p", _int),
+    "treel": ("lowlvl_tree", _int), "treeh": ("highlvl_tree", _int),
+    "domino": ("qr_domino", _int), "tsrr": ("qr_tsrr", _int),
+    "criteria": ("criteria", _int), "alpha": ("alpha", float),
+    "seed": ("seed", _int), "mtx": ("mtx", _int),
+    "butlvl": ("butterfly_level", _int),
+    "nruns": ("nruns", _int),
+    "cores": ("cores", _int), "gpus": ("gpus", _int),
+    "scheduler": ("scheduler", str), "vpmap": ("_vpmap", str),
+    "thread_multi": ("thread_multi", None),
+    "ht": ("_ht", _int),
+}
+
+_SHORT = {
+    "p": "grid-rows", "P": "grid-rows", "q": "grid-cols", "Q": "grid-cols",
+    "N": "N", "M": "M", "K": "NRHS",
+    "A": "LDA", "B": "LDB", "C": "LDC",
+    "i": "IB", "t": "MB", "T": "NB", "s": "SMB", "S": "SNB",
+    "z": "HNB",
+    "a": "alpha", "y": "butlvl", "c": "cores", "g": "gpus",
+    "o": "scheduler", "V": "vpmap", "d": "domino", "r": "tsrr",
+}
+_SHORT_FLAGS = {"x": "check", "X": "check_inv", "b": "sync",
+                "m": "thread_multi"}
+
+
+def parse_arguments(argv: list[str], ip: Optional[IParam] = None) -> IParam:
+    ip = ip or IParam()
+    args = list(argv)
+    try:
+        return _parse_arguments(args, ip)
+    except IndexError:
+        sys.stderr.write(f"missing value for option {args[-1]}\n{_USAGE}")
+        raise SystemExit(2)
+
+
+def _parse_arguments(args: list[str], ip: IParam) -> IParam:
+    i = 0
+    positional = []
+    while i < len(args):
+        a = args[i]
+        if a == "--":
+            ip.extra = args[i + 1:]
+            break
+        if a in ("-h", "--help"):
+            sys.stderr.write(_USAGE)
+            raise SystemExit(0)
+        if a.startswith("--"):
+            body = a[2:]
+            name, eq, val = body.partition("=")
+            if name in ("verbose",):
+                ip.loud = _int(val) if eq else 2
+            elif name == "dot":
+                ip.dot = val if eq else "dag.dot"
+            elif name in _LONG:
+                field_, conv = _LONG[name]
+                if conv is None:
+                    setattr(ip, field_, True)
+                else:
+                    if not eq:
+                        i += 1
+                        val = args[i]
+                    if not field_.startswith("_"):
+                        setattr(ip, field_, conv(val))
+            else:
+                sys.stderr.write(f"unknown option {a}\n{_USAGE}")
+                raise SystemExit(2)
+        elif a.startswith("-") and len(a) >= 2 and not a[1].isdigit():
+            c, rest = a[1], a[2:]
+            if c == "v":
+                ip.loud = _int(rest.lstrip("=")) if rest else 2
+            elif c in _SHORT_FLAGS:
+                # clustered boolean flags: -xX, -xb
+                for cc in a[1:]:
+                    if cc not in _SHORT_FLAGS:
+                        sys.stderr.write(f"unknown flag -{cc} in {a}\n")
+                        raise SystemExit(2)
+                    setattr(ip, _SHORT_FLAGS[cc], True)
+            elif c in _SHORT:
+                field_, conv = _LONG[_SHORT[c]]
+                val = rest.lstrip("=")
+                if not val:
+                    i += 1
+                    val = args[i]
+                if not field_.startswith("_"):
+                    setattr(ip, field_, conv(val))
+            else:
+                sys.stderr.write(f"unknown option {a}\n{_USAGE}")
+                raise SystemExit(2)
+        else:
+            positional.append(a)
+        i += 1
+    if positional and ip.N == 0:
+        ip.N = _int(positional[0])
+    # defaults cascade (iparam_default_* in tests/common.c:586-638)
+    if ip.M == 0:
+        ip.M = ip.N
+    if ip.MB == 0:
+        ip.MB = min(max(ip.N, 1), 192 if ip.N >= 1024 else 64)
+    if ip.NB == 0:
+        ip.NB = ip.MB
+    if ip.HNB == 0:
+        ip.HNB = ip.NB
+    if ip.HMB == 0:
+        ip.HMB = ip.MB
+    if ip.LDA == 0:
+        ip.LDA = max(ip.M, ip.N)
+    return ip
+
+
+class Driver:
+    """Per-run context: devices, mesh, timing, reporting."""
+
+    def __init__(self, ip: IParam, name: str):
+        import jax
+        from dplasma_tpu.parallel import mesh as pmesh
+
+        self.ip = ip
+        self.name = name
+        self.mesh = None
+        ndev = len(jax.devices())
+        if ip.P * ip.Q > 1:
+            if ip.P * ip.Q > ndev:
+                raise SystemExit(
+                    f"grid {ip.P}x{ip.Q} needs {ip.P*ip.Q} devices, "
+                    f"have {ndev}")
+            self.mesh = pmesh.make_mesh(ip.P, ip.Q,
+                                        jax.devices()[:ip.P * ip.Q])
+        self._cm = pmesh.use_grid(self.mesh) if self.mesh else None
+        if self._cm:
+            self._cm.__enter__()
+
+    def close(self):
+        if self._cm:
+            self._cm.__exit__(None, None, None)
+            self._cm = None
+
+    # --- timing & reporting -------------------------------------------
+    def _sync(self, out):
+        import jax
+        jax.block_until_ready(out)
+        leaves = jax.tree_util.tree_leaves(out)
+        if leaves:
+            # one-element fetch: a true barrier on transports where
+            # block_until_ready returns before remote execution completes
+            x = leaves[0]
+            np.asarray(x[(0,) * getattr(x, "ndim", 0)])
+
+    def progress(self, fn: Callable, args: tuple, flops: float,
+                 label: Optional[str] = None):
+        """Compile, run nruns times, print the reference-format perf line.
+
+        ENQ = trace+compile (the taskpool-construction analog),
+        PROG = best device execution time, DEST = teardown (~0 here).
+        Returns (output, gflops).
+        """
+        import jax
+        ip, name = self.ip, label or self.name
+        jfn = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
+        t0 = time.perf_counter()
+        lowered = jfn.lower(*args)
+        compiled = lowered.compile()
+        enq = time.perf_counter() - t0
+        if ip.dot:
+            # --dot analog (tests/common.c:406-431): dump the traced
+            # program — the compiled tile DAG — for offline inspection
+            with open(ip.dot, "w") as f:
+                f.write(lowered.as_text())
+            if ip.rank == 0 and ip.loud >= 1:
+                print(f"#+ traced DAG written to {ip.dot}")
+        out = None
+        best = float("inf")
+        for _ in range(max(ip.nruns, 1)):
+            t0 = time.perf_counter()
+            out = compiled(*args)
+            self._sync(out)
+            best = min(best, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        dest = time.perf_counter() - t0
+        gflops = (flops / 1e9) / best
+        total = enq + best + dest
+        if ip.rank == 0:
+            print("[****] TIME(s) %12.5f : %s\tPxQxg= %3d %-3d %d NB= %4d "
+                  "N= %7d : %14f gflops - ENQ&PROG&DEST %12.5f : %14f gflops"
+                  " - ENQ %12.5f - DEST %12.5f"
+                  % (best, name, ip.P, ip.Q, ip.gpus, ip.NB, ip.N,
+                     gflops, total, (flops / 1e9) / total, enq, dest))
+            if ip.loud >= 5:
+                print('<DartMeasurement name="performance" '
+                      'type="numeric/double"\n'
+                      '                 encoding="none" compression="none">\n'
+                      f'{gflops:g}\n</DartMeasurement>')
+            sys.stdout.flush()
+        return out, gflops
+
+    def report_check(self, what: str, residual, ok) -> int:
+        res = float(np.asarray(residual))
+        status = "SUCCESS" if bool(ok) else "FAILED"
+        if self.ip.rank == 0:
+            print(f"[{status}] {what} residual = {res:e}")
+        return 0 if bool(ok) else 1
+
+
+def run_driver(name: str, body: Callable[[Driver], int],
+               argv: Optional[list[str]] = None) -> int:
+    """Entry point shared by every testing_* driver.
+
+    ``name`` is e.g. ``testing_dpotrf``; the precision letter after
+    ``testing_`` selects the dtype (the reference's precision-generated
+    binaries, ref tests/CMakeLists.txt:16-81).
+    """
+    ip = IParam()
+    base = name.rsplit("/", 1)[-1]
+    if base.startswith("testing_") and base[8] in PRECISIONS:
+        ip.prec = base[8]
+    ip = parse_arguments(sys.argv[1:] if argv is None else argv, ip)
+    if ip.N <= 0:
+        sys.stderr.write("missing matrix dimension (-N)\n" + _USAGE)
+        return 2
+    import os
+
+    import jax
+    # this image preimports jax (sitecustomize), so env platform selection
+    # must be re-applied via config (same workaround as tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if ip.prec in ("d", "z"):
+        jax.config.update("jax_enable_x64", True)
+    drv = Driver(ip, base)
+    try:
+        ret = body(drv) or 0
+    finally:
+        drv.close()
+    return ret

@@ -1,0 +1,685 @@
+"""The testing_* driver bodies — analogs of the reference's 59
+``tests/testing_z*.c`` binaries (ref tests/CMakeLists.txt:16-81), sharing
+the CLI/timing harness in :mod:`dplasma_tpu.drivers.common`.
+
+Each body follows the reference driver shape (e.g.
+tests/testing_zpotrf.c:17-121): seeded generation → timed DAG execution
+with the GFLOPS print → optional ``-x`` residual verification against a
+regenerated input.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.drivers.common import Driver
+from dplasma_tpu.ops import (aux, blas3, checks, eig, generators, hqr, ldl,
+                             lu, norms, potrf as potrf_mod, qr, rbt)
+from dplasma_tpu.utils import flops as lawn41
+
+TREE_NAMES = {0: "flat", 1: "greedy", 2: "fibonacci", 3: "binary",
+              4: "greedy1p"}
+CRITERIA = {0: "alternating", 1: "higham_sum", 2: "mumps", 3: "random"}
+
+
+def _is_complex(dtype):
+    return jnp.issubdtype(dtype, jnp.complexfloating)
+
+
+def _gen(drv: Driver, M, N, seed_off=0, kind="rnt", bump=None):
+    ip = drv.ip
+    dt = ip.prec_dtype
+    if kind == "he":
+        return generators.plghe(bump if bump is not None else float(N),
+                                N, ip.NB, seed=ip.seed + seed_off, dtype=dt)
+    if kind == "sy":
+        return generators.plgsy(bump if bump is not None else float(N),
+                                N, ip.NB, seed=ip.seed + seed_off, dtype=dt)
+    return generators.plrnt(M, N, ip.MB, ip.NB, seed=ip.seed + seed_off,
+                            dtype=dt)
+
+
+def _put(drv: Driver, A: TileMatrix) -> TileMatrix:
+    if drv.mesh is None:
+        return A
+    from dplasma_tpu.parallel import mesh as pmesh
+    return A.like(pmesh.device_put2d(A.data, drv.mesh))
+
+
+# ---------------------------------------------------------------- BLAS-3
+
+def gemm(drv: Driver):
+    ip = drv.ip
+    cplx = _is_complex(ip.prec_dtype)
+    A = _put(drv, _gen(drv, ip.M, ip.K))
+    B = _put(drv, _gen(drv, ip.K, ip.N, 1))
+    C = _put(drv, _gen(drv, ip.M, ip.N, 2))
+    alpha, beta = (0.51, -0.42)
+    out, _ = drv.progress(
+        lambda a, b, c: blas3.gemm(alpha, a, b, beta, c),
+        (A, B, C), lawn41.gemm(ip.M, ip.N, ip.K, cplx))
+    if ip.check:
+        ref = alpha * (A.to_dense() @ B.to_dense()) + beta * C.to_dense()
+        got = out.to_dense()
+        eps = jnp.finfo(ref.real.dtype).eps
+        r = jnp.max(jnp.abs(ref - got)) / (jnp.max(jnp.abs(ref)) + 1.0)
+        return drv.report_check("GEMM", r, r < 60 * eps * ip.K)
+    return 0
+
+
+def _sym_update(drv: Driver, op, nflops, rank2: bool):
+    ip = drv.ip
+    A = _put(drv, _gen(drv, ip.N, ip.K))
+    C0 = _gen(drv, ip.N, ip.N, 2, kind="he" if op in (blas3.herk,
+                                                     blas3.her2k) else "sy")
+    C = _put(drv, C0)
+    if rank2:
+        B = _put(drv, _gen(drv, ip.N, ip.K, 1))
+        args, fn = (A, B, C), lambda a, b, c: op(0.7, a, b, 0.3, c,
+                                                uplo="L", trans="N")
+    else:
+        args, fn = (A, C), lambda a, c: op(0.7, a, 0.3, c,
+                                           uplo="L", trans="N")
+    drv.progress(fn, args, nflops)
+    return 0
+
+
+def syrk(drv):
+    ip = drv.ip
+    return _sym_update(drv, blas3.syrk,
+                       lawn41.syrk(ip.K, ip.N, _is_complex(ip.prec_dtype)),
+                       False)
+
+
+def herk(drv):
+    ip = drv.ip
+    return _sym_update(drv, blas3.herk,
+                       lawn41.syrk(ip.K, ip.N, _is_complex(ip.prec_dtype)),
+                       False)
+
+
+def syr2k(drv):
+    ip = drv.ip
+    return _sym_update(drv, blas3.syr2k,
+                       lawn41.syr2k(ip.K, ip.N, _is_complex(ip.prec_dtype)),
+                       True)
+
+
+def her2k(drv):
+    ip = drv.ip
+    return _sym_update(drv, blas3.her2k,
+                       lawn41.syr2k(ip.K, ip.N, _is_complex(ip.prec_dtype)),
+                       True)
+
+
+def _symm_like(drv: Driver, op):
+    ip = drv.ip
+    A = _put(drv, _gen(drv, ip.M, ip.M, 0,
+                       kind="he" if op is blas3.hemm else "sy"))
+    B = _put(drv, _gen(drv, ip.M, ip.N, 1))
+    C = _put(drv, _gen(drv, ip.M, ip.N, 2))
+    drv.progress(lambda a, b, c: op(0.7, a, b, 0.3, c, side="L", uplo="L"),
+                 (A, B, C),
+                 lawn41.symm("L", ip.M, ip.N, _is_complex(ip.prec_dtype)))
+    return 0
+
+
+def symm(drv):
+    return _symm_like(drv, blas3.symm)
+
+
+def hemm(drv):
+    return _symm_like(drv, blas3.hemm)
+
+
+def trmm(drv: Driver):
+    ip = drv.ip
+    A = _put(drv, _gen(drv, ip.M, ip.M, 0, kind="he"))
+    B = _put(drv, _gen(drv, ip.M, ip.N, 1))
+    drv.progress(
+        lambda a, b: blas3.trmm(1.0, a, b, side="L", uplo="L"), (A, B),
+        lawn41.trmm("L", ip.M, ip.N, _is_complex(ip.prec_dtype)))
+    return 0
+
+
+def trsm(drv: Driver):
+    ip = drv.ip
+    A = _put(drv, _gen(drv, ip.M, ip.M, 0, kind="he"))
+    B0 = _gen(drv, ip.M, ip.N, 1)
+    B = _put(drv, B0)
+    out, _ = drv.progress(
+        lambda a, b: blas3.trsm(1.0, a, b, side="L", uplo="L"), (A, B),
+        lawn41.trsm("L", ip.M, ip.N, _is_complex(ip.prec_dtype)))
+    if ip.check:
+        X = out
+        R = blas3.trmm(1.0, A, X, side="L", uplo="L")
+        nb = norms.lange(B0, "F")
+        r = norms.lange(aux.geadd(R, B, -1.0, 1.0), "F") / nb
+        eps = jnp.finfo(jnp.real(jnp.zeros((), ip.prec_dtype)).dtype).eps
+        return drv.report_check("TRSM", r, r < 60 * eps * ip.M)
+    return 0
+
+
+# --------------------------------------------------------------- POTRF
+
+def potrf(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
+    A = _put(drv, A0)
+    L, _ = drv.progress(lambda a: potrf_mod.potrf(a, "L"), (A,),
+                        lawn41.potrf(ip.N, _is_complex(ip.prec_dtype)))
+    ret = 0
+    if ip.check:
+        r, ok = checks.check_potrf(A0, L, "L")
+        ret |= drv.report_check("POTRF", r, ok)
+        B = _gen(drv, ip.N, ip.K, 1)
+        X = potrf_mod.potrs(L, _put(drv, B), "L")
+        r, ok = checks.check_axmb(A0, B, X, uplo="L")
+        ret |= drv.report_check("POTRS |b-Ax|", r, ok)
+    return ret
+
+
+def potrs(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
+    L = potrf_mod.potrf(_put(drv, A0), "L")
+    B = _gen(drv, ip.N, ip.K, 1)
+    X, _ = drv.progress(lambda l, b: potrf_mod.potrs(l, b, "L"),
+                        (L, _put(drv, B)),
+                        lawn41.potrs(ip.N, ip.K,
+                                     _is_complex(ip.prec_dtype)))
+    if ip.check:
+        r, ok = checks.check_axmb(A0, B, X, uplo="L")
+        return drv.report_check("POTRS |b-Ax|", r, ok)
+    return 0
+
+
+def posv(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
+    B = _gen(drv, ip.N, ip.K, 1)
+    cplx = _is_complex(ip.prec_dtype)
+    out, _ = drv.progress(
+        lambda a, b: potrf_mod.posv(a, b, "L"), (_put(drv, A0), _put(drv, B)),
+        lawn41.potrf(ip.N, cplx) + lawn41.potrs(ip.N, ip.K, cplx))
+    if ip.check:
+        _, X = out
+        r, ok = checks.check_axmb(A0, B, X, uplo="L")
+        return drv.report_check("POSV |b-Ax|", r, ok)
+    return 0
+
+
+def potri(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
+    L = potrf_mod.potrf(_put(drv, A0), "L")
+    Ainv, _ = drv.progress(lambda l: potrf_mod.potri(l, "L"), (L,),
+                           lawn41.potri(ip.N, _is_complex(ip.prec_dtype)))
+    if ip.check or ip.check_inv:
+        r, ok = checks.check_inverse(A0, Ainv, uplo="L")
+        return drv.report_check("POTRI", r, ok)
+    return 0
+
+
+def poinv(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
+    Ainv, _ = drv.progress(lambda a: potrf_mod.poinv(a, "L"),
+                           (_put(drv, A0),),
+                           lawn41.potri(ip.N, _is_complex(ip.prec_dtype))
+                           + lawn41.potrf(ip.N, _is_complex(ip.prec_dtype)))
+    if ip.check or ip.check_inv:
+        r, ok = checks.check_inverse(A0, Ainv, uplo="L")
+        return drv.report_check("POINV", r, ok)
+    return 0
+
+
+def trtri(drv: Driver):
+    ip = drv.ip
+    A = _put(drv, _gen(drv, ip.N, ip.N, 0, kind="he"))
+    drv.progress(lambda a: potrf_mod.trtri(a, "L", "N"), (A,),
+                 lawn41.trtri(ip.N, _is_complex(ip.prec_dtype)))
+    return 0
+
+
+def lauum(drv: Driver):
+    ip = drv.ip
+    A = _put(drv, _gen(drv, ip.N, ip.N, 0, kind="he"))
+    drv.progress(lambda a: potrf_mod.lauum(a, "L"), (A,),
+                 lawn41.lauum(ip.N, _is_complex(ip.prec_dtype)))
+    return 0
+
+
+# ------------------------------------------------------------------ QR
+
+def geqrf(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.M, ip.N)
+    out, _ = drv.progress(qr.geqrf, (_put(drv, A0),),
+                          lawn41.geqrf(ip.M, ip.N,
+                                       _is_complex(ip.prec_dtype)))
+    if ip.check:
+        Af, Tf = out
+        Q = qr.ungqr(Af, Tf).to_dense()
+        R = jnp.triu(Af.to_dense()[:min(ip.M, ip.N), :])
+        ret = 0
+        r, ok = checks.check_qr(A0, Q, R)
+        ret |= drv.report_check("|A-QR|", r, ok)
+        r, ok = checks.check_orthogonality(Q)
+        ret |= drv.report_check("|I-Q'Q|", r, ok)
+        return ret
+    return 0
+
+
+def gelqf(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.M, ip.N)
+    out, _ = drv.progress(qr.gelqf, (_put(drv, A0),),
+                          lawn41.gelqf(ip.M, ip.N,
+                                       _is_complex(ip.prec_dtype)))
+    if ip.check:
+        Af, Tf = out
+        Q = qr.unglq(Af, Tf).to_dense()
+        L = jnp.tril(Af.to_dense()[:, :min(ip.M, ip.N)])
+        ref = A0.to_dense()
+        eps = jnp.finfo(ref.real.dtype).eps
+        r = jnp.max(jnp.abs(ref - L @ Q)) / (jnp.max(jnp.abs(ref)) + 1.0)
+        return drv.report_check("|A-LQ|", r, r < 60 * eps * max(ip.M, ip.N))
+    return 0
+
+
+def ungqr(drv: Driver):
+    ip = drv.ip
+    Af, Tf = qr.geqrf(_put(drv, _gen(drv, ip.M, ip.N)))
+    out, _ = drv.progress(qr.ungqr, (Af, Tf),
+                          lawn41.ungqr(ip.M, ip.N, ip.N,
+                                       _is_complex(ip.prec_dtype)))
+    if ip.check:
+        r, ok = checks.check_orthogonality(out.to_dense())
+        return drv.report_check("|I-Q'Q|", r, ok)
+    return 0
+
+
+def unglq(drv: Driver):
+    ip = drv.ip
+    Af, Tf = qr.gelqf(_put(drv, _gen(drv, ip.M, ip.N)))
+    drv.progress(qr.unglq, (Af, Tf),
+                 lawn41.ungqr(ip.N, ip.M, ip.M,
+                              _is_complex(ip.prec_dtype)))
+    return 0
+
+
+def unmqr(drv: Driver):
+    ip = drv.ip
+    Af, Tf = qr.geqrf(_put(drv, _gen(drv, ip.M, ip.M)))
+    C = _put(drv, _gen(drv, ip.M, ip.N, 1))
+    drv.progress(lambda a, t, c: qr.unmqr("L", "N", a, t, c), (Af, Tf, C),
+                 lawn41.unmqr("L", ip.M, ip.N, ip.M,
+                              _is_complex(ip.prec_dtype)))
+    return 0
+
+
+def unmlq(drv: Driver):
+    ip = drv.ip
+    Af, Tf = qr.gelqf(_put(drv, _gen(drv, ip.M, ip.M)))
+    C = _put(drv, _gen(drv, ip.M, ip.N, 1))
+    drv.progress(lambda a, t, c: qr.unmlq("L", "N", a, t, c), (Af, Tf, C),
+                 lawn41.unmqr("L", ip.M, ip.N, ip.M,
+                              _is_complex(ip.prec_dtype)))
+    return 0
+
+
+def gels(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.M, ip.N)
+    B = _gen(drv, max(ip.M, ip.N), ip.K, 1)
+    cplx = _is_complex(ip.prec_dtype)
+    out, _ = drv.progress(qr.gels, (_put(drv, A0), _put(drv, B)),
+                          lawn41.geqrf(ip.M, ip.N, cplx)
+                          + lawn41.unmqr("L", ip.M, ip.K, ip.N, cplx))
+    if ip.check:
+        # least squares: A^H (A x - b) == 0
+        Ad, Xd = A0.to_dense(), out.to_dense()[:ip.N]
+        res = Ad.conj().T @ (Ad @ Xd - B.to_dense()[:ip.M])
+        nrm = jnp.linalg.norm(Ad) ** 2 * jnp.linalg.norm(Xd)
+        eps = jnp.finfo(res.real.dtype).eps
+        r = jnp.linalg.norm(res) / (nrm * eps * max(ip.M, ip.N))
+        return drv.report_check("GELS normal eq", r, r < 60)
+    return 0
+
+
+def _hqr_tree_from_ip(drv: Driver, MT: int):
+    ip = drv.ip
+    return hqr.hqr_tree(
+        MT,
+        llvl=TREE_NAMES.get(ip.lowlvl_tree, "greedy"),
+        hlvl=TREE_NAMES.get(ip.highlvl_tree, "flat"),
+        a=ip.qr_a if ip.qr_a > 0 else 1,
+        p=ip.qr_p if ip.qr_p > 0 else max(ip.P, 1),
+    )
+
+
+def geqrf_hqr(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.M, ip.N)
+    tree = _hqr_tree_from_ip(drv, A0.desc.MT)
+    out, _ = drv.progress(
+        lambda a: hqr.geqrf_param(tree, a), (_put(drv, A0),),
+        lawn41.geqrf(ip.M, ip.N, _is_complex(ip.prec_dtype)))
+    if ip.check:
+        Af, Tts, Ttt = out
+        Q = hqr.ungqr_param(tree, Af, Tts, Ttt).to_dense()
+        R = jnp.triu(Af.to_dense()[:min(ip.M, ip.N), :])
+        ret = 0
+        r, ok = checks.check_qr(A0, Q, R)
+        ret |= drv.report_check("|A-QR|", r, ok)
+        r, ok = checks.check_orthogonality(Q)
+        ret |= drv.report_check("|I-Q'Q|", r, ok)
+        return ret
+    return 0
+
+
+def gelqf_hqr(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.M, ip.N)
+    tree = _hqr_tree_from_ip(drv, A0.desc.NT)
+    drv.progress(lambda a: hqr.gelqf_param(tree, a), (_put(drv, A0),),
+                 lawn41.gelqf(ip.M, ip.N, _is_complex(ip.prec_dtype)))
+    return 0
+
+
+def geqrf_systolic(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.M, ip.N)
+    tree = hqr.systolic_tree(A0.desc.MT, p=max(ip.qr_p, 1),
+                             q=max(ip.qr_a, 1))
+    out, _ = drv.progress(
+        lambda a: hqr.geqrf_param(tree, a), (_put(drv, A0),),
+        lawn41.geqrf(ip.M, ip.N, _is_complex(ip.prec_dtype)))
+    if ip.check:
+        Af, Tts, Ttt = out
+        Q = hqr.ungqr_param(tree, Af, Tts, Ttt).to_dense()
+        R = jnp.triu(Af.to_dense()[:min(ip.M, ip.N), :])
+        r, ok = checks.check_qr(A0, Q, R)
+        return drv.report_check("|A-QR|", r, ok)
+    return 0
+
+
+# ------------------------------------------------------------------ LU
+
+def _lu_flops(ip):
+    return lawn41.getrf(ip.M, ip.N, _is_complex(ip.prec_dtype))
+
+
+def getrf_nopiv(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he")   # diag-dominant-ish, safe
+    LU, _ = drv.progress(lu.getrf_nopiv, (_put(drv, A0),), _lu_flops(ip))
+    if ip.check:
+        B = _gen(drv, ip.N, ip.K, 1)
+        Y = blas3.trsm(1.0, LU, _put(drv, B), side="L", uplo="L",
+                       trans="N", diag="U")
+        X = blas3.trsm(1.0, LU, Y, side="L", uplo="U", trans="N")
+        r, ok = checks.check_axmb(A0, B, X)
+        return drv.report_check("GETRF_NOPIV |b-Ax|", r, ok)
+    return 0
+
+
+def getrf_1d(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N)
+    out, _ = drv.progress(lu.getrf_1d, (_put(drv, A0),), _lu_flops(ip))
+    if ip.check:
+        LU, perm = out
+        B = _gen(drv, ip.N, ip.K, 1)
+        X = lu.getrs("N", LU, perm, _put(drv, B))
+        r, ok = checks.check_axmb(A0, B, X)
+        return drv.report_check("GETRF |b-Ax|", r, ok)
+    return 0
+
+
+def getrf_ptgpanel(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N)
+    out, _ = drv.progress(lu.getrf_ptgpanel, (_put(drv, A0),),
+                          _lu_flops(ip))
+    if ip.check:
+        LU, perm = out
+        B = _gen(drv, ip.N, ip.K, 1)
+        X = lu.trsmpl_ptgpanel(LU, perm, _put(drv, B))
+        X = blas3.trsm(1.0, LU, X, side="L", uplo="U")
+        r, ok = checks.check_axmb(A0, B, X)
+        return drv.report_check("GETRF_PTGPANEL |b-Ax|", r, ok)
+    return 0
+
+
+def getrf_incpiv(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N)
+    out, _ = drv.progress(lu.getrf_incpiv, (_put(drv, A0),), _lu_flops(ip))
+    if ip.check:
+        LU, Lc, piv = out
+        B = _gen(drv, ip.N, ip.K, 1)
+        X = lu.getrs_incpiv(LU, Lc, piv, _put(drv, B))
+        r, ok = checks.check_axmb(A0, B, X)
+        return drv.report_check("GETRF_INCPIV |b-Ax|", r, ok)
+    return 0
+
+
+def getrf_qrf(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N)
+    crit = CRITERIA.get(ip.criteria, "higham_sum")
+    alpha = ip.alpha if ip.alpha > 0 else 100.0
+    out, _ = drv.progress(
+        lambda a: lu.getrf_qrf(a, criterion=crit, alpha=alpha),
+        (_put(drv, A0),), _lu_flops(ip))
+    if ip.check:
+        LU, Tm, lu_tab = out
+        B = _gen(drv, ip.N, ip.K, 1)
+        X = lu.getrs_qrf(LU, Tm, lu_tab, _put(drv, B))
+        r, ok = checks.check_axmb(A0, B, X)
+        return drv.report_check("GETRF_QRF |b-Ax|", r, ok)
+    return 0
+
+
+def gesv(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N)
+    B = _gen(drv, ip.N, ip.K, 1)
+    cplx = _is_complex(ip.prec_dtype)
+    out, _ = drv.progress(lu.gesv_1d, (_put(drv, A0), _put(drv, B)),
+                          lawn41.getrf(ip.N, ip.N, cplx)
+                          + lawn41.getrs(ip.N, ip.K, cplx))
+    if ip.check:
+        X = out[-1] if isinstance(out, tuple) else out
+        r, ok = checks.check_axmb(A0, B, X)
+        return drv.report_check("GESV |b-Ax|", r, ok)
+    return 0
+
+
+def gesv_incpiv(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N)
+    B = _gen(drv, ip.N, ip.K, 1)
+    cplx = _is_complex(ip.prec_dtype)
+    out, _ = drv.progress(lu.gesv_incpiv, (_put(drv, A0), _put(drv, B)),
+                          lawn41.getrf(ip.N, ip.N, cplx)
+                          + lawn41.getrs(ip.N, ip.K, cplx))
+    if ip.check:
+        X = out[-1] if isinstance(out, tuple) else out
+        r, ok = checks.check_axmb(A0, B, X)
+        return drv.report_check("GESV_INCPIV |b-Ax|", r, ok)
+    return 0
+
+
+# ---------------------------------------------------------- eig/svd/ldl
+
+def heev(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he", bump=0.0)
+    out, _ = drv.progress(lambda a: eig.heev(a, "L"), (_put(drv, A0),),
+                          lawn41.heev(ip.N, _is_complex(ip.prec_dtype)))
+    if ip.check:
+        w = out[0] if isinstance(out, tuple) else out
+        ref = jnp.linalg.eigvalsh(A0.to_dense())
+        r = jnp.max(jnp.abs(jnp.sort(w) - jnp.sort(ref))) / (
+            jnp.max(jnp.abs(ref)) + 1.0)
+        eps = jnp.finfo(jnp.real(jnp.zeros((), ip.prec_dtype)).dtype).eps
+        return drv.report_check("HEEV eigenvalues", r, r < 60 * eps * ip.N)
+    return 0
+
+
+def hetrd(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he", bump=0.0)
+    drv.progress(lambda a: eig.hetrd(a, "L"), (_put(drv, A0),),
+                 lawn41.heev(ip.N, _is_complex(ip.prec_dtype)))
+    return 0
+
+
+def gesvd(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.M, ip.N)
+    out, _ = drv.progress(eig.gesvd, (_put(drv, A0),),
+                          lawn41.gebrd(ip.M, ip.N,
+                                       _is_complex(ip.prec_dtype)))
+    if ip.check:
+        s = out[0] if isinstance(out, tuple) else out
+        ref = jnp.linalg.svd(A0.to_dense(), compute_uv=False)
+        k = min(len(jnp.atleast_1d(s)), len(ref))
+        r = jnp.max(jnp.abs(jnp.sort(s)[-k:] - jnp.sort(ref)[-k:])) / (
+            ref.max() + 1.0)
+        eps = jnp.finfo(jnp.real(jnp.zeros((), ip.prec_dtype)).dtype).eps
+        return drv.report_check("GESVD singular values", r,
+                                r < 60 * eps * max(ip.M, ip.N))
+    return 0
+
+
+def gebrd(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.M, ip.N)
+    drv.progress(eig.gebrd, (_put(drv, A0),),
+                 lawn41.gebrd(ip.M, ip.N, _is_complex(ip.prec_dtype)))
+    return 0
+
+
+def hetrf(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
+    out, _ = drv.progress(lambda a: ldl.hetrf(a, "L"), (_put(drv, A0),),
+                          lawn41.hetrf(ip.N, _is_complex(ip.prec_dtype)))
+    if ip.check:
+        B = _gen(drv, ip.N, ip.K, 1)
+        X = ldl.hetrs(out, _put(drv, B))
+        r, ok = checks.check_axmb(A0, B, X, uplo="L")
+        return drv.report_check("HETRF |b-Ax|", r, ok)
+    return 0
+
+
+def hebut(drv: Driver):
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
+    B = _gen(drv, ip.N, ip.K, 1)
+    depth = max(ip.butterfly_level, 1)
+    out, _ = drv.progress(
+        lambda a, b: rbt.hesv_rbt(a, b, "L", seed=ip.seed, depth=depth),
+        (_put(drv, A0), _put(drv, B)),
+        lawn41.hetrf(ip.N, _is_complex(ip.prec_dtype)))
+    if ip.check:
+        _, X = out
+        r, ok = checks.check_axmb(A0, B, X, uplo="L")
+        return drv.report_check("HESV_RBT |b-Ax|", r, ok)
+    return 0
+
+
+# -------------------------------------------------------------- norms/aux
+
+def _norm_driver(drv: Driver, fn, kind="rnt"):
+    ip = drv.ip
+    A = _put(drv, _gen(drv, ip.M, ip.N, 0, kind=kind))
+    for nrm in ("M", "1", "I", "F"):
+        val, _ = drv.progress(lambda a, n=nrm: fn(a, n), (A,),
+                              float(ip.M) * ip.N, label=f"{drv.name}:{nrm}")
+        if ip.loud >= 2 and ip.rank == 0:
+            print(f"  ||A||_{nrm} = {float(val):e}")
+    return 0
+
+
+def lange(drv):
+    return _norm_driver(drv, norms.lange)
+
+
+def lanhe(drv):
+    return _norm_driver(drv, lambda a, n: norms.lanhe(a, n, "L"), kind="he")
+
+
+def lansy(drv):
+    return _norm_driver(drv, lambda a, n: norms.lansy(a, n, "L"), kind="sy")
+
+
+def lantr(drv):
+    return _norm_driver(drv, lambda a, n: norms.lantr(a, n, "L", "N"))
+
+
+def lanm2(drv: Driver):
+    ip = drv.ip
+    A = _put(drv, _gen(drv, ip.M, ip.N))
+    val, _ = drv.progress(norms.lanm2, (A,), 2.0 * ip.M * ip.N * 20)
+    if ip.check:
+        ref = jnp.linalg.norm(A.to_dense(), 2)
+        r = jnp.abs(val - ref) / ref
+        return drv.report_check("LANM2 vs SVD", r, r < 1e-2)
+    return 0
+
+
+def geadd(drv: Driver):
+    ip = drv.ip
+    A = _put(drv, _gen(drv, ip.M, ip.N))
+    B = _put(drv, _gen(drv, ip.M, ip.N, 1))
+    drv.progress(lambda a, b: aux.geadd(a, b, 0.7, 0.3), (A, B),
+                 2.0 * ip.M * ip.N)
+    return 0
+
+
+def tradd(drv: Driver):
+    ip = drv.ip
+    A = _put(drv, _gen(drv, ip.M, ip.N))
+    B = _put(drv, _gen(drv, ip.M, ip.N, 1))
+    drv.progress(lambda a, b: aux.tradd(a, b, 0.7, 0.3, uplo="L"), (A, B),
+                 1.0 * ip.M * ip.N)
+    return 0
+
+
+def print_matrix(drv: Driver):
+    ip = drv.ip
+    A = _gen(drv, ip.M, ip.N)
+    if ip.rank == 0:
+        print(A)
+        if ip.loud >= 3:
+            print(A.to_dense())
+    return 0
+
+
+#: registry: algo name (precision-less) -> driver body
+DRIVERS = {
+    "gemm": gemm, "symm": symm, "hemm": hemm,
+    "syrk": syrk, "herk": herk, "syr2k": syr2k, "her2k": her2k,
+    "trmm": trmm, "trsm": trsm,
+    "potrf": potrf, "potrs": potrs, "posv": posv,
+    "potri": potri, "poinv": poinv, "trtri": trtri, "lauum": lauum,
+    "geqrf": geqrf, "gelqf": gelqf, "ungqr": ungqr, "unglq": unglq,
+    "unmqr": unmqr, "unmlq": unmlq, "gels": gels,
+    "geqrf_hqr": geqrf_hqr, "gelqf_hqr": gelqf_hqr,
+    "geqrf_systolic": geqrf_systolic,
+    "getrf_nopiv": getrf_nopiv, "getrf_1d": getrf_1d, "getrf": getrf_1d,
+    "getrf_ptgpanel": getrf_ptgpanel, "getrf_incpiv": getrf_incpiv,
+    "getrf_qrf": getrf_qrf,
+    "gesv": gesv, "gesv_incpiv": gesv_incpiv,
+    "heev": heev, "hetrd": hetrd, "gesvd": gesvd, "gebrd": gebrd,
+    "hetrf": hetrf, "hebut": hebut,
+    "lange": lange, "lanhe": lanhe, "lansy": lansy, "lantr": lantr,
+    "lanm2": lanm2,
+    "geadd": geadd, "tradd": tradd, "print": print_matrix,
+}
