@@ -1,5 +1,5 @@
 from dplasma_tpu.ops import (aux, blas3, checks, generators, hqr, info,
-                             lu, map as map_ops, norms, potrf, qr)
+                             lu, map as map_ops, matgen, norms, potrf, qr)
 
 __all__ = ["aux", "blas3", "checks", "generators", "hqr", "info", "lu",
-           "map_ops", "norms", "potrf", "qr"]
+           "map_ops", "matgen", "norms", "potrf", "qr"]

@@ -1,0 +1,463 @@
+"""Special test-matrix generators (the pltmg family + latms).
+
+Reference surface: ``dplasma_zpltmg(mtxtype, A, seed)`` with the
+dplasmaMatrix* enum (ref src/include/dplasma/constants.h:164-203,
+src/zpltmg_wrapper.c), per-tile kernels core_zpltmg*.c and four
+dedicated JDFs (zpltmg_{chebvand,fiedler,hankel,toeppd}.jdf), plus
+``dplasma_zlatms`` (singular-value-controlled matrices,
+src/zlatms_wrapper.c, used by tests/testing_zgesvd.c:99).
+
+TPU-native design: every generator is a closed-form elementwise map of
+the global indices (one fused VPU op), deterministic under any tiling or
+sharding. Where the reference runs a row recurrence (chebvand) we use
+the Chebyshev closed form; where it QR-factorizes a skinny panel
+(condex, house, latms) we do the same with stacked MXU ops. No
+per-tile workspace plumbing (W/V vectors of the JDF versions) is
+needed — vectors are generated globally from the seed.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from dplasma_tpu.descriptors import Dist, TileDesc, TileMatrix
+from dplasma_tpu.ops.generators import _grid, _mask_mn, _uniform, _value, plrnt
+
+
+def _desc(M, N, mb, nb, dist):
+    return TileDesc(M, N, mb, nb, dist)
+
+
+def _rdtype(dtype):
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        return jnp.finfo(dtype).dtype.type
+    return dtype.type
+
+
+def _finish(desc, v, dtype):
+    return TileMatrix(_mask_mn(desc, v.astype(dtype)), desc)
+
+
+def _randvec(n, seed, dtype):
+    """Seeded random vector (U(-0.5, 0.5)), the analog of the reference's
+    workspace V vectors fed to the genvect JDFs."""
+    i = jnp.arange(n)
+    return _value(seed, i, jnp.zeros_like(i), dtype)
+
+
+def _square(M, N, who):
+    if M != N:
+        raise ValueError(f"{who} requires a square matrix, got {M}x{N}")
+
+
+# -- elementwise closed forms -----------------------------------------
+
+def hadamard(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """H(i,j) = (-1)^popcount(i & j); requires N a power of two
+    (core_zpltmg.c PlasmaMatrixHadamard)."""
+    _square(M, N, "hadamard")
+    if M & (M - 1):
+        raise ValueError("hadamard requires a power-of-two size")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    bits = (r.astype(jnp.uint32) & c.astype(jnp.uint32))
+    pop = jnp.zeros_like(bits)
+    for s in range(32):
+        pop = pop + ((bits >> s) & 1)
+    v = 1.0 - 2.0 * (pop % 2).astype(_rdtype(dtype))
+    return _finish(d, v, dtype)
+
+
+def parter(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """A(i,j) = 1/(i - j + 0.5): Toeplitz/Cauchy, singular values near pi."""
+    _square(M, N, "parter")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    v = 1.0 / (r.astype(_rdtype(dtype)) - c + 0.5)
+    return _finish(d, v, dtype)
+
+
+def ris(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """A(i,j) = 0.5/(N - i - j - 0.5) (F.N. Ris; eigenvalues cluster
+    around +-pi/2)."""
+    _square(M, N, "ris")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    v = 0.5 / (N - r.astype(_rdtype(dtype)) - c - 0.5)
+    return _finish(d, v, dtype)
+
+
+def kms(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist(), rho=0.5):
+    """Kac-Murdock-Szego Toeplitz: A(i,j) = rho^|i-j| (SPD for
+    0 < |rho| < 1)."""
+    _square(M, N, "kms")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    v = jnp.asarray(rho, _rdtype(dtype)) ** jnp.abs(r - c)
+    return _finish(d, v, dtype)
+
+
+def moler(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """SPD U^T U with U unit upper triangular of -1s: diagonal i+1,
+    off-diagonal min(i,j) - 1 (0-based)."""
+    _square(M, N, "moler")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    v = jnp.where(r == c, (r + 1.0), jnp.minimum(r, c) - 1.0)
+    return _finish(d, v.astype(_rdtype(dtype)), dtype)
+
+
+def riemann(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """B(2:n+1, 2:n+1) with B(i,j) = i-1 if i | j else -1."""
+    _square(M, N, "riemann")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    ii, jj = r + 2, c + 2
+    v = jnp.where(jj % ii == 0, (ii - 1.0), -1.0)
+    return _finish(d, v.astype(_rdtype(dtype)), dtype)
+
+
+def lehmer(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """SPD A(i,j) = min(i,j)/max(i,j) (1-based)."""
+    _square(M, N, "lehmer")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    rd = _rdtype(dtype)
+    v = jnp.minimum(r, c).astype(rd) + 1.0
+    v = v / (jnp.maximum(r, c) + 1.0)
+    return _finish(d, v, dtype)
+
+
+def minij(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """SPD A(i,j) = min(i,j) (1-based)."""
+    _square(M, N, "minij")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    v = (jnp.minimum(r, c) + 1).astype(_rdtype(dtype))
+    return _finish(d, v, dtype)
+
+
+def invhess(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """gallery('invhess', 1:n): lower triangle j+1, strict upper -(i+1);
+    inverse is upper Hessenberg."""
+    _square(M, N, "invhess")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    v = jnp.where(c <= r, (c + 1.0), -(r + 1.0))
+    return _finish(d, v.astype(_rdtype(dtype)), dtype)
+
+
+def cauchy(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """C(i,j) = 1/(i + j) with 1-based indices."""
+    _square(M, N, "cauchy")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    v = 1.0 / (r.astype(_rdtype(dtype)) + c + 2.0)
+    return _finish(d, v, dtype)
+
+
+def hilb(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """Hilbert matrix H(i,j) = 1/(i + j - 1) (1-based)."""
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    v = 1.0 / (r.astype(_rdtype(dtype)) + c + 1.0)
+    return _finish(d, v, dtype)
+
+
+def lotkin(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """Hilbert with first row set to ones; ill-conditioned,
+    nonsymmetric."""
+    A = hilb(M, N, mb, nb, seed, dtype, dist)
+    data = A.data.at[0, :].set(jnp.asarray(1.0, A.dtype))
+    return TileMatrix(data, A.desc).zero_pad()
+
+
+def orthog(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """Orthogonal eigenvector matrix of the second-difference matrix:
+    Q(i,j) = sqrt(2/(n+1)) sin((i+1)(j+1) pi / (n+1))."""
+    _square(M, N, "orthog")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    rd = _rdtype(dtype)
+    scale = math.pi / (N + 1.0)
+    v = math.sqrt(2.0 / (N + 1.0)) * jnp.sin(
+        (r + 1.0).astype(rd) * (c + 1.0) * scale)
+    return _finish(d, v, dtype)
+
+
+def wilkinson(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """Wilkinson eigenvalue test matrix W_n: symmetric tridiagonal,
+    diagonal (n - 2 min(i, n-1-i) - 1)/2, off-diagonals 1."""
+    _square(M, N, "wilkinson")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    rd = _rdtype(dtype)
+    diag = (N - 2.0 * jnp.minimum(r, N - 1 - r) - 1.0) / 2.0
+    v = jnp.where(r == c, diag.astype(rd), 0.0)
+    v = jnp.where(jnp.abs(r - c) == 1, jnp.asarray(1.0, rd), v)
+    return _finish(d, v, dtype)
+
+
+def foster(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """Foster's pathological case for partial-pivoting LU (k=h=c=1)."""
+    _square(M, N, "foster")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    rd = _rdtype(dtype)
+    kh = 1.0  # k*h with the reference defaults k=h=c=1
+    v = jnp.zeros((d.Mp, d.Np), rd)
+    v = jnp.where(r > c, jnp.asarray(-kh, rd), v)
+    v = jnp.where(c == 0, jnp.asarray(-kh / 2.0, rd), v)
+    v = jnp.where(c == N - 1, jnp.asarray(-1.0, rd), v)
+    diag = jnp.where(c == 0, 1.0,
+                     jnp.where(c == N - 1, 1.0 - 1.0 - kh / 2.0,
+                               1.0 - kh / 2.0))
+    v = jnp.where(r == c, diag.astype(rd), v)
+    return _finish(d, v, dtype)
+
+
+def wright(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """Wright's pathological case for partial-pivoting LU (h=0.01,
+    two-step exponential-integrator structure)."""
+    _square(M, N, "wright")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    rd = _rdtype(dtype)
+    v = jnp.where(r == c, jnp.asarray(1.0, rd), 0.0)
+    v = jnp.where((r == c + 2) & (c % 2 == 0), jnp.asarray(-0.9048, rd), v)
+    v = jnp.where((r == c + 3) & (c % 2 == 0), jnp.asarray(-1.2092, rd), v)
+    v = jnp.where((r == c + 2) & (c % 2 == 1), jnp.asarray(-0.8270, rd), v)
+    v = jnp.where((r == c + 3) & (c % 2 == 1), jnp.asarray(-1.3499, rd), v)
+    v = jnp.where((c == M - 2) & (r == 0), jnp.asarray(1.0, rd), v)
+    v = jnp.where((c == M - 1) & (r == 1), jnp.asarray(1.0, rd), v)
+    return _finish(d, v, dtype)
+
+
+def dorr(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist(), theta=0.01):
+    """Dorr matrix: row-diagonally-dominant ill-conditioned tridiagonal
+    (core_zpltmg.c PlasmaMatrixDorr, theta default 0.01)."""
+    _square(M, N, "dorr")
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    rd = _rdtype(dtype)
+    h = 1.0 / (N + 1.0)
+    term = theta / (h * h)
+    half = (N + 1) // 2
+    jj = c.astype(rd)
+    first = c < half
+    # column jj: above-diagonal (r == c-1), diagonal, below-diagonal (r == c+1)
+    above = jnp.where(first | (c == half),
+                      -term - (0.5 - jj * h) / h, -term)
+    diag = jnp.where(first, 2.0 * term + (0.5 - (jj + 1.0) * h) / h,
+                     2.0 * term - (0.5 - (jj + 1.0) * h) / h)
+    below = jnp.where(first & (c + 1 != half), -term,
+                      -term + (0.5 - (jj + 2.0) * h) / h)
+    v = jnp.zeros_like(jj)
+    v = jnp.where(r == c - 1, above, v)
+    v = jnp.where(r == c, diag, v)
+    v = jnp.where(r == c + 1, below, v)
+    return _finish(d, v, dtype)
+
+
+# -- seeded-vector forms ----------------------------------------------
+
+def fiedler(M, N, mb, nb, seed=3872, dtype=jnp.float32, dist=Dist()):
+    """A(i,j) = |c(i) - c(j)| with seeded random c
+    (zpltmg_fiedler.jdf)."""
+    _square(M, N, "fiedler")
+    d = _desc(M, N, mb, nb, dist)
+    rd = _rdtype(dtype)
+    n = max(d.Mp, d.Np)
+    vvec = _uniform(seed, jnp.arange(n), jnp.zeros((n,), jnp.int32), rd)
+    v = jnp.abs(vvec[:d.Mp, None] - vvec[None, :d.Np])
+    return _finish(d, v, dtype)
+
+
+def hankel(M, N, mb, nb, seed=3872, dtype=jnp.float32, dist=Dist()):
+    """Symmetric Hankel from a seeded vector: A(i,j) = v(i+j)
+    (zpltmg_hankel.jdf)."""
+    d = _desc(M, N, mb, nb, dist)
+    vvec = _randvec(d.Mp + d.Np, seed, dtype)
+    r, c = _grid(d)
+    v = vvec[r + c]
+    return _finish(d, v, dtype)
+
+
+def circul(M, N, mb, nb, seed=3872, dtype=jnp.float32, dist=Dist()):
+    """Circulant of a seeded random first column: A(i,j) =
+    v((j - i) mod N) (core_zpltmg_circul.c)."""
+    _square(M, N, "circul")
+    d = _desc(M, N, mb, nb, dist)
+    vvec = _randvec(M, seed, dtype)
+    r, c = _grid(d)
+    v = vvec[(c - r + M) % M]
+    return _finish(d, v, dtype)
+
+
+def compan(M, N, mb, nb, seed=3872, dtype=jnp.float32, dist=Dist()):
+    """Companion-form matrix of a seeded random polynomial: ones on the
+    subdiagonal, first row u(2:n)/u(1) with the leading entry zeroed —
+    the reference's (unnegated) variant, matched exactly
+    (core_zpltmg.c PlasmaMatrixCompan: zplrnt row scaled by 1/v0, then
+    A(0,0) restored to 0)."""
+    _square(M, N, "compan")
+    d = _desc(M, N, mb, nb, dist)
+    u = _randvec(N + 1, seed, dtype)
+    row0 = u[1:] / u[0]
+    row0 = row0.at[0].set(jnp.asarray(0.0, row0.dtype))
+    r, c = _grid(d)
+    v = jnp.where(r == c + 1, jnp.asarray(1.0, row0.dtype), 0.0)
+    v = v.at[0, :].set(jnp.pad(row0[:N], (0, d.Np - N)))
+    return _finish(d, v, dtype)
+
+
+def toeppd(M, N, mb, nb, seed=3872, dtype=jnp.float32, dist=Dist(),
+           terms: int | None = None):
+    """SPD Toeplitz: A(i,j) = sum_k w_k cos(t_k (i-j)) with seeded
+    w in (0,1), t in (0, 2 pi) (core_zpltmg_toeppd.c)."""
+    _square(M, N, "toeppd")
+    d = _desc(M, N, mb, nb, dist)
+    m = terms if terms is not None else N
+    rd = _rdtype(dtype)
+    idx = jnp.arange(m)
+    zero = jnp.zeros_like(idx)
+    w = _uniform(seed, idx, zero, rd) + 0.5
+    t = 2.0 * math.pi * (_uniform(seed, idx, zero + 1, rd) + 0.5)
+    # Toeplitz: value depends only on the lag k = i - j in (-N, N)
+    lags = jnp.arange(-(d.Mp - 1), d.Np).astype(rd)
+    prof = (w[None, :] * jnp.cos(lags[:, None] * t[None, :])).sum(axis=1)
+    r, c = _grid(d)
+    v = prof[(r - c) + (d.Mp - 1)]
+    return _finish(d, v, dtype)
+
+
+def demmel(M, N, mb, nb, seed=3872, dtype=jnp.float32, dist=Dist()):
+    """Row-graded random matrix after Demmel: A(i,j) = r(i,j) *
+    10^(14 i / n) * (1 if i == j else 1e-7), r seeded random — the
+    reference's variant of D*(I + 1e-7 rand), matched exactly
+    (core_zpltmg.c PlasmaMatrixDemmel scales the random diagonal by dii,
+    not 1 + 1e-7 r)."""
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    rd = _rdtype(dtype)
+    rand = _value(seed, r, c, dtype)
+    dii = jnp.asarray(10.0, rd) ** (14.0 * r.astype(rd) / M)
+    v = rand * dii.astype(rand.dtype) * jnp.where(
+        r == c, 1.0, 1e-7).astype(rand.dtype)
+    return _finish(d, v, dtype)
+
+
+def chebvand(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist()):
+    """Chebyshev-Vandermonde: A(i,j) = T_i(p_j) at points
+    p = linspace(0, 1, N). The reference runs the three-term row
+    recurrence as a dedicated JDF (zpltmg_chebvand.jdf); on [0,1] the
+    closed form T_i(x) = cos(i arccos x) is one fused op."""
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    rd = _rdtype(dtype)
+    p = c.astype(rd) / max(N - 1, 1)
+    v = jnp.cos(r.astype(rd) * jnp.arccos(jnp.clip(p, 0.0, 1.0)))
+    return _finish(d, v, dtype)
+
+
+def langou(M, N, mb, nb, seed=3872, dtype=jnp.float32, dist=Dist()):
+    """Random matrix with columns N/4..N/2 scaled by eps — fails plain
+    partial pivoting, recovered by the hybrid LU/QR (getrf_qrf)
+    (core_zpltmg.c final case)."""
+    d = _desc(M, N, mb, nb, dist)
+    r, c = _grid(d)
+    v = _value(seed, r, c, dtype)
+    eps = jnp.finfo(_rdtype(dtype)).eps
+    scale = jnp.where((c >= N // 4) & (c < N // 2), eps, 1.0)
+    v = v * scale.astype(v.dtype)
+    return _finish(d, v, dtype)
+
+
+# -- QR-built forms ----------------------------------------------------
+
+def house(M, N, mb, nb, seed=3872, dtype=jnp.float32, dist=Dist()):
+    """Householder reflector of a seeded random vector:
+    A = I - tau v v^H (dplasma_zpltmg_house)."""
+    _square(M, N, "house")
+    d = _desc(M, N, mb, nb, dist)
+    x = _randvec(M, seed, dtype)
+    alpha = x[0]
+    sigma = jnp.real(jnp.vdot(x[1:], x[1:]))
+    nrm = jnp.sqrt(jnp.abs(alpha) ** 2 + sigma)
+    # real beta, as LAPACK zlarfg: H stays unitary for complex x
+    beta = jnp.where(jnp.real(alpha) >= 0, -nrm, nrm).astype(x.dtype)
+    v = x.at[0].set(alpha - beta)
+    tau = (beta - alpha) / beta
+    vn = v / v[0]
+    eye = jnp.eye(M, dtype=jnp.dtype(dtype))
+    mat = eye - tau * jnp.outer(vn, vn.conj())
+    return TileMatrix.from_dense(mat.astype(dtype), mb, nb, dist)
+
+
+def condex(M, N, mb, nb, seed=0, dtype=jnp.float32, dist=Dist(),
+           theta=100.0):
+    """Higham's counter-example for condition estimators (gallery
+    condex, k=4): A = I + theta Q Q^H, Q = orth([ones, e1,
+    (-1)^i (1 + i/(n-1))]) (core_zpltmg_condexq.c)."""
+    _square(M, N, "condex")
+    d = _desc(M, N, mb, nb, dist)
+    rd = _rdtype(dtype)
+    i = jnp.arange(M).astype(rd)
+    cols = jnp.stack([
+        jnp.ones((M,), rd),
+        jnp.zeros((M,), rd).at[0].set(1.0),
+        ((-1.0) ** i) * (1.0 + i / max(N - 1, 1)),
+    ], axis=1).astype(jnp.dtype(dtype))
+    q, _ = jnp.linalg.qr(cols)
+    mat = jnp.eye(M, dtype=q.dtype) + jnp.asarray(theta, q.dtype) * (
+        q @ q.conj().T)
+    return TileMatrix.from_dense(mat.astype(dtype), mb, nb, dist)
+
+
+def latms(M, N, mb, nb, sv, seed=3872, dtype=jnp.float32, dist=Dist()):
+    """A = U diag(sv) V^H with Haar-ish random U, V from QR of seeded
+    Gaussian-free uniforms (dplasma_zlatms semantics: spectrum
+    controlled exactly by ``sv``; used by the SVD tests,
+    tests/testing_zgesvd.c:99)."""
+    d = _desc(M, N, mb, nb, dist)
+    K = min(M, N)
+    sv = jnp.asarray(sv, dtype=_rdtype(dtype))
+    if sv.shape != (K,):
+        raise ValueError(f"need {K} singular values, got {sv.shape}")
+    gu = plrnt(M, K, mb, nb, seed=seed, dtype=dtype).to_dense()
+    gv = plrnt(N, K, mb, nb, seed=seed + 7, dtype=dtype).to_dense()
+    u, _ = jnp.linalg.qr(gu)
+    v, _ = jnp.linalg.qr(gv)
+    mat = (u * sv[None, :].astype(u.dtype)) @ v.conj().T
+    return TileMatrix.from_dense(mat.astype(dtype), mb, nb, dist)
+
+
+_DISPATCH = {
+    "random": lambda M, N, mb, nb, seed, dtype, dist: plrnt(
+        M, N, mb, nb, seed=seed, dtype=dtype, dist=dist),
+    "hadamard": hadamard, "house": house, "parter": parter, "ris": ris,
+    "kms": kms, "condex": condex, "moler": moler, "circul": circul,
+    "hankel": hankel, "compan": compan, "riemann": riemann,
+    "lehmer": lehmer, "toeppd": toeppd, "minij": minij, "fiedler": fiedler,
+    "dorr": dorr, "demmel": demmel, "chebvand": chebvand,
+    "invhess": invhess, "cauchy": cauchy, "hilb": hilb, "lotkin": lotkin,
+    "orthog": orthog, "wilkinson": wilkinson, "foster": foster,
+    "wright": wright, "langou": langou,
+}
+
+# Matrix-type vocabulary, mirroring the reference's dplasmaMatrix* enum
+# (constants.h:164-203) minus its "Unavailable" entries.
+TYPES = tuple(_DISPATCH)
+
+
+def pltmg(mtxtype: str, M: int, N: int, mb: int, nb: int, seed: int = 3872,
+          dtype=jnp.float32, dist: Dist = Dist()) -> TileMatrix:
+    """Generate a named special matrix (dplasma_zpltmg dispatch,
+    src/zpltmg_wrapper.c:480-560)."""
+    key = mtxtype.lower()
+    if key not in _DISPATCH:
+        raise ValueError(f"unknown matrix type {mtxtype!r}; "
+                         f"known: {sorted(_DISPATCH)}")
+    return _DISPATCH[key](M, N, mb, nb, seed=seed, dtype=dtype, dist=dist)
