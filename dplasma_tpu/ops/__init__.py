@@ -1,5 +1,7 @@
-from dplasma_tpu.ops import (aux, blas3, checks, generators, hqr, info,
-                             lu, map as map_ops, matgen, norms, potrf, qr)
+from dplasma_tpu.ops import (aux, blas3, checks, eig, gemm, generators,
+                             hqr, info, ldl, lu, map as map_ops, matgen,
+                             norms, potrf, qr, rbt)
 
-__all__ = ["aux", "blas3", "checks", "generators", "hqr", "info", "lu",
-           "map_ops", "matgen", "norms", "potrf", "qr"]
+__all__ = ["aux", "blas3", "checks", "eig", "gemm", "generators", "hqr",
+           "info", "ldl", "lu", "map_ops", "matgen", "norms", "potrf",
+           "qr", "rbt"]

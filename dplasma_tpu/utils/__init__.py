@@ -1,3 +1,3 @@
-from dplasma_tpu.utils import flops
+from dplasma_tpu.utils import config, flops
 
-__all__ = ["flops"]
+__all__ = ["config", "flops"]

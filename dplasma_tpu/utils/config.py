@@ -1,0 +1,162 @@
+"""Runtime configuration tiers.
+
+The reference exposes four tiers (SURVEY §5.6):
+
+1. CLI vocabulary — lives in ``drivers/common.py``;
+2. MCA-style params — ``--mca key value`` passthrough / env overrides
+   with a help catalog (ref tests/Testings.cmake:146,
+   share/help-dplasma.txt:1-8);
+3. environment per-precision priority limits ``[SDCZ]<FUNC>``
+   (ref src/dplasmaaux.c:58-90, documented at tests/common.c:162-164);
+4. ``dplasma_info_t`` — MPI_Info-style string kv store passed to the
+   ``_New_ex`` wrapper variants for per-operation tuning
+   (ref src/utils/dplasma_info.c, src/zgemm_wrapper.c:290-334).
+
+All four are plain host-side Python consulted at trace time — tunables
+shape the compiled program (loop blocking, lookahead, algorithm choice)
+exactly as the reference's values shaped its DAGs.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class Info:
+    """MPI_Info-style string key/value store (dplasma_info_t analog:
+    create/set/get/delete/dup/free — ref src/utils/dplasma_info.h).
+
+    Keys are case-insensitive strings; values are strings (callers parse
+    numbers), mirroring ``dplasma_info_set(info, "DPLASMA:GEMM:GPU:B",
+    "64")`` usage.
+    """
+
+    def __init__(self, items: Optional[dict] = None):
+        self._kv: dict[str, str] = {}
+        if items:
+            for k, v in items.items():
+                self.set(k, v)
+
+    def set(self, key: str, value) -> None:
+        self._kv[key.upper()] = str(value)
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._kv.get(key.upper(), default)
+
+    def get_int(self, key: str, default: int) -> int:
+        v = self.get(key)
+        if v is None:
+            return default
+        try:
+            return int(v)
+        except ValueError:
+            return default
+
+    def delete(self, key: str) -> None:
+        self._kv.pop(key.upper(), None)
+
+    def dup(self) -> "Info":
+        return Info(dict(self._kv))
+
+    def nkeys(self) -> int:
+        return len(self._kv)
+
+    def keys(self):
+        return list(self._kv)
+
+    def __contains__(self, key: str) -> bool:
+        return key.upper() in self._kv
+
+    def __repr__(self):
+        return f"Info({self._kv!r})"
+
+
+# -- tier 3: per-precision priority limits ----------------------------
+
+_PREC_OF_DTYPE = {"float32": "S", "float64": "D",
+                  "complex64": "C", "complex128": "Z"}
+
+
+def priority_limit(func: str, dtype=None, prec: Optional[str] = None
+                   ) -> Optional[int]:
+    """Environment lookup ``[SDCZ]<FUNC>`` → int priority/lookahead cap
+    (dplasma_aux_get_priority_limit semantics, dplasmaaux.c:58-90):
+    e.g. ``DPOTRF=4`` caps the d-precision POTRF lookahead depth."""
+    if prec is None:
+        name = None
+        if dtype is not None:
+            try:
+                import jax.numpy as jnp
+                name = jnp.dtype(dtype).name
+            except TypeError:
+                name = str(dtype)
+        prec = _PREC_OF_DTYPE.get(name, "S")
+    v = os.environ.get(f"{prec.upper()}{func.upper()}")
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+# -- tier 2: MCA-style params with a help catalog ----------------------
+
+_MCA_REGISTRY: dict[str, tuple[str, str]] = {}  # name -> (default, help)
+_MCA_OVERRIDES: dict[str, str] = {}
+
+
+def mca_register(name: str, default, help_text: str) -> None:
+    """Register a tunable with default + help text (the analog of
+    PaRSEC MCA param registration backed by share/help-dplasma.txt)."""
+    _MCA_REGISTRY[name] = (str(default), help_text)
+
+
+def mca_set(name: str, value) -> None:
+    """Programmatic/CLI override (``--mca name value`` passthrough)."""
+    _MCA_OVERRIDES[name] = str(value)
+
+
+def mca_get(name: str, default=None) -> Optional[str]:
+    """Resolution order: explicit override > env DPLASMA_MCA_<NAME>
+    (dots → underscores) > registered default > ``default``."""
+    if name in _MCA_OVERRIDES:
+        return _MCA_OVERRIDES[name]
+    env = os.environ.get(
+        "DPLASMA_MCA_" + name.upper().replace(".", "_").replace(":", "_"))
+    if env is not None:
+        return env
+    if name in _MCA_REGISTRY:
+        return _MCA_REGISTRY[name][0]
+    return None if default is None else str(default)
+
+
+def mca_get_int(name: str, default: int) -> int:
+    v = mca_get(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def mca_help() -> str:
+    """Render the registered-param catalog (help-dplasma.txt analog)."""
+    lines = []
+    for name, (default, text) in sorted(_MCA_REGISTRY.items()):
+        lines.append(f"{name} (default: {default})\n    {text}")
+    return "\n".join(lines)
+
+
+# Core registrations (mirroring tunables the reference exposes)
+mca_register("device.hbm_fraction", "0.95",
+             "Fraction of accelerator memory the streaming GEMM footprint "
+             "model may plan for (analog of "
+             "device_cuda_memory_use/number_of_blocks).")
+mca_register("gemm.lookahead", "2",
+             "Pipeline lookahead depth for paced GEMM variants (analog of "
+             "dplasma_aux_getGEMMLookahead, dplasmaaux.c:92-111).")
+mca_register("runtime.scheduler", "wavefront",
+             "Trace-time tile ordering policy (analog of the 8 PaRSEC "
+             "scheduler modules, tests/common.c:35-45).")

@@ -1,0 +1,154 @@
+"""GEMM algorithm dispatch: SUMMA, streaming, info tunables, config tiers.
+
+Mirrors dplasma_zgemm_New_ex's three-way dispatch
+(ref src/zgemm_wrapper.c:439-493) and the DPLASMA:GEMM:GPU:* info keys
+(ref src/zgemm_wrapper.c:290-334).
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dplasma_tpu.descriptors import Dist, TileMatrix
+from dplasma_tpu.ops import checks, gemm as gemm_mod, generators
+from dplasma_tpu.ops.blas3 import gemm as gemm_dot
+from dplasma_tpu.parallel import mesh as pmesh
+from dplasma_tpu.utils import config
+
+
+def mk(M, N, mb, nb, seed, dtype=jnp.float64, dist=Dist()):
+    return generators.plrnt(M, N, mb, nb, seed=seed, dtype=dtype, dist=dist)
+
+
+def run_case(fn, transa, transb, dtype=jnp.float64, M=48, N=40, K=56, nb=8):
+    Ma, Na = (M, K) if transa == "N" else (K, M)
+    Mb, Nb = (K, N) if transb == "N" else (N, K)
+    A = mk(Ma, Na, nb, nb, 11, dtype)
+    B = mk(Mb, Nb, nb, nb, 22, dtype)
+    C = mk(M, N, nb, nb, 33, dtype)
+    ref = gemm_dot(-0.7, A, B, 0.3, C, transa, transb)
+    got = fn(-0.7, A, B, 0.3, C, transa, transb)
+    r, ok = checks.check_gemm(ref, got)
+    assert ok, (transa, transb, r)
+
+
+@pytest.mark.parametrize("transa", ["N", "T"])
+@pytest.mark.parametrize("transb", ["N", "T"])
+def test_stream_matches_dot(transa, transb):
+    def fn(al, A, B, be, C, ta, tb):
+        plan = gemm_mod.GemmPlan("stream", b=2, c=3, d=2, look_ahead=2)
+        return gemm_mod.gemm_stream(al, A, B, be, C, ta, tb, plan)
+    run_case(fn, transa, transb)
+
+
+def test_stream_complex_conj():
+    def fn(al, A, B, be, C, ta, tb):
+        plan = gemm_mod.GemmPlan("stream", b=1, c=1, d=3, look_ahead=1)
+        return gemm_mod.gemm_stream(al, A, B, be, C, ta, tb, plan)
+    run_case(fn, "C", "N", dtype=jnp.complex128)
+    run_case(fn, "N", "C", dtype=jnp.complex128)
+
+
+@pytest.mark.parametrize("transa", ["N", "T"])
+@pytest.mark.parametrize("transb", ["N", "C"])
+def test_summa_matches_dot(devices8, transa, transb):
+    dt = jnp.complex128 if transb == "C" else jnp.float64
+    m = pmesh.make_mesh(2, 4, devices=devices8)
+    with pmesh.use_grid(m):
+        run_case(gemm_mod.gemm_summa, transa, transb, dtype=dt,
+                 M=48, N=40, K=64, nb=8)
+
+
+def test_summa_fallback_without_mesh():
+    # no active mesh -> silently the GSPMD dot path
+    run_case(gemm_mod.gemm_summa, "N", "N")
+
+
+def test_summa_multi_step_pipeline(devices8):
+    m = pmesh.make_mesh(2, 4, devices=devices8)
+    with pmesh.use_grid(m):
+        def fn(al, A, B, be, C, ta, tb):
+            return gemm_mod.gemm_summa(al, A, B, be, C, ta, tb,
+                                       steps_per_panel=2)
+        run_case(fn, "N", "N", M=48, N=40, K=64, nb=8)
+
+
+def test_gemm_ex_dispatch_modes(devices8):
+    A = mk(32, 32, 8, 8, 1)
+    B = mk(32, 32, 8, 8, 2)
+    C = mk(32, 32, 8, 8, 3)
+    # single device auto -> dot
+    plan = gemm_mod.plan_gemm(C, A, B)
+    assert plan.algo == "dot"
+    # mesh active -> summa
+    with pmesh.use_grid(pmesh.make_mesh(2, 4, devices=devices8)):
+        assert gemm_mod.plan_gemm(C, A, B).algo == "summa"
+        got = gemm_mod.gemm_ex(1.0, A, B, 0.0, C)
+    ref = gemm_dot(1.0, A, B, 0.0, C)
+    r, ok = checks.check_gemm(ref, got)
+    assert ok, r
+
+
+def test_gemm_ex_stream_via_info():
+    A, B, C = mk(40, 48, 8, 8, 4), mk(48, 40, 8, 8, 5), mk(40, 40, 8, 8, 6)
+    info = config.Info({"DPLASMA:GEMM:GPU:B": 2, "DPLASMA:GEMM:GPU:C": 2,
+                        "DPLASMA:GEMM:GPU:D": 1,
+                        "DPLASMA:GEMM:GPU:LOOK_AHEAD": 3})
+    plan = gemm_mod.plan_gemm(C, A, B, info=info, algo="stream")
+    assert (plan.b, plan.c, plan.d, plan.look_ahead) == (2, 2, 1, 3)
+    got = gemm_mod.gemm_ex(2.0, A, B, -1.0, C, info=info, algo="stream")
+    ref = gemm_dot(2.0, A, B, -1.0, C)
+    r, ok = checks.check_gemm(ref, got)
+    assert ok, r
+
+
+def test_footprint_triggers_stream(monkeypatch):
+    # shrink the "device memory" so the model must pick streaming
+    monkeypatch.setattr(gemm_mod, "device_memory_bytes", lambda **kw: 10_000)
+    A, B, C = mk(64, 64, 8, 8, 7), mk(64, 64, 8, 8, 8), mk(64, 64, 8, 8, 9)
+    plan = gemm_mod.plan_gemm(C, A, B)
+    assert plan.algo == "stream"
+    assert plan.b >= 1 and plan.c >= 1 and plan.d >= 1
+    # blocking respects the shrunken budget
+    item = 8
+    assert (plan.b * 8 * plan.c * 8 + plan.b * 8 * plan.d * 8
+            + plan.d * 8 * plan.c * 8) * item <= 0.25 * 10_000 or \
+        (plan.b, plan.c, plan.d) == (1, 1, 1)
+
+
+# -- config tiers ------------------------------------------------------
+
+def test_info_store_semantics():
+    i = config.Info()
+    i.set("DPLASMA:GEMM:GPU:b", 64)
+    assert i.get("dplasma:gemm:gpu:B") == "64"
+    assert i.get_int("DPLASMA:GEMM:GPU:B", 1) == 64
+    assert i.get_int("missing", 7) == 7
+    j = i.dup()
+    j.set("x", "y")
+    assert "x" in j and "x" not in i
+    assert i.nkeys() == 1
+    i.delete("DPLASMA:GEMM:GPU:B")
+    assert i.nkeys() == 0
+
+
+def test_priority_limit_env(monkeypatch):
+    monkeypatch.setenv("DPOTRF", "4")
+    assert config.priority_limit("potrf", dtype=jnp.float64) == 4
+    assert config.priority_limit("potrf", dtype=jnp.float32) is None
+    monkeypatch.setenv("ZGEQRF", "notanint")
+    assert config.priority_limit("geqrf", prec="z") is None
+
+
+def test_mca_resolution_order(monkeypatch):
+    assert config.mca_get("gemm.lookahead") == "2"  # registered default
+    monkeypatch.setenv("DPLASMA_MCA_GEMM_LOOKAHEAD", "5")
+    assert config.mca_get_int("gemm.lookahead", 0) == 5
+    config.mca_set("gemm.lookahead", 9)
+    try:
+        assert config.mca_get_int("gemm.lookahead", 0) == 9
+    finally:
+        config._MCA_OVERRIDES.clear()
+    assert "gemm.lookahead" in config.mca_help()
