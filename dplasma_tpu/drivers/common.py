@@ -288,7 +288,7 @@ class Driver:
             np.asarray(x[(0,) * getattr(x, "ndim", 0)])
 
     def progress(self, fn: Callable, args: tuple, flops: float,
-                 label: Optional[str] = None):
+                 label: Optional[str] = None, dag_fn: Callable = None):
         """Compile, run nruns times, print the reference-format perf line.
 
         ENQ = trace+compile (the taskpool-construction analog),
@@ -303,10 +303,19 @@ class Driver:
         compiled = lowered.compile()
         enq = time.perf_counter() - t0
         if ip.dot:
-            # --dot analog (tests/common.c:406-431): dump the traced
-            # program — the compiled tile DAG — for offline inspection
-            with open(ip.dot, "w") as f:
-                f.write(lowered.as_text())
+            # --dot analog (tests/common.c:406-431). When the op exposes
+            # an analytic tile-DAG builder, emit true Graphviz of task
+            # classes/priorities/owner ranks; otherwise fall back to the
+            # lowered XLA program text.
+            if dag_fn is not None:
+                from dplasma_tpu.utils.profiling import DagRecorder
+                rec = DagRecorder(enabled=True)
+                dag_fn(rec)
+                with open(ip.dot, "w") as f:
+                    f.write(rec.to_dot(name or "dag"))
+            else:
+                with open(ip.dot, "w") as f:
+                    f.write(lowered.as_text())
             if ip.rank == 0 and ip.loud >= 1:
                 print(f"#+ traced DAG written to {ip.dot}")
         out = None

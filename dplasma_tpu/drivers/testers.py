@@ -168,7 +168,8 @@ def potrf(drv: Driver):
     A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
     A = _put(drv, A0)
     L, _ = drv.progress(lambda a: potrf_mod.potrf(a, "L"), (A,),
-                        lawn41.potrf(ip.N, _is_complex(ip.prec_dtype)))
+                        lawn41.potrf(ip.N, _is_complex(ip.prec_dtype)),
+                        dag_fn=lambda rec: potrf_mod.dag(A, "L", rec))
     ret = 0
     if ip.check:
         r, ok = checks.check_potrf(A0, L, "L")

@@ -1,0 +1,202 @@
+"""DTD-style eager insert-task front-end.
+
+The reference's second programming model: instead of a precompiled
+parameterized task graph, the application inserts tile tasks dynamically
+and the runtime infers dependences from data access modes
+(``parsec_dtd_insert_task`` with PARSEC_INPUT/OUTPUT/INOUT hints —
+ref src/dtd_wrappers/dplasma_z_dtd.h:13,49-53, tests/testing_zpotrf_dtd.c).
+
+TPU-native design: :class:`TaskPool` records inserted tasks against
+:class:`~dplasma_tpu.descriptors.TileMatrix` tiles, tracking a version
+per tile (last-writer). Insertion order is a valid sequential schedule
+(PaRSEC DTD's sequential-consistency contract), so execution replays
+tasks in order inside ONE jit trace — XLA then reorders/fuses under the
+true data dependences, which is exactly the freedom the PaRSEC DTD
+scheduler had. The tracked dependences feed the same
+:class:`~dplasma_tpu.utils.profiling.DagRecorder` dot output and the
+native wavefront scheduler for inspection.
+
+Task classes for potrf/trsm/herk/gemm mirror
+``src/dtd_wrappers/dplasma_z_dtd.h``; :func:`potrf_dtd` rebuilds the
+right-looking Cholesky by insertion the way testing_zpotrf_dtd.c does.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.kernels import blas as k
+
+IN, OUT, INOUT = "IN", "OUT", "INOUT"
+
+
+@dataclasses.dataclass(frozen=True)
+class TileRef:
+    """A (matrix, i, j, mode) access — the dtd tile handle analogue."""
+    mat: int          # index of the matrix within the pool
+    i: int
+    j: int
+    mode: str
+
+    def __post_init__(self):
+        assert self.mode in (IN, OUT, INOUT), self.mode
+
+
+@dataclasses.dataclass
+class _Task:
+    fn: Callable
+    refs: Tuple[TileRef, ...]
+    name: str
+    kwargs: dict
+
+
+class TaskPool:
+    """Insert-task pool over one or more TileMatrix operands.
+
+    Usage (mirrors testing_zpotrf_dtd.c's insertion loops)::
+
+        tp = TaskPool(A)
+        tp.insert_task(fn, tp.tile(0, kk, kk, INOUT), name="potrf")
+        ...
+        (A_out,) = tp.wait()
+
+    ``fn`` receives the current tile arrays (one per ref, in order) and
+    returns the new values of the OUT/INOUT tiles (in order; a single
+    array if there is exactly one).
+    """
+
+    def __init__(self, *mats: TileMatrix):
+        assert mats, "TaskPool needs at least one TileMatrix"
+        self.mats = list(mats)
+        self.tasks: List[_Task] = []
+        # last writer task id per (mat, i, j); -1 = initial data
+        self._writer: Dict[Tuple[int, int, int], int] = {}
+        self.edges: List[Tuple[int, int]] = []
+
+    def tile(self, mat: int, i: int, j: int, mode: str = IN) -> TileRef:
+        m = self.mats[mat]
+        assert 0 <= i < m.MT and 0 <= j < m.NT, (i, j, m)
+        return TileRef(mat, i, j, mode)
+
+    def insert_task(self, fn: Callable, *refs: TileRef,
+                    name: Optional[str] = None, **kwargs) -> int:
+        """Register a task; dependences are inferred from access modes
+        (flow deps only — anti/output deps are absorbed by functional
+        updates, the version chain keeps writers ordered)."""
+        tid = len(self.tasks)
+        self.tasks.append(_Task(fn, refs, name or fn.__name__, kwargs))
+        for r in refs:
+            key = (r.mat, r.i, r.j)
+            w = self._writer.get(key, -1)
+            if r.mode in (IN, INOUT) and w >= 0:
+                self.edges.append((w, tid))
+            if r.mode in (OUT, INOUT):
+                if r.mode == OUT and w >= 0:
+                    # output dep: order writers even without a read
+                    self.edges.append((w, tid))
+                self._writer[key] = tid
+        return tid
+
+    # -- execution -----------------------------------------------------
+    def _replay(self, datas):
+        mats = [TileMatrix(d, m.desc) for d, m in zip(datas, self.mats)]
+        for t in self.tasks:
+            ins = [mats[r.mat].tile(r.i, r.j) for r in t.refs]
+            outs = t.fn(*ins, **t.kwargs)
+            wrefs = [r for r in t.refs if r.mode in (OUT, INOUT)]
+            if len(wrefs) == 1:
+                outs = (outs,)
+            assert len(outs) == len(wrefs), (t.name, len(outs), len(wrefs))
+            for r, val in zip(wrefs, outs):
+                mats[r.mat] = mats[r.mat].set_tile(r.i, r.j, val)
+        return tuple(m.data for m in mats)
+
+    def wait(self, jit: bool = True) -> Tuple[TileMatrix, ...]:
+        """Execute all inserted tasks (one traced XLA program) and
+        return the updated matrices — the parsec_dtd_taskpool_wait
+        analogue."""
+        fn = jax.jit(self._replay) if jit else self._replay
+        datas = fn(tuple(m.data for m in self.mats))
+        return tuple(TileMatrix(d, m.desc)
+                     for d, m in zip(datas, self.mats))
+
+    # -- introspection -------------------------------------------------
+    def record_dag(self, rec) -> None:
+        """Feed the tracked task DAG into a DagRecorder (--dot)."""
+        ids = []
+        for t in self.tasks:
+            ix = tuple(x for r in t.refs for x in (r.i, r.j))[:3]
+            ids.append(rec.task(t.name, *ix))
+        for s, d in self.edges:
+            rec.edge(ids[s], ids[d])
+
+    def schedule(self, lookahead: int = 0):
+        """Wavefront order of the inserted DAG via the native scheduler."""
+        from dplasma_tpu import native
+        return native.wavefront_order(len(self.tasks), self.edges,
+                                      None, lookahead)
+
+
+# ---------------------------------------------------------------------
+# Task classes (src/dtd_wrappers/dplasma_z_dtd.h analogues)
+# ---------------------------------------------------------------------
+
+def _t_potrf(akk, *, lower):
+    return k.potrf(akk, lower=lower)
+
+
+def _t_trsm(lkk, amk, *, lower):
+    if lower:
+        return k.trsm(lkk, amk, side="R", lower=True, trans="C")
+    return k.trsm(lkk, amk, side="L", lower=False, trans="C")
+
+
+def _t_herk(pan, amm, *, lower):
+    if lower:
+        return k.herk(-1.0, pan, 1.0, amm, trans="N")
+    return k.herk(-1.0, pan, 1.0, amm, trans="C")
+
+
+def _t_gemm(pm, pn, amn, *, lower):
+    if lower:
+        return k.gemm(-1.0, pm, pn, 1.0, amn, tb=True, conj_b=True)
+    return k.gemm(-1.0, pm, pn, 1.0, amn, ta=True, conj_a=True)
+
+
+def potrf_dtd(A: TileMatrix, uplo: str = "L",
+              pool: Optional[TaskPool] = None) -> TileMatrix:
+    """Right-looking tile Cholesky via task insertion — the
+    testing_zpotrf_dtd.c flow. Numerically identical to ops.potrf's
+    panel formulation; exercises the DTD runtime path."""
+    lower = uplo.upper() == "L"
+    tp = pool if pool is not None else TaskPool(A.pad_diag())
+    nt = tp.mats[0].desc.KT
+    for kk in range(nt):
+        tp.insert_task(_t_potrf, tp.tile(0, kk, kk, INOUT),
+                       name="potrf", lower=lower)
+        for m in range(kk + 1, nt):
+            pan = (m, kk) if lower else (kk, m)
+            tp.insert_task(_t_trsm, tp.tile(0, kk, kk, IN),
+                           tp.tile(0, *pan, INOUT),
+                           name="trsm", lower=lower)
+        for m in range(kk + 1, nt):
+            pan = (m, kk) if lower else (kk, m)
+            tp.insert_task(_t_herk, tp.tile(0, *pan, IN),
+                           tp.tile(0, m, m, INOUT),
+                           name="herk", lower=lower)
+            for n in range(kk + 1, m):
+                # lower: A[m,n] -= A[m,k] A[n,k]^H
+                # upper: A[n,m] -= A[k,n]^H A[k,m]
+                pm, pn = ((m, kk), (n, kk)) if lower else ((kk, n), (kk, m))
+                tgt = (m, n) if lower else (n, m)
+                tp.insert_task(_t_gemm, tp.tile(0, *pm, IN),
+                               tp.tile(0, *pn, IN),
+                               tp.tile(0, *tgt, INOUT),
+                               name="gemm", lower=lower)
+    if pool is not None:
+        return tp
+    (out,) = tp.wait()
+    return out

@@ -59,13 +59,28 @@ def dot(a, b, ta: bool = False, tb: bool = False, conj_a: bool = False,
         a = a.T
     if tb:
         b = b.T
+    from dplasma_tpu.kernels import pallas_kernels as _pk
+    if _pk.eligible(a, b):
+        return _pk.matmul(a, b, precision=_PRECISION).astype(res_dtype)
     out = jnp.matmul(a, b, precision=_PRECISION,
                      preferred_element_type=_acc_type(res_dtype))
     return out.astype(res_dtype)
 
 
 def gemm(alpha, a, b, beta, c, ta=False, tb=False, conj_a=False, conj_b=False):
-    """C = alpha op(A) op(B) + beta C (CORE_zgemm semantics)."""
+    """C = alpha op(A) op(B) + beta C (CORE_zgemm semantics).
+
+    Dispatches to the fused Pallas kernel (one HBM round-trip for C) when
+    enabled and eligible; falls back to XLA matmul + axpy otherwise.
+    """
+    from dplasma_tpu.kernels import pallas_kernels as _pk
+    if (not (conj_a or conj_b) and isinstance(alpha, (int, float))
+            and isinstance(beta, (int, float))):
+        aa = a.T if ta else a
+        bb = b.T if tb else b
+        if _pk.eligible(aa, bb, c):
+            return _pk.gemm(aa, bb, c, alpha=float(alpha), beta=float(beta),
+                            precision=_PRECISION)
     return alpha * dot(a, b, ta, tb, conj_a, conj_b) + beta * c
 
 

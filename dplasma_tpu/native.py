@@ -1,0 +1,281 @@
+"""ctypes bindings for the native runtime library (native/src/dplasma_rt.cpp).
+
+The reference keeps its runtime half in native code (PaRSEC — SURVEY
+§2.1); here the native library carries the trace-time index algebra,
+the priority wavefront scheduler, and the binary profiling writer. A
+pure-Python fallback with identical semantics keeps the package usable
+before ``make -C native`` has run; :func:`available` reports which path
+is active and tests assert both agree.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_ROOT, "native", "build", "libdplasma_rt.so")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+class _Dist(ctypes.Structure):
+    _fields_ = [("P", ctypes.c_int32), ("Q", ctypes.c_int32),
+                ("kp", ctypes.c_int32), ("kq", ctypes.c_int32),
+                ("ip", ctypes.c_int32), ("jq", ctypes.c_int32)]
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile the native library in-tree (g++). Returns success."""
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.join(_ROOT, "native")],
+            check=True, capture_output=quiet)
+    except (OSError, subprocess.CalledProcessError):
+        return False
+    global _tried
+    _tried = False  # allow reload
+    return load() is not None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.dtpu_version.restype = ctypes.c_int32
+    lib.dtpu_rank_of.restype = ctypes.c_int32
+    lib.dtpu_rank_of.argtypes = [ctypes.POINTER(_Dist), ctypes.c_int64,
+                                 ctypes.c_int64]
+    lib.dtpu_rank_grid.argtypes = [ctypes.POINTER(_Dist), ctypes.c_int64,
+                                   ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_int32)]
+    lib.dtpu_wavefront_order.restype = ctypes.c_int32
+    lib.dtpu_wavefront_order.argtypes = [
+        ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.dtpu_potrf_priority.restype = ctypes.c_int64
+    lib.dtpu_potrf_priority.argtypes = [ctypes.c_int32] + \
+        [ctypes.c_int64] * 4
+    lib.dtpu_trace_open.restype = ctypes.c_void_p
+    lib.dtpu_trace_open.argtypes = [ctypes.c_char_p]
+    lib.dtpu_trace_event.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64, ctypes.c_int64,
+                                     ctypes.c_double]
+    lib.dtpu_trace_info.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_char_p]
+    lib.dtpu_trace_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ---------------------------------------------------------------------
+# Block-cyclic owner maps
+# ---------------------------------------------------------------------
+
+def rank_grid(dist, MT: int, NT: int) -> np.ndarray:
+    """Owner rank of every tile: (MT, NT) int32 array.
+
+    ``dist`` is any object with P/Q/kp/kq/ip/jq (descriptors.Dist).
+    """
+    lib = load()
+    if lib is not None:
+        d = _Dist(dist.P, dist.Q, dist.kp, dist.kq, dist.ip, dist.jq)
+        out = np.empty((MT, NT), dtype=np.int32)
+        lib.dtpu_rank_grid(ctypes.byref(d), MT, NT,
+                           out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
+    i = np.arange(MT)[:, None]
+    j = np.arange(NT)[None, :]
+    pr = (i // dist.kp + dist.ip) % dist.P
+    pc = (j // dist.kq + dist.jq) % dist.Q
+    return (pr * dist.Q + pc).astype(np.int32)
+
+
+# ---------------------------------------------------------------------
+# Wavefront scheduler
+# ---------------------------------------------------------------------
+
+def wavefront_order(n: int, edges: Sequence[tuple],
+                    priority: Optional[Sequence[int]] = None,
+                    lookahead: int = 0) -> np.ndarray:
+    """Priority topological order of a task DAG.
+
+    ``edges`` are (pred, succ) pairs; higher ``priority`` runs earlier
+    among ready tasks; ``lookahead > 0`` bounds how far a task may
+    overtake program (id) order — the trace-time analogue of the
+    reference's lookahead pipelining (ref src/dplasmaaux.c:92-111).
+    Raises ValueError on cycles.
+    """
+    edges = list(edges)
+    pri = np.zeros(n, dtype=np.int64) if priority is None else \
+        np.asarray(priority, dtype=np.int64)
+    lib = load()
+    if lib is not None:
+        src = np.array([e[0] for e in edges], dtype=np.int64)
+        dst = np.array([e[1] for e in edges], dtype=np.int64)
+        out = np.empty(n, dtype=np.int64)
+        p64 = ctypes.POINTER(ctypes.c_int64)
+        rc = lib.dtpu_wavefront_order(
+            n, len(edges), src.ctypes.data_as(p64),
+            dst.ctypes.data_as(p64), pri.ctypes.data_as(p64),
+            lookahead, out.ctypes.data_as(p64))
+        if rc == -2:
+            raise ValueError("task graph has a cycle")
+        if rc != 0:
+            raise ValueError(f"bad task graph (rc={rc})")
+        return out
+    # Python fallback: identical semantics.
+    import heapq
+    indeg = [0] * n
+    succs = [[] for _ in range(n)]
+    for s, t in edges:
+        if not (0 <= s < n and 0 <= t < n):
+            raise ValueError("bad task graph (edge out of range)")
+        indeg[t] += 1
+        succs[s].append(t)
+    ready = [(-int(pri[v]), v) for v in range(n) if indeg[v] == 0]
+    heapq.heapify(ready)
+    order = []
+    while ready:
+        spill = []
+        item = heapq.heappop(ready)
+        if lookahead > 0:
+            while item[1] > len(order) + lookahead and ready:
+                spill.append(item)
+                item = heapq.heappop(ready)
+            if item[1] > len(order) + lookahead:
+                for idx, s in enumerate(spill):
+                    if s[1] < item[1]:
+                        spill[idx], item = item, s
+            for s in spill:
+                heapq.heappush(ready, s)
+        v = item[1]
+        order.append(v)
+        for t in succs[v]:
+            indeg[t] -= 1
+            if indeg[t] == 0:
+                heapq.heappush(ready, (-int(pri[t]), t))
+    if len(order) != n:
+        raise ValueError("task graph has a cycle")
+    return np.asarray(order, dtype=np.int64)
+
+
+_POTRF_KIND = {"potrf": 0, "trsm": 1, "herk": 2, "gemm": 3}
+
+
+def potrf_priority(kind: str, NT: int, k: int, m: int = 0,
+                   n: int = 0) -> int:
+    """Cubic POTRF critical-path priorities (ref src/zpotrf_L.jdf:58-69)."""
+    lib = load()
+    if lib is not None:
+        return int(lib.dtpu_potrf_priority(_POTRF_KIND[kind], NT, k, m, n))
+    N3 = NT ** 3
+    if kind == "potrf":
+        return N3 - (NT - k) ** 3
+    if kind in ("trsm", "herk"):
+        return N3 - ((NT - m) ** 3 + 3 * (m - k))
+    if kind == "gemm":
+        return N3 - ((NT - m) ** 3 + 3 * (m - n) + 6 * (n - k))
+    raise KeyError(kind)
+
+
+# ---------------------------------------------------------------------
+# Binary trace writer
+# ---------------------------------------------------------------------
+
+class TraceWriter:
+    """Binary profiling trace (DTPUPROF1 format; PaRSEC-trace analogue).
+
+    Uses the native writer when built, else a struct-for-struct Python
+    mirror so files are byte-compatible either way.
+    """
+
+    def __init__(self, path: str):
+        self._lib = load()
+        self._path = path
+        if self._lib is not None:
+            self._h = self._lib.dtpu_trace_open(path.encode())
+            if not self._h:
+                raise OSError(f"cannot open trace {path}")
+            self._f = None
+        else:
+            self._h = None
+            self._f = open(path, "wb")
+            self._f.write(b"DTPUPROF1")
+
+    def event(self, name: str, begin_ns: int, end_ns: int,
+              flops: float = 0.0) -> None:
+        if self._h is not None:
+            self._lib.dtpu_trace_event(self._h, name.encode(),
+                                       begin_ns, end_ns, flops)
+        else:
+            import struct
+            nb = name.encode()
+            self._f.write(b"\x01" + struct.pack("<i", len(nb)) + nb +
+                          struct.pack("<qqd", begin_ns, end_ns, flops))
+
+    def info(self, key: str, val: str) -> None:
+        if self._h is not None:
+            self._lib.dtpu_trace_info(self._h, key.encode(), val.encode())
+        else:
+            import struct
+            kb, vb = key.encode(), val.encode()
+            self._f.write(b"\x02" + struct.pack("<i", len(kb)) + kb +
+                          struct.pack("<i", len(vb)) + vb)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.dtpu_trace_close(self._h)
+            self._h = None
+        elif self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_trace(path: str):
+    """Parse a DTPUPROF1 file → (events, info) lists."""
+    import struct
+    events, info = [], {}
+    with open(path, "rb") as f:
+        magic = f.read(9)
+        if magic != b"DTPUPROF1":
+            raise ValueError(f"bad trace magic {magic!r}")
+        while True:
+            tag = f.read(1)
+            if not tag:
+                break
+            if tag == b"\x01":
+                (n,) = struct.unpack("<i", f.read(4))
+                name = f.read(n).decode()
+                b, e, fl = struct.unpack("<qqd", f.read(24))
+                events.append((name, b, e, fl))
+            elif tag == b"\x02":
+                (n,) = struct.unpack("<i", f.read(4))
+                key = f.read(n).decode()
+                (n,) = struct.unpack("<i", f.read(4))
+                info[key] = f.read(n).decode()
+            else:
+                raise ValueError(f"bad trace tag {tag!r}")
+    return events, info

@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from functools import partial
 from typing import Optional
 
 import jax
@@ -42,7 +41,7 @@ from jax.sharding import PartitionSpec as P
 
 from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.kernels import blas as k
-from dplasma_tpu.ops.blas3 import _op, _pack_like, gemm as gemm_dot
+from dplasma_tpu.ops.blas3 import _op, gemm as gemm_dot
 from dplasma_tpu.parallel import mesh as pmesh
 from dplasma_tpu.utils import config
 
@@ -91,7 +90,11 @@ def plan_gemm(C: TileMatrix, A: TileMatrix, B: TileMatrix,
         if pmesh.active() is not None:
             algo = "summa"
         else:
-            frac = float(config.mca_get("device.hbm_fraction", "0.95"))
+            try:
+                frac = float(config.mca_get("device.hbm_fraction", "0.95"))
+            except ValueError:
+                frac = 0.95  # malformed MCA value: fall back (mca_get_int
+                # semantics, ref PaRSEC MCA params SURVEY §5.6)
             if _footprint_bytes(M, N, Ka, C.dtype) > frac * \
                     device_memory_bytes():
                 algo = "stream"

@@ -67,6 +67,67 @@ def potrf(A: TileMatrix, uplo: str = "L") -> TileMatrix:
     return TileMatrix(X, A.desc)
 
 
+def dag(A: TileMatrix, uplo: str = "L", recorder=None):
+    """Record the tile-level POTRF DAG (task classes potrf/trsm/herk/gemm
+    with the cubic priorities of src/zpotrf_L.jdf:58-69,116,219 and
+    block-cyclic owner ranks) into ``recorder`` for ``--dot`` dumps.
+
+    The DAG is data-independent (pure index algebra), so it is emitted
+    analytically rather than by instrumenting the compute path — the
+    same property the reference exploits (dep expressions evaluated
+    identically on every rank, SURVEY §3.3). ``uplo='U'`` transposes the
+    tile each task lives on (A[k,m] instead of A[m,k]); the task graph
+    itself is identical by symmetry.
+    """
+    from dplasma_tpu import native
+    from dplasma_tpu.utils import profiling
+    rec = recorder if recorder is not None else profiling.recorder
+    nt = A.desc.KT
+    lower = uplo.upper() == "L"
+    ranks = native.rank_grid(A.desc.dist, nt, nt)
+    pri = native.potrf_priority
+
+    def rank_at(i, j):
+        return int(ranks[i, j] if lower else ranks[j, i])
+
+    def task(cls, ix, k, m, n, tile):
+        return rec.task(cls, *ix, priority=pri(cls, nt, k, m, n),
+                        rank=rank_at(*tile))
+
+    def potrf_t(k):
+        return task("potrf", (k,), k, 0, 0, (k, k))
+
+    def trsm_t(m, k):
+        return task("trsm", (m, k), k, m, 0, (m, k))
+
+    def herk_t(k, m):
+        return task("herk", (k, m), k, m, 0, (m, m))
+
+    def gemm_t(m, n, k):
+        return task("gemm", (m, n, k), k, m, n, (m, n))
+
+    for k in range(nt):
+        pk = potrf_t(k)
+        if k > 0:
+            rec.edge(herk_t(k - 1, k), pk, "Akk")  # last diag update
+        for m in range(k + 1, nt):
+            tr = trsm_t(m, k)
+            rec.edge(pk, tr, "Lkk")
+            if k > 0:
+                rec.edge(gemm_t(m, k, k - 1), tr, "Amk")
+            hk = herk_t(k, m)
+            rec.edge(tr, hk, "panel")
+            if k > 0:
+                rec.edge(herk_t(k - 1, m), hk, "Amm")  # accumulation chain
+            for n in range(k + 1, m):
+                gm = gemm_t(m, n, k)
+                rec.edge(tr, gm, "A")
+                rec.edge(trsm_t(n, k), gm, "B")
+                if k > 0:
+                    rec.edge(gemm_t(m, n, k - 1), gm, "C")  # chain
+    return rec
+
+
 def potrs(A: TileMatrix, B: TileMatrix, uplo: str = "L") -> TileMatrix:
     """Solve A X = B given the Cholesky factor (dplasma_zpotrs:
     two blocked TRSM sweeps)."""

@@ -120,19 +120,35 @@ def gebut(A: TileMatrix, seed_u: int = 3872, seed_v: int = 2354,
     X = A.zero_pad().data
     sub = X[:M, :N]
     sub = _rows_apply(sub, seed_u, depth, "T")
-    sub = _rows_apply(sub.T, seed_v, depth, "N").T
+    # A·V = (V^T A^T)^T — column application is mode "T" on the transpose
+    sub = _rows_apply(sub.T, seed_v, depth, "T").T
     return A.like(X.at[:M, :N].set(sub))
 
 
 def hesv_rbt(A: TileMatrix, B: TileMatrix, uplo: str = "L",
-             seed: int = 3872, depth: int = 2):
+             seed: int = 3872, depth: int = 2, refine: int = 2):
     """Solve a Hermitian-indefinite system without pivoting via
     RBT + LDL^H (the reference's hebut → hetrf → backtransform flow,
     tests/testing_zhebut.c): Ã = U^T A U; x = U Ã^{-1} U^T b.
     A must store BOTH triangles (or be densified by the caller) since
-    the butterfly mixes them. Returns (factor, X)."""
+    the butterfly mixes them.
+
+    ``refine`` steps of iterative refinement against the ORIGINAL A
+    recover the accuracy the pivot-free factorization gives up to
+    element growth (the standard RBT companion; the reference's qrf
+    hybrid makes the same robustness-vs-pivoting trade, SURVEY §2.2
+    "LU variants"). Returns (factor, X)."""
     At = hebut(A, seed, depth)
     F = ldl.hetrf(At, uplo)
-    y = gebmm(B, seed, depth, trans="T")
-    z = ldl.hetrs(F, y)
-    return F, gebmm(z, seed, depth, trans="N")
+
+    def solve(rhs):
+        y = gebmm(rhs, seed, depth, trans="T")
+        return gebmm(ldl.hetrs(F, y), seed, depth, trans="N")
+
+    from dplasma_tpu.kernels import blas as k
+    X = solve(B)
+    a = A.zero_pad().data
+    for _ in range(max(refine, 0)):
+        R = B.like(B.zero_pad().data - k.dot(a, X.data))
+        X = X.like(X.data + solve(R).data)
+    return F, X

@@ -1,0 +1,183 @@
+"""Profiling / tracing / DAG-dump subsystem.
+
+The reference exposes three observability layers (SURVEY §5.1):
+
+* PaRSEC's binary trace with driver-stamped run metadata
+  (``PROFILING_SAVE_[di]INFO``, ref tests/common.h:198-231);
+* a Graphviz dump of the executed DAG (``--dot`` → ``--parsec_dot``,
+  ref tests/common.c:137,406-431);
+* compile-time kernel printf tracing (``printlog``,
+  ref src/dplasmajdf.h:21-31).
+
+TPU-native equivalents here:
+
+* :class:`Profile` — wall-clock event spans + run-metadata kv pairs,
+  written through the native binary trace writer
+  (:mod:`dplasma_tpu.native`); ``save_info``/``save_dinfo`` mirror the
+  reference macros. Device-side op timing comes from JAX's own profiler
+  (:func:`jax_trace` context manager wraps it).
+* :class:`DagRecorder` — trace-time tile-DAG recording: ops register
+  task instances and dependence edges as they trace; ``to_dot()``
+  emits Graphviz with the reference's node shape (task class + index
+  tuple), priority annotations, and owner-rank coloring.
+* :func:`printlog` — env-gated kernel trace print
+  (``DPLASMA_TRACE_KERNELS``).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dplasma_tpu import native
+
+_TRACE_KERNELS = bool(int(os.environ.get("DPLASMA_TRACE_KERNELS", "0")))
+
+
+def printlog(fmt: str, *args) -> None:
+    """Kernel-level trace print, compiled out unless DPLASMA_TRACE_KERNELS
+    is set (ref src/dplasmajdf.h:21-31)."""
+    if _TRACE_KERNELS:
+        print("[dplasma_tpu] " + (fmt % args if args else fmt), flush=True)
+
+
+class Profile:
+    """Run profile: named spans + metadata, serialized as DTPUPROF1.
+
+    Usage::
+
+        prof = Profile()
+        with prof.span("potrf", flops=1e9):
+            run()
+        prof.save_dinfo("GFLOPS", gf)      # ref common.h:198-231
+        prof.write("run.prof")
+    """
+
+    def __init__(self):
+        self.events: List[Tuple[str, int, int, float]] = []
+        self.info: Dict[str, str] = {}
+        self._t0 = time.time_ns()
+        self.info["cwd"] = os.getcwd()
+        self.info["start_time"] = str(self._t0)
+
+    @contextlib.contextmanager
+    def span(self, name: str, flops: float = 0.0):
+        b = time.time_ns()
+        try:
+            yield
+        finally:
+            self.events.append((name, b, time.time_ns(), flops))
+
+    def save_info(self, key: str, value) -> None:
+        self.info[str(key)] = str(value)
+
+    def save_dinfo(self, key: str, value: float) -> None:
+        self.info[str(key)] = repr(float(value))
+
+    def write(self, path: str) -> None:
+        with native.TraceWriter(path) as t:
+            for k, v in self.info.items():
+                t.info(k, v)
+            for name, b, e, fl in self.events:
+                t.event(name, b, e, fl)
+
+
+@contextlib.contextmanager
+def jax_trace(logdir: str):
+    """Device-side op/kernel tracing via the JAX profiler (the XLA-level
+    counterpart of PaRSEC's task trace)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------
+# Trace-time DAG recording (--dot)
+# ---------------------------------------------------------------------
+
+@dataclass
+class _Task:
+    tid: int
+    cls: str
+    index: Tuple[int, ...]
+    priority: int = 0
+    rank: int = -1
+    flops: float = 0.0
+
+
+@dataclass
+class DagRecorder:
+    """Records the tile DAG as ops trace; emits Graphviz.
+
+    Ops call :meth:`task` for each task instance and :meth:`edge` for
+    each flow dependence. ``enabled`` gates all recording so the hooks
+    are free when off (the default), like the reference's ``--dot``
+    plumbing (ref tests/common.c:406-431).
+    """
+
+    enabled: bool = False
+    tasks: List[_Task] = field(default_factory=list)
+    edges: List[Tuple[int, int, str]] = field(default_factory=list)
+    _names: Dict[Tuple[str, Tuple[int, ...]], int] = field(
+        default_factory=dict)
+
+    def task(self, cls: str, *index: int, priority: int = 0,
+             rank: int = -1, flops: float = 0.0) -> int:
+        """Register (or look up) task instance cls(*index); returns id."""
+        if not self.enabled:
+            return -1
+        key = (cls, tuple(int(i) for i in index))
+        tid = self._names.get(key)
+        if tid is None:
+            tid = len(self.tasks)
+            self._names[key] = tid
+            self.tasks.append(_Task(tid, cls, key[1], priority, rank, flops))
+        return tid
+
+    def edge(self, src: int, dst: int, label: str = "") -> None:
+        if self.enabled and src >= 0 and dst >= 0:
+            self.edges.append((src, dst, label))
+
+    # -- output --------------------------------------------------------
+    _PALETTE = ["#66c2a5", "#fc8d62", "#8da0cb", "#e78ac3", "#a6d854",
+                "#ffd92f", "#e5c494", "#b3b3b3"]
+
+    def to_dot(self, name: str = "dag") -> str:
+        lines = [f'digraph "{name}" {{', "  node [shape=box];"]
+        classes = sorted({t.cls for t in self.tasks})
+        color = {c: self._PALETTE[i % len(self._PALETTE)]
+                 for i, c in enumerate(classes)}
+        for t in self.tasks:
+            idx = ", ".join(map(str, t.index))
+            label = f"{t.cls}({idx})"
+            extra = f"\\nprio={t.priority}" if t.priority else ""
+            rank = f"\\nrank={t.rank}" if t.rank >= 0 else ""
+            lines.append(
+                f'  t{t.tid} [label="{label}{extra}{rank}" '
+                f'style=filled fillcolor="{color[t.cls]}"];')
+        for s, d, lab in self.edges:
+            attr = f' [label="{lab}"]' if lab else ""
+            lines.append(f"  t{s} -> t{d}{attr};")
+        lines.append("}")
+        return "\n".join(lines)
+
+    def write_dot(self, path: str, name: str = "dag") -> None:
+        with open(path, "w") as f:
+            f.write(self.to_dot(name))
+
+    def order(self, lookahead: int = 0):
+        """Priority wavefront linearization of the recorded DAG (native
+        scheduler; the analogue of PaRSEC's priority queues)."""
+        pri = [t.priority for t in self.tasks]
+        return native.wavefront_order(
+            len(self.tasks), [(s, d) for s, d, _ in self.edges], pri,
+            lookahead)
+
+
+# Global recorder the ops consult; drivers flip .enabled for --dot.
+recorder = DagRecorder()

@@ -1,0 +1,199 @@
+// dplasma_tpu native runtime library.
+//
+// The reference's runtime half lives in native code (PaRSEC: scheduler,
+// comm engine, profiling — consumed via parsec_* APIs, SURVEY §2.1; the
+// block-cyclic owner algebra is parsec_matrix_block_cyclic_t, ref
+// tests/testing_zpotrf.c:100-103). This library is the TPU framework's
+// native counterpart for the trace-time work that is pure index algebra
+// and bookkeeping:
+//
+//   * 2-D block-cyclic owner maps with supertiles (KP/KQ) and grid
+//     offsets (IP/JQ) — used by the layout layer;
+//   * priority-aware wavefront linearization of tile DAGs (the analogue
+//     of PaRSEC's priority schedulers, ref tests/common.c:35-45, and the
+//     cubic priority formulas of src/zpotrf_L.jdf:58-69);
+//   * a binary profiling trace writer (the analogue of PaRSEC's
+//     profiling subsystem, ref tests/common.h:198-231).
+//
+// Everything is exposed as a minimal C ABI consumed via ctypes
+// (dplasma_tpu/native.py); a pure-Python fallback mirrors the semantics
+// when the shared library has not been built.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// Block-cyclic index algebra (parsec_matrix_block_cyclic_t semantics).
+// ---------------------------------------------------------------------
+
+typedef struct {
+  int32_t P, Q;    // process grid
+  int32_t kp, kq;  // supertile (k-cyclic) repetition factors
+  int32_t ip, jq;  // grid offsets of tile (0, 0)
+} dtpu_dist_t;
+
+// Owner rank (row-major in the P x Q grid) of tile (i, j).
+int32_t dtpu_rank_of(const dtpu_dist_t* d, int64_t i, int64_t j) {
+  int64_t pr = ((i / d->kp) + d->ip) % d->P;
+  int64_t pc = ((j / d->kq) + d->jq) % d->Q;
+  return (int32_t)(pr * d->Q + pc);
+}
+
+// Fill rank-of for a whole MT x NT tile grid (row-major out buffer).
+void dtpu_rank_grid(const dtpu_dist_t* d, int64_t MT, int64_t NT,
+                    int32_t* out) {
+  for (int64_t i = 0; i < MT; ++i)
+    for (int64_t j = 0; j < NT; ++j) out[i * NT + j] = dtpu_rank_of(d, i, j);
+}
+
+// Number of tiles of a 1-D cyclic axis owned by coordinate p.
+int64_t dtpu_local_count_1d(int64_t nt, int32_t procs, int32_t k, int32_t off,
+                            int32_t p) {
+  int64_t count = 0;
+  for (int64_t t0 = 0; t0 < nt; t0 += k) {
+    int64_t owner = ((t0 / k) + off) % procs;
+    if (owner == p) count += std::min((int64_t)k, nt - t0);
+  }
+  return count;
+}
+
+// ---------------------------------------------------------------------
+// Wavefront scheduler.
+//
+// Tasks are nodes 0..n-1 with dependency edges (pred -> succ) and
+// int64 priorities (higher runs earlier among ready tasks). Produces a
+// topological order equivalent to PaRSEC's priority-queue scheduling of
+// the DAG on one worker. `lookahead` bounds how far a high-priority
+// task may overtake program order (0 = unbounded, pure priority).
+// ---------------------------------------------------------------------
+
+int32_t dtpu_wavefront_order(int64_t n, int64_t n_edges, const int64_t* src,
+                             const int64_t* dst, const int64_t* priority,
+                             int64_t lookahead, int64_t* out_order) {
+  std::vector<int64_t> indeg(n, 0);
+  std::vector<int64_t> head(n, -1), next(n_edges, -1), eto(n_edges, 0);
+  for (int64_t e = 0; e < n_edges; ++e) {
+    int64_t s = src[e], t = dst[e];
+    if (s < 0 || s >= n || t < 0 || t >= n) return -1;
+    indeg[t]++;
+    eto[e] = t;
+    next[e] = head[s];
+    head[s] = e;
+  }
+  // max-heap on (priority, -task_id) → deterministic tie-break by id.
+  typedef std::pair<int64_t, int64_t> pq_item;  // (priority, -id)
+  std::priority_queue<pq_item> ready;
+  std::vector<pq_item> spill;
+  for (int64_t v = 0; v < n; ++v)
+    if (indeg[v] == 0) ready.push({priority ? priority[v] : 0, -v});
+  int64_t emitted = 0;
+  while (!ready.empty()) {
+    // Pop until a task inside the lookahead window appears; park the
+    // overtakers. With lookahead == 0 the first pop wins (pure priority).
+    pq_item pick = ready.top();
+    ready.pop();
+    if (lookahead > 0) {
+      spill.clear();
+      while (-pick.second > emitted + lookahead && !ready.empty()) {
+        spill.push_back(pick);
+        pick = ready.top();
+        ready.pop();
+      }
+      if (-pick.second > emitted + lookahead) {
+        // nothing in-window is ready: take the smallest id to progress.
+        for (auto& s : spill)
+          if (-s.second < -pick.second) std::swap(s, pick);
+      }
+      for (auto& s : spill) ready.push(s);
+    }
+    int64_t v = -pick.second;
+    out_order[emitted++] = v;
+    for (int64_t e = head[v]; e != -1; e = next[e]) {
+      int64_t t = eto[e];
+      if (--indeg[t] == 0) ready.push({priority ? priority[t] : 0, -t});
+    }
+  }
+  return emitted == n ? 0 : -2;  // -2: cycle
+}
+
+// Cubic POTRF priority formulas (ref src/zpotrf_L.jdf:58-69): the
+// critical-path-length-derived priorities for each task class.
+int64_t dtpu_potrf_priority(int32_t kind, int64_t NT, int64_t k, int64_t m,
+                            int64_t n) {
+  const int64_t N3 = NT * NT * NT;
+  switch (kind) {
+    case 0:  // POTRF(k)
+      return N3 - ((NT - k) * (NT - k) * (NT - k));
+    case 1:  // TRSM(m, k)
+      return N3 - ((NT - m) * (NT - m) * (NT - m) + 3 * (m - k));
+    case 2:  // HERK(k, m)
+      return N3 - ((NT - m) * (NT - m) * (NT - m) + 3 * (m - k));
+    case 3:  // GEMM(m, n, k)
+      return N3 -
+             ((NT - m) * (NT - m) * (NT - m) + 3 * (m - n) + 6 * (n - k));
+    default:
+      return 0;
+  }
+}
+
+// ---------------------------------------------------------------------
+// Binary profiling trace (PaRSEC profiling analogue).
+//
+// Format: "DTPUPROF1" magic, then records:
+//   u8 tag (1=event, 2=info), followed by
+//   event: i32 name_len, name bytes, i64 begin_ns, i64 end_ns, f64 flops
+//   info:  i32 key_len, key, i32 val_len, val
+// ---------------------------------------------------------------------
+
+typedef struct {
+  FILE* f;
+} dtpu_trace_t;
+
+dtpu_trace_t* dtpu_trace_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  const char magic[9] = "DTPUPROF";
+  fwrite(magic, 1, 8, f);
+  fputc('1', f);
+  dtpu_trace_t* t = new dtpu_trace_t{f};
+  return t;
+}
+
+static void write_str(FILE* f, const char* s) {
+  int32_t n = (int32_t)strlen(s);
+  fwrite(&n, 4, 1, f);
+  fwrite(s, 1, n, f);
+}
+
+void dtpu_trace_event(dtpu_trace_t* t, const char* name, int64_t begin_ns,
+                      int64_t end_ns, double flops) {
+  if (!t) return;
+  fputc(1, t->f);
+  write_str(t->f, name);
+  fwrite(&begin_ns, 8, 1, t->f);
+  fwrite(&end_ns, 8, 1, t->f);
+  fwrite(&flops, 8, 1, t->f);
+}
+
+void dtpu_trace_info(dtpu_trace_t* t, const char* key, const char* val) {
+  if (!t) return;
+  fputc(2, t->f);
+  write_str(t->f, key);
+  write_str(t->f, val);
+}
+
+void dtpu_trace_close(dtpu_trace_t* t) {
+  if (!t) return;
+  fclose(t->f);
+  delete t;
+}
+
+int32_t dtpu_version() { return 1; }
+
+}  // extern "C"

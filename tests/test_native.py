@@ -1,0 +1,146 @@
+"""Native runtime library: C++ path vs Python fallback parity, trace
+roundtrip, DAG recording/dot (ref: PaRSEC scheduler/profiling contract,
+SURVEY §2.1; --dot at tests/common.c:406-431)."""
+import os
+
+import numpy as np
+import pytest
+
+from dplasma_tpu import native
+from dplasma_tpu.descriptors import Dist, TileMatrix
+from dplasma_tpu.ops import potrf as potrf_mod
+from dplasma_tpu.utils.profiling import DagRecorder, Profile
+
+
+def _with_fallback(fn):
+    """Run fn under native lib (if present) and under the fallback."""
+    r1 = fn()
+    lib, tried = native._lib, native._tried
+    native._lib, native._tried = None, True
+    try:
+        r2 = fn()
+    finally:
+        native._lib, native._tried = lib, tried
+    return r1, r2
+
+
+def test_rank_grid_parity():
+    d = Dist(P=2, Q=3, kp=2, kq=3, ip=1, jq=2)
+    a, b = _with_fallback(lambda: native.rank_grid(d, 11, 13))
+    assert (a == b).all()
+    # owner formula: ((i/kp)+ip)%P, ((j/kq)+jq)%Q (ref common.c:79-93)
+    assert a[0, 0] == ((0 + 1) % 2) * 3 + ((0 + 2) % 3)
+    assert a[4, 9] == ((2 + 1) % 2) * 3 + ((3 + 2) % 3)
+
+
+def test_wavefront_priority_and_cycle():
+    edges = [(0, 2), (1, 2), (2, 3), (1, 4)]
+    pri = [0, 10, 0, 0, 100]
+    a, b = _with_fallback(lambda: native.wavefront_order(5, edges, pri))
+    assert (a == b).all()
+    pos = {int(v): i for i, v in enumerate(a)}
+    for s, t in edges:
+        assert pos[s] < pos[t]
+    assert pos[1] == 0  # highest-priority source first
+    with pytest.raises(ValueError):
+        native.wavefront_order(2, [(0, 1), (1, 0)])
+
+
+def test_wavefront_lookahead_bounds_overtaking():
+    def run():
+        return native.wavefront_order(6, [], [0, 0, 0, 0, 0, 100],
+                                      lookahead=2)
+    a, b = _with_fallback(run)
+    assert (a == b).all()
+    # task 5 cannot run before position 3 (5 <= emitted+2)
+    assert list(a).index(5) >= 3
+
+
+def test_potrf_priorities_monotone_on_critical_path():
+    NT = 8
+    p = [native.potrf_priority("potrf", NT, k) for k in range(NT)]
+    assert p == sorted(p)  # later panels are more urgent
+    a, b = _with_fallback(
+        lambda: native.potrf_priority("gemm", 10, 1, 5, 3))
+    assert a == b
+
+
+def test_trace_roundtrip(tmp_path):
+    path = os.path.join(tmp_path, "t.prof")
+
+    def write():
+        with native.TraceWriter(path) as t:
+            t.info("SCHED", "wavefront")
+            t.event("potrf(0)", 10, 20, 1e6)
+        return native.read_trace(path)
+    a, b = _with_fallback(write)
+    assert a == b
+    events, info = a
+    assert events == [("potrf(0)", 10, 20, 1e6)]
+    assert info["SCHED"] == "wavefront"
+
+
+def test_profile_spans(tmp_path):
+    prof = Profile()
+    with prof.span("potrf", flops=2e9):
+        pass
+    prof.save_dinfo("GFLOPS", 123.5)
+    p = os.path.join(tmp_path, "run.prof")
+    prof.write(p)
+    events, info = native.read_trace(p)
+    assert events[0][0] == "potrf" and events[0][3] == 2e9
+    assert float(info["GFLOPS"]) == 123.5
+
+
+def test_potrf_dag_dot():
+    A = TileMatrix.zeros(16, 16, 4, 4, dist=Dist(P=2, Q=2))
+    rec = DagRecorder(enabled=True)
+    potrf_mod.dag(A, "L", rec)
+    names = {(t.cls, t.index) for t in rec.tasks}
+    NT = 4
+    assert ("potrf", (0,)) in names and ("potrf", (NT - 1,)) in names
+    assert ("trsm", (1, 0)) in names
+    assert ("gemm", (2, 1, 0)) in names
+    # every non-root task has an incoming edge
+    roots = {t.tid for t in rec.tasks} - {d for _, d, _ in rec.edges}
+    assert roots == {0}  # only potrf(0)
+    # every task except the final potrf has an OUTGOING edge (no stray
+    # sinks: herk/gemm accumulation chains are recorded)
+    by_key = {(t.cls, t.index): t.tid for t in rec.tasks}
+    srcs = {s for s, _, _ in rec.edges}
+    sinks = {t.tid for t in rec.tasks} - srcs
+    assert sinks == {by_key[("potrf", (NT - 1,))]}
+    # the chain herk(k-1,k) -> potrf(k) is present for every k
+    edge_set = {(s, d) for s, d, _ in rec.edges}
+    for kk in range(1, NT):
+        assert (by_key[("herk", (kk - 1, kk))],
+                by_key[("potrf", (kk,))]) in edge_set
+    # herk priority follows the reference formula (NT-m)^3 + 3(m-k)
+    t_h = rec.tasks[by_key[("herk", (0, 2))]]
+    assert t_h.priority == NT ** 3 - ((NT - 2) ** 3 + 3 * (2 - 0))
+    # schedulable (acyclic) and complete, schedule respects every dep
+    order = rec.order()
+    assert len(order) == len(rec.tasks)
+    pos = {int(v): i for i, v in enumerate(order)}
+    for s, d, _ in rec.edges:
+        assert pos[s] < pos[d]
+    dot = rec.to_dot("potrf")
+    assert "digraph" in dot and "potrf(0)" in dot and "->" in dot
+    # rank coloring present
+    assert "rank=" in dot
+
+
+def test_potrf_dag_uplo_u_ranks():
+    # non-symmetric grid so (m,k) vs (k,m) owners differ
+    A = TileMatrix.zeros(16, 16, 4, 4, dist=Dist(P=1, Q=4))
+    rl = DagRecorder(enabled=True)
+    potrf_mod.dag(A, "L", rl)
+    ru = DagRecorder(enabled=True)
+    potrf_mod.dag(A, "U", ru)
+    # same task graph, transposed tile ownership
+    assert {(t.cls, t.index) for t in rl.tasks} == \
+        {(t.cls, t.index) for t in ru.tasks}
+    gl = native.rank_grid(A.desc.dist, 4, 4)
+    keyed_u = {(t.cls, t.index): t for t in ru.tasks}
+    t_u = keyed_u[("trsm", (2, 0))]
+    assert t_u.rank == gl[0, 2]  # upper: panel tile lives at (k, m)
