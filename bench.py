@@ -8,21 +8,32 @@ target of 70% machine peak (BASELINE.json): we self-measure peak with a
 GEMM microbench (the reference's tools/gemmpeak analog) and report
 ``(potrf_pct_peak / 0.70)`` — 1.0 means the target is met.
 
+Timing methodology (tunneled-device safe): the op under test runs K_lo
+and K_hi times inside ONE jit (fori_loop, input perturbed per iteration
+so nothing hoists); per-run time is (t_hi - t_lo)/(K_hi - K_lo), which
+cancels the fixed dispatch+fetch latency of remote transports (~100 ms
+here). min-of-3 on each endpoint.
+
 Prints exactly ONE JSON line.
 """
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
-from dplasma_tpu.descriptors import TileMatrix
-from dplasma_tpu.kernels import blas as k
-from dplasma_tpu.ops import generators, potrf as potrf_mod
-from dplasma_tpu.utils import flops as lawn41
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from dplasma_tpu.descriptors import TileMatrix  # noqa: E402
+from dplasma_tpu.ops import generators, potrf as potrf_mod  # noqa: E402
+from dplasma_tpu.utils import flops as lawn41  # noqa: E402
+from tools.gemmpeak import measure_peak  # noqa: E402
 
 
 def _sync(x):
@@ -31,49 +42,51 @@ def _sync(x):
     np.asarray(x.ravel()[:1])
 
 
-def _time_best(fn, *args, reps=3):
-    _sync(fn(*args))  # compile + warm
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _sync(fn(*args))
-        best = min(best, time.perf_counter() - t0)
-    return best
+def _per_run_seconds(loop, lo: int, hi: int, reps: int = 3) -> float:
+    """Differenced loop timing: fixed dispatch/fetch latency cancels.
+    ``loop(k)`` runs the op k times (dynamic trip count: ONE compile)."""
+    times = {}
+    _sync(loop(hi))  # compile + warm
+    for kk in (lo, hi):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _sync(loop(kk))
+            best = min(best, time.perf_counter() - t0)
+        times[kk] = best
+    return max((times[hi] - times[lo]) / (hi - lo), 1e-12)
 
 
-def _gemm_peak(n=None, chain=4, dtype=jnp.float32):
-    """Machine-peak GEMM microbench (tools/gemmpeak analog). Chains
-    ``chain`` dependent matmuls in one dispatch to amortize per-call
-    transport latency."""
-    n = n or (8192 if jax.default_backend() == "tpu" else 1024)
-    a = jnp.ones((n, n), dtype)
-    b = jnp.ones((n, n), dtype)
+def bench_potrf(N: int, nb: int, dtype=jnp.float32,
+                lo: int = 1, hi: int = 6) -> float:
+    A0 = generators.plghe(float(N), N, nb, seed=3872, dtype=dtype)
+    desc = A0.desc
+    data = A0.data
+    diag = jnp.arange(data.shape[0])
 
-    def f(x, y):
-        for _ in range(chain):
-            y = k.dot(x, y)
-        return y
+    @jax.jit
+    def loop(k, d):
+        def body(i, acc):
+            # i-dependent diagonal shift: same DAG, unhoistable
+            shift = (i.astype(d.dtype) + 1.0) * 1e-6
+            a = d.at[diag, diag].add(shift)
+            L = potrf_mod.potrf(TileMatrix(a, desc), "L")
+            # consume the WHOLE factor: a [0,0]-only read would let
+            # XLA dead-code-eliminate all panels past the first
+            return acc + jnp.sum(L.data).astype(jnp.float32)
+        return lax.fori_loop(0, k, body, jnp.zeros((), jnp.float32))
 
-    t = _time_best(jax.jit(f), a, b)
-    return chain * lawn41.gemm(n, n, n) / 1e9 / t
+    t = _per_run_seconds(lambda kk: loop(kk, data), lo, hi)
+    return lawn41.potrf(N) / 1e9 / t
 
 
 def main():
     on_tpu = jax.default_backend() == "tpu"
-    N, nb = (16384, 2048) if on_tpu else (4096, 512)
-    dtype = jnp.float32
-
-    A0 = generators.plghe(float(N), N, nb, seed=3872, dtype=dtype)
-
-    def run(data):
-        A = TileMatrix(data, A0.desc)
-        return potrf_mod.potrf(A, "L").data
-
-    f = jax.jit(run)
-    t = _time_best(f, A0.data)
-    gflops = lawn41.potrf(N) / 1e9 / t
-
-    peak = _gemm_peak(dtype=dtype)
+    N, nb = (16384, 2048) if on_tpu else (2048, 256)
+    gflops = bench_potrf(N, nb)
+    peak = measure_peak(
+        n=4096 if on_tpu else 1024, iters=60 if on_tpu else 20,
+        dtype="float32", precision=jax.lax.Precision.HIGHEST)
     pct_peak = gflops / peak if peak > 0 else 0.0
     print(json.dumps({
         "metric": f"dpotrf_gflops_n{N}_{jax.default_backend()}",

@@ -125,10 +125,12 @@ class TaskPool:
 
     # -- introspection -------------------------------------------------
     def record_dag(self, rec) -> None:
-        """Feed the tracked task DAG into a DagRecorder (--dot)."""
+        """Feed the tracked task DAG into a DagRecorder (--dot). The
+        full flattened ref index keys each node — same-named tasks with
+        different tile sets must not collide."""
         ids = []
         for t in self.tasks:
-            ix = tuple(x for r in t.refs for x in (r.i, r.j))[:3]
+            ix = tuple(x for r in t.refs for x in (r.i, r.j))
             ids.append(rec.task(t.name, *ix))
         for s, d in self.edges:
             rec.edge(ids[s], ids[d])
@@ -167,10 +169,16 @@ def _t_gemm(pm, pn, amn, *, lower):
 
 
 def potrf_dtd(A: TileMatrix, uplo: str = "L",
-              pool: Optional[TaskPool] = None) -> TileMatrix:
+              pool: Optional[TaskPool] = None):
     """Right-looking tile Cholesky via task insertion — the
     testing_zpotrf_dtd.c flow. Numerically identical to ops.potrf's
-    panel formulation; exercises the DTD runtime path."""
+    panel formulation; exercises the DTD runtime path.
+
+    Returns the factored TileMatrix. If ``pool`` is supplied, tasks are
+    only INSERTED (not run) and the pool itself is returned so the
+    caller can compose further insertions before ``wait()``; such a
+    pool must wrap ``A.pad_diag()`` (ragged edge tiles need the unit
+    diagonal pad to keep the padded factorization nonsingular)."""
     lower = uplo.upper() == "L"
     tp = pool if pool is not None else TaskPool(A.pad_diag())
     nt = tp.mats[0].desc.KT

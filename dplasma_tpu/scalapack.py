@@ -1,0 +1,204 @@
+"""Python half of the ScaLAPACK ABI shim.
+
+The native shim (native/src/scalapack_shim.cpp) exposes F77
+``p[sd]gemm_/p[sd]potrf_/...`` symbols — the reference's drop-in PBLAS
+surface (ref src/scalapack_wrappers/dplasma_wrapper_pdgemm.c:543-545) —
+and forwards every call here. :func:`dispatch` wraps the caller's
+column-major buffers zero-copy with numpy (BLACS descriptor → view, the
+analogue of the BLACS→``parsec_matrix_block_cyclic_t`` marshalling in
+scalapack_wrappers/common.c:26-90), runs the framework op on a
+:class:`TileMatrix`, and writes results back in place.
+
+Scope: single-process BLACS grids (the shim's host process owns the
+whole matrix). The descriptor's MB defines the internal tiling, clamped
+to a sane quantum the way the reference redistributes to 512² internal
+tiles (scalapack_wrappers/common.c:5-6).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+# BLACS descriptor slots (ScaLAPACK DESC_)
+_DTYPE, _CTXT, _M, _N, _MB, _NB, _RSRC, _CSRC, _LLD = range(9)
+
+_NP_DTYPE = {"d": np.float64, "f": np.float32}
+# counters mirroring the reference's wrapped-call accounting
+# (scalapack_wrappers/common.c:8-24)
+call_counts: dict = {}
+
+
+def _view(addr: int, desc, dtype) -> np.ndarray:
+    """Zero-copy column-major view of the caller's local array."""
+    lld = max(int(desc[_LLD]), 1)
+    ncols = max(int(desc[_N]), 1)
+    n_items = lld * ncols
+    buf = (ctypes.c_byte * (n_items * np.dtype(dtype).itemsize)) \
+        .from_address(addr)
+    return np.frombuffer(buf, dtype=dtype).reshape((lld, ncols), order="F")
+
+
+def _sub(view: np.ndarray, i: int, j: int, m: int, n: int) -> np.ndarray:
+    """(ia, ja) 1-based submatrix of extent m×n."""
+    return view[i - 1:i - 1 + m, j - 1:j - 1 + n]
+
+
+def _tile_nb(desc, m: int, n: int) -> int:
+    """Internal tile size: descriptor MB, clamped (the 512² analogue)."""
+    nb = int(desc[_MB]) or 128
+    return max(16, min(nb, 512, max(m, n)))
+
+
+def _to_tm(a: np.ndarray, nb: int):
+    import jax.numpy as jnp
+    from dplasma_tpu.descriptors import TileMatrix
+    return TileMatrix.from_dense(jnp.asarray(np.ascontiguousarray(a)),
+                                 nb, nb)
+
+
+def dispatch(name: str, args) -> int:
+    """Entry point called from the native shim. Returns INFO."""
+    call_counts[name] = call_counts.get(name, 0) + 1
+    # d-precision ABI requires real f64 end-to-end (the reference links
+    # double BLAS); enable x64 before the first trace. f64 runs on the
+    # host CPU backend — TPU lacks f64 factorization expanders.
+    import contextlib
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    prec = next((_c(a) for a in args if _c(a) in _NP_DTYPE), "d")
+    ctx = contextlib.nullcontext()
+    if prec == "d":
+        cpus = jax.devices("cpu")
+        if cpus:
+            ctx = jax.default_device(cpus[0])
+    try:
+        with ctx:
+            return int(_HANDLERS[name](*args))
+    except Exception as exc:  # surface as INFO<0, like xerbla
+        import traceback
+        traceback.print_exc()
+        return -1 if not isinstance(exc, KeyError) else -9998
+
+
+def _h_gemm(transa, transb, prec, m, n, k, alpha, beta,
+            pa, ia, ja, desca, pb, ib, jb, descb, pc, ic, jc, descc):
+    from dplasma_tpu.ops import blas3
+    dt = _NP_DTYPE[_c(prec)]
+    ta, tb = _c(transa).upper(), _c(transb).upper()
+    av = _view(pa, desca, dt)
+    bv = _view(pb, descb, dt)
+    cv = _view(pc, descc, dt)
+    a = _sub(av, ia, ja, m if ta == "N" else k, k if ta == "N" else m)
+    b = _sub(bv, ib, jb, k if tb == "N" else n, n if tb == "N" else k)
+    c = _sub(cv, ic, jc, m, n)
+    nb = _tile_nb(descc, m, n)
+    # PBLAS contract: C is not referenced when beta == 0 (it may be
+    # uninitialized); feed zeros so stray NaNs cannot leak through 0*C.
+    C = _to_tm(np.zeros_like(c) if beta == 0.0 else c, nb)
+    out = blas3.gemm(alpha, _to_tm(a, nb), _to_tm(b, nb), beta, C,
+                     transa=ta, transb=tb)
+    c[:] = np.asarray(out.to_dense(), dtype=dt)
+    return 0
+
+
+def _h_potrf(uplo, prec, n, pa, ia, ja, desca):
+    import jax.numpy as jnp
+    from dplasma_tpu.ops import potrf as potrf_mod, info as info_mod
+    dt = _NP_DTYPE[_c(prec)]
+    u = _c(uplo).upper()
+    av = _view(pa, desca, dt)
+    a = _sub(av, ia, ja, n, n)
+    A = _to_tm(a, _tile_nb(desca, n, n))
+    L = potrf_mod.potrf(A, u)
+    info = int(info_mod.factor_info(L, u))
+    ld = np.asarray(L.to_dense(), dtype=dt)
+    mask = np.tril(np.ones((n, n), bool)) if u == "L" else \
+        np.triu(np.ones((n, n), bool))
+    a[mask] = ld[mask]
+    return info
+
+
+def _h_trsm(side, uplo, transa, diag, prec, m, n, alpha,
+            pa, ia, ja, desca, pb, ib, jb, descb):
+    return _h_tr("trsm", side, uplo, transa, diag, prec, m, n, alpha,
+                 pa, ia, ja, desca, pb, ib, jb, descb)
+
+
+def _h_trmm(side, uplo, transa, diag, prec, m, n, alpha,
+            pa, ia, ja, desca, pb, ib, jb, descb):
+    return _h_tr("trmm", side, uplo, transa, diag, prec, m, n, alpha,
+                 pa, ia, ja, desca, pb, ib, jb, descb)
+
+
+def _h_tr(op, side, uplo, transa, diag, prec, m, n, alpha,
+          pa, ia, ja, desca, pb, ib, jb, descb):
+    from dplasma_tpu.ops import blas3
+    dt = _NP_DTYPE[_c(prec)]
+    s, u, t, d = (_c(x).upper() for x in (side, uplo, transa, diag))
+    ka = m if s == "L" else n
+    av = _view(pa, desca, dt)
+    bv = _view(pb, descb, dt)
+    a = _sub(av, ia, ja, ka, ka)
+    b = _sub(bv, ib, jb, m, n)
+    nb = _tile_nb(descb, m, n)
+    fn = blas3.trsm if op == "trsm" else blas3.trmm
+    out = fn(alpha, _to_tm(a, nb), _to_tm(b, nb), side=s, uplo=u,
+             trans=t, diag=d)
+    b[:] = np.asarray(out.to_dense(), dtype=dt)
+    return 0
+
+
+def _h_getrf(prec, m, n, pa, ia, ja, desca, pipiv):
+    from dplasma_tpu.ops import lu
+    dt = _NP_DTYPE[_c(prec)]
+    av = _view(pa, desca, dt)
+    a = _sub(av, ia, ja, m, n)
+    A = _to_tm(a, _tile_nb(desca, m, n))
+    LU, perm = lu.getrf_1d(A)
+    mn = min(m, n)
+    ipiv = np.asarray(lu.perm_to_ipiv(np.asarray(perm)[:m]))[:mn]
+    a[:] = np.asarray(LU.to_dense(), dtype=dt)
+    buf = (ctypes.c_int32 * mn).from_address(pipiv)
+    np.frombuffer(buf, dtype=np.int32)[:] = ipiv.astype(np.int32) + 1
+    # singularity: exact zero on the U diagonal
+    udiag = np.diagonal(np.asarray(LU.to_dense()))[:mn]
+    zeros = np.nonzero((udiag == 0) | ~np.isfinite(udiag))[0]
+    return int(zeros[0]) + 1 if zeros.size else 0
+
+
+def _h_geqrf(prec, m, n, pa, ia, ja, desca, ptau, pwork, lwork):
+    from dplasma_tpu.ops import qr
+    dt = _NP_DTYPE[_c(prec)]
+    av = _view(pa, desca, dt)
+    a = _sub(av, ia, ja, m, n)
+    A = _to_tm(a, _tile_nb(desca, m, n))
+    Af, Tf = qr.geqrf(A)
+    a[:] = np.asarray(Af.to_dense(), dtype=dt)
+    # tau = diagonal of the compact-WY T factors, per panel
+    mn = min(m, n)
+    td = np.asarray(Tf.data)
+    tau = np.array([td[i % Tf.desc.mb, i] for i in range(mn)], dtype=dt)
+    buf = (ctypes.c_byte * (mn * np.dtype(dt).itemsize)) \
+        .from_address(ptau)
+    np.frombuffer(buf, dtype=dt)[:] = tau
+    return 0
+
+
+def _c(x) -> str:
+    """Native chars arrive as 1-byte ints or bytes; normalize to str."""
+    if isinstance(x, int):
+        return chr(x)
+    if isinstance(x, bytes):
+        return x.decode()
+    return str(x)
+
+
+_HANDLERS = {
+    "gemm": _h_gemm,
+    "potrf": _h_potrf,
+    "trsm": _h_trsm,
+    "trmm": _h_trmm,
+    "getrf": _h_getrf,
+    "geqrf": _h_geqrf,
+}

@@ -1,0 +1,179 @@
+// ScaLAPACK ABI shim: drop-in p[sd]{gemm,potrf,trsm,trmm,getrf,geqrf}_
+// symbols over the TPU framework.
+//
+// The reference ships the same facility as src/scalapack_wrappers/
+// (3.7k LoC of C): F77 PBLAS/ScaLAPACK entry points that marshal BLACS
+// descriptors into the runtime's matrix views, lazily initializing the
+// runtime on first use (parsec_init_wrapped_call,
+// dplasma_wrapper_pdgemm.c:283,543-545). Here the native half embeds
+// CPython: each F77 call acquires the GIL (initializing the interpreter
+// if the host application is not Python) and dispatches into
+// dplasma_tpu.scalapack.dispatch(), which wraps the caller's buffers
+// with numpy (zero-copy, Fortran order), runs the JAX op, and writes
+// results back in place.
+//
+// Scope: single-process BLACS grids (one TPU host process). Distributed
+// callers need the framework's own mesh API — the reference makes the
+// same single-communicator assumption per wrapped call.
+//
+// Build: make -C native shim   (links libpython; see native/Makefile)
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <mutex>
+
+namespace {
+
+std::once_flag g_init_once;
+bool g_we_initialized = false;
+
+void ensure_python() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+      // Release the GIL so PyGILState_Ensure below works uniformly.
+      PyEval_SaveThread();
+    }
+  });
+}
+
+// Call dplasma_tpu.scalapack.dispatch(name, args). Returns the int
+// status (INFO) from Python, or -9999 on internal failure.
+int dispatch(const char* name, PyObject* args /* stolen */) {
+  ensure_python();
+  PyGILState_STATE st = PyGILState_Ensure();
+  int ret = -9999;
+  PyObject* mod = PyImport_ImportModule("dplasma_tpu.scalapack");
+  if (mod) {
+    PyObject* res =
+        PyObject_CallMethod(mod, "dispatch", "sO", name, args);
+    if (res) {
+      ret = (int)PyLong_AsLong(res);
+      Py_DECREF(res);
+    }
+    Py_DECREF(mod);
+  }
+  if (PyErr_Occurred()) {
+    PyErr_Print();
+    fflush(stderr);
+  }
+  Py_XDECREF(args);
+  PyGILState_Release(st);
+  return ret;
+}
+
+PyObject* desc_tuple(const int* desc) {
+  PyObject* t = PyTuple_New(9);
+  for (int i = 0; i < 9; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLong(desc[i]));
+  return t;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- GEMM
+#define DEF_PGEMM(pfx, T)                                                  \
+  void pfx##gemm_(const char* transa, const char* transb, const int* m,    \
+                  const int* n, const int* k, const T* alpha, T* a,        \
+                  const int* ia, const int* ja, const int* desca, T* b,    \
+                  const int* ib, const int* jb, const int* descb,          \
+                  const T* beta, T* c, const int* ic, const int* jc,       \
+                  const int* descc) {                                      \
+    ensure_python();                                                       \
+    PyGILState_STATE st = PyGILState_Ensure();                             \
+    PyObject* args = Py_BuildValue(                                        \
+        "(ccciiiddKiiNKiiNKiiN)", *transa, *transb, #T[0], *m, *n, *k,     \
+        (double)*alpha, (double)*beta, (unsigned long long)(uintptr_t)a,   \
+        *ia, *ja, desc_tuple(desca), (unsigned long long)(uintptr_t)b,     \
+        *ib, *jb, desc_tuple(descb), (unsigned long long)(uintptr_t)c,     \
+        *ic, *jc, desc_tuple(descc));                                      \
+    PyGILState_Release(st);                                                \
+    dispatch("gemm", args);                                                \
+  }
+
+DEF_PGEMM(pd, double)
+DEF_PGEMM(ps, float)
+
+// --------------------------------------------------------------- POTRF
+#define DEF_PPOTRF(pfx, T)                                                 \
+  void pfx##potrf_(const char* uplo, const int* n, T* a, const int* ia,    \
+                   const int* ja, const int* desca, int* info) {           \
+    ensure_python();                                                       \
+    PyGILState_STATE st = PyGILState_Ensure();                             \
+    PyObject* args = Py_BuildValue(                                        \
+        "(cciKiiN)", *uplo, #T[0], *n,                                     \
+        (unsigned long long)(uintptr_t)a, *ia, *ja, desc_tuple(desca));    \
+    PyGILState_Release(st);                                                \
+    *info = dispatch("potrf", args);                                       \
+  }
+
+DEF_PPOTRF(pd, double)
+DEF_PPOTRF(ps, float)
+
+// ---------------------------------------------------------- TRSM/TRMM
+#define DEF_PTR(pfx, T, op)                                                \
+  void pfx##op##_(const char* side, const char* uplo, const char* transa,  \
+                  const char* diag, const int* m, const int* n,            \
+                  const T* alpha, T* a, const int* ia, const int* ja,      \
+                  const int* desca, T* b, const int* ib, const int* jb,    \
+                  const int* descb) {                                      \
+    ensure_python();                                                       \
+    PyGILState_STATE st = PyGILState_Ensure();                             \
+    PyObject* args = Py_BuildValue(                                        \
+        "(ccccciidKiiNKiiN)", *side, *uplo, *transa, *diag, #T[0],         \
+        *m, *n, (double)*alpha,                                            \
+        (unsigned long long)(uintptr_t)a, *ia, *ja, desc_tuple(desca),     \
+        (unsigned long long)(uintptr_t)b, *ib, *jb, desc_tuple(descb));    \
+    PyGILState_Release(st);                                                \
+    dispatch(#op, args);                                                   \
+  }
+
+DEF_PTR(pd, double, trsm)
+DEF_PTR(ps, float, trsm)
+DEF_PTR(pd, double, trmm)
+DEF_PTR(ps, float, trmm)
+
+// --------------------------------------------------------------- GETRF
+#define DEF_PGETRF(pfx, T)                                                 \
+  void pfx##getrf_(const int* m, const int* n, T* a, const int* ia,        \
+                   const int* ja, const int* desca, int* ipiv,             \
+                   int* info) {                                            \
+    ensure_python();                                                       \
+    PyGILState_STATE st = PyGILState_Ensure();                             \
+    PyObject* args = Py_BuildValue(                                        \
+        "(ciiKiiNK)", #T[0], *m, *n, (unsigned long long)(uintptr_t)a,     \
+        *ia, *ja, desc_tuple(desca),                                       \
+        (unsigned long long)(uintptr_t)ipiv);                              \
+    PyGILState_Release(st);                                                \
+    *info = dispatch("getrf", args);                                       \
+  }
+
+DEF_PGETRF(pd, double)
+DEF_PGETRF(ps, float)
+
+// --------------------------------------------------------------- GEQRF
+#define DEF_PGEQRF(pfx, T)                                                 \
+  void pfx##geqrf_(const int* m, const int* n, T* a, const int* ia,        \
+                   const int* ja, const int* desca, T* tau, T* work,       \
+                   const int* lwork, int* info) {                          \
+    ensure_python();                                                       \
+    PyGILState_STATE st = PyGILState_Ensure();                             \
+    PyObject* args = Py_BuildValue(                                        \
+        "(ciiKiiNKKi)", #T[0], *m, *n, (unsigned long long)(uintptr_t)a,   \
+        *ia, *ja, desc_tuple(desca), (unsigned long long)(uintptr_t)tau,   \
+        (unsigned long long)(uintptr_t)work, *lwork);                      \
+    PyGILState_Release(st);                                                \
+    *info = dispatch("geqrf", args);                                       \
+  }
+
+DEF_PGEQRF(pd, double)
+DEF_PGEQRF(ps, float)
+
+int dplasma_tpu_shim_version() { return 1; }
+
+}  // extern "C"

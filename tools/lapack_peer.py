@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Head-to-head LAPACK peers of the testing drivers (tools/cscalapack twin).
+
+The reference ships pure-ScaLAPACK twins of its testers
+(`tools/cscalapack/pdpotrf.c`, `pdgemm.c`, `pdgeqrf.c`, `pdsyev.c`, …)
+so the same problem can be timed against the incumbent library with
+identical flop formulas and print format. This twin runs numpy/scipy's
+LAPACK (the incumbent on a TPU host) and prints the framework's
+reference-format perf line, so A/B comparison is::
+
+    python -m dplasma_tpu.drivers testing_dpotrf -N 4096 -t 256
+    python tools/lapack_peer.py potrf -N 4096
+
+Supported: potrf, gemm, geqrf, getrf, heev, gesvd.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+from dplasma_tpu.utils import flops as lawn41  # noqa: E402
+
+
+def _perf_line(name: str, N: int, t: float, fl: float, nb: int = 0,
+               extra: str = ""):
+    gf = fl / 1e9 / t if t > 0 else 0.0
+    print(f"[****] TIME(s) {t:12.5f} : {name}\tPxQxg=   1 1   0 "
+          f"NB= {nb:4d} N= {N:7d} : {gf:14.6f} gflops{extra}")
+
+
+def _timed(fn, nruns: int):
+    best = float("inf")
+    out = None
+    for _ in range(max(nruns, 1)):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return out, best
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("op", choices=["potrf", "gemm", "geqrf", "getrf",
+                                  "heev", "gesvd"])
+    p.add_argument("-N", type=int, default=2048)
+    p.add_argument("-K", type=int, default=0, help="inner dim for gemm")
+    p.add_argument("--nruns", type=int, default=3)
+    p.add_argument("--dtype", default="float64")
+    args = p.parse_args(argv)
+
+    N = args.N
+    K = args.K or N
+    dt = np.dtype(args.dtype)
+    cplx = dt.kind == "c"
+    rng = np.random.default_rng(3872)
+
+    def randm(m, n):
+        x = rng.standard_normal((m, n))
+        if cplx:
+            x = x + 1j * rng.standard_normal((m, n))
+        return x.astype(dt)
+
+    if args.op == "potrf":
+        a = randm(N, N)
+        spd = a @ a.conj().T + N * np.eye(N, dtype=dt)
+        _, t = _timed(lambda: np.linalg.cholesky(spd), args.nruns)
+        _perf_line("peer_potrf", N, t, lawn41.potrf(N, cplx))
+    elif args.op == "gemm":
+        a, b, c = randm(N, K), randm(K, N), randm(N, N)
+        _, t = _timed(lambda: a @ b + c, args.nruns)
+        _perf_line("peer_gemm", N, t, lawn41.gemm(N, N, K, cplx))
+    elif args.op == "geqrf":
+        import scipy.linalg as sla
+        a = randm(N, N)
+        _, t = _timed(lambda: sla.qr(a, mode="r"), args.nruns)
+        _perf_line("peer_geqrf", N, t, lawn41.geqrf(N, N, cplx))
+    elif args.op == "getrf":
+        import scipy.linalg as sla
+        a = randm(N, N)
+        _, t = _timed(lambda: sla.lu_factor(a), args.nruns)
+        _perf_line("peer_getrf", N, t, lawn41.getrf(N, N, cplx))
+    elif args.op == "heev":
+        a = randm(N, N)
+        h = (a + a.conj().T) / 2
+        _, t = _timed(lambda: np.linalg.eigvalsh(h), args.nruns)
+        _perf_line("peer_heev", N, t, lawn41.heev(N, cplx))
+    elif args.op == "gesvd":
+        a = randm(N, N)
+        _, t = _timed(
+            lambda: np.linalg.svd(a, compute_uv=False), args.nruns)
+        _perf_line("peer_gesvd", N, t, lawn41.gebrd(N, N, cplx))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
