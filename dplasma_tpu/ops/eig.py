@@ -107,16 +107,30 @@ def band_to_rect(B: TileMatrix, bw: int):
     return jnp.stack(rows)
 
 
-def hbrdt(B: TileMatrix, bw: int):
-    """Band → tridiagonal (dplasma_zhbrdt analog): successive blocked
-    band-halving sweeps instead of scalar bulge chasing (see module
-    docstring). Returns (d, e) real diagonal/off-diagonal."""
+_CHASE_CUT = 64  # bandwidth below which the scan bulge chase takes over
+
+
+def hbrdt(B: TileMatrix, bw: int, chase_cut: int = _CHASE_CUT):
+    """Band → tridiagonal (dplasma_zhbrdt analog), two regimes:
+
+    * wide bands: blocked band-halving sweeps — MXU matmuls, one
+      unrolled panel loop per width level (see module docstring); a
+      sweep with panel width w leaves true bandwidth 2w-1;
+    * bands ≤ ``chase_cut``: ONE ``lax.scan`` bulge chase over a
+      precomputed Givens schedule (ops.band) — the reference's
+      sequential chase (zhbrdt.jdf:41-60) with O(1) compile cost.
+
+    ``bw`` is the TRUE bandwidth of B. Returns (d, e) real."""
+    from dplasma_tpu.ops import band as band_mod
     X = B.zero_pad().data
     N = B.desc.M
-    w = bw
-    while w > 1:
-        w = max(1, w // 2)
+    b = min(bw, max(N - 1, 1))
+    while b > max(1, chase_cut):
+        w = max(1, (b + 1) // 4)  # panel w leaves band 2w-1 ~ b/2
         X = _two_sided_band_sweep(X, w, N)
+        b = 2 * w - 1
+    if b > 1:
+        return band_mod.herm_band_to_tridiag(X, N, b)
     d = jnp.real(jnp.diagonal(X))[:N]
     e = jnp.abs(jnp.diagonal(X, offset=-1))[:N - 1]
     return d, e
@@ -124,11 +138,11 @@ def hbrdt(B: TileMatrix, bw: int):
 
 def hetrd(A: TileMatrix, uplo: str = "L"):
     """Dense Hermitian → tridiagonal, two-stage (dplasma_zhetrd):
-    herbt to bandwidth nb, then band-halving to 1. Returns (d, e).
+    herbt to band 2nb-1, then band reduction to 1. Returns (d, e).
     The complex off-diagonal is phase-rotated real (a diagonal unitary
     similarity — eigenvalues unchanged), as LAPACK zhetrd does."""
     Bm, _, _ = herbt(A, uplo)
-    return hbrdt(Bm, A.desc.nb)
+    return hbrdt(Bm, 2 * A.desc.nb - 1)
 
 
 def heev(A: TileMatrix, uplo: str = "L"):
@@ -202,22 +216,26 @@ def _bidiag_reduce(X, nbp: int, M: int, N: int):
     return X
 
 
-def gebrd(A: TileMatrix):
-    """Dense → bidiagonal (d, e): ge2gb to band nb, then band-halving
-    sweeps down to bandwidth 1. Returns (d, e) real (phase-rotated)."""
+def gebrd(A: TileMatrix, chase_cut: int = _CHASE_CUT):
+    """Dense → bidiagonal (d, e): ge2gb to upper band 2nb-1, blocked
+    QR/LQ halving while the band is wide (a sweep with panel width w
+    leaves upper bandwidth 2w-1), then the scan bulge chase (ops.band)
+    for the narrow tail. Returns (d, e) real (phase-rotated)."""
+    from dplasma_tpu.ops import band as band_mod
     B = gebrd_ge2gb(A)
     X = B.data
     M, N = A.desc.M, A.desc.N
-    w = A.desc.nb
-    while w > 1:
-        w = max(1, w // 2)
+    b = min(2 * A.desc.nb - 1, max(N - 1, 1))
+    while b > max(1, chase_cut):
+        w = max(1, (b + 1) // 4)
         X = _bidiag_reduce(X, w, M, N)
+        b = 2 * w - 1
     K = min(M, N)
+    if b > 1:
+        return band_mod.bidiag_band_to_bidiag(X, M, N, b)
     d = jnp.abs(jnp.diagonal(X))[:K]
-    if K > 1:
-        e = jnp.abs(jnp.diagonal(X, offset=1))[:K - 1]
-    else:
-        e = jnp.zeros((0,), d.dtype)
+    ne = K if (M < N and K >= 1) else max(K - 1, 0)
+    e = jnp.abs(jnp.diagonal(X, offset=1))[:ne]
     return d, e
 
 
@@ -230,12 +248,14 @@ def gesvd(A: TileMatrix):
     descending singular values (min(M,N),)."""
     d, e = gebrd(A)
     K = d.shape[0]
-    if K == 1:
+    if K == 1 and e.shape[0] == 0:
         return d
-    off = jnp.zeros((2 * K - 1,), d.dtype)
+    # interleave [d1, e1, d2, e2, …]; e has K-1 entries (M >= N) or K
+    # (M < N — the K×(K+1) bidiagonal's tail), sizes fall out either way
+    L = K + e.shape[0]
+    off = jnp.zeros((L,), d.dtype)
     off = off.at[0::2].set(d)
-    if K > 1:
-        off = off.at[1::2].set(e)
+    off = off.at[1::2].set(e)
     w = jax.scipy.linalg.eigh_tridiagonal(
-        jnp.zeros((2 * K,), d.dtype), off, eigvals_only=True)
+        jnp.zeros((L + 1,), d.dtype), off, eigvals_only=True)
     return w[::-1][:K]

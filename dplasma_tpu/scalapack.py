@@ -65,10 +65,11 @@ def dispatch(name: str, args) -> int:
     # host CPU backend — TPU lacks f64 factorization expanders.
     import contextlib
     import jax
-    jax.config.update("jax_enable_x64", True)
     prec = next((_c(a) for a in args if _c(a) in _NP_DTYPE), "d")
     ctx = contextlib.nullcontext()
     if prec == "d":
+        # only the d-precision ABI needs x64; don't disturb f32 hosts
+        jax.config.update("jax_enable_x64", True)
         cpus = jax.devices("cpu")
         if cpus:
             ctx = jax.default_device(cpus[0])
@@ -158,11 +159,12 @@ def _h_getrf(prec, m, n, pa, ia, ja, desca, pipiv):
     LU, perm = lu.getrf_1d(A)
     mn = min(m, n)
     ipiv = np.asarray(lu.perm_to_ipiv(np.asarray(perm)[:m]))[:mn]
-    a[:] = np.asarray(LU.to_dense(), dtype=dt)
+    ld = np.asarray(LU.to_dense(), dtype=dt)
+    a[:] = ld
     buf = (ctypes.c_int32 * mn).from_address(pipiv)
     np.frombuffer(buf, dtype=np.int32)[:] = ipiv.astype(np.int32) + 1
     # singularity: exact zero on the U diagonal
-    udiag = np.diagonal(np.asarray(LU.to_dense()))[:mn]
+    udiag = np.diagonal(ld)[:mn]
     zeros = np.nonzero((udiag == 0) | ~np.isfinite(udiag))[0]
     return int(zeros[0]) + 1 if zeros.size else 0
 
@@ -170,6 +172,11 @@ def _h_getrf(prec, m, n, pa, ia, ja, desca, pipiv):
 def _h_geqrf(prec, m, n, pa, ia, ja, desca, ptau, pwork, lwork):
     from dplasma_tpu.ops import qr
     dt = _NP_DTYPE[_c(prec)]
+    if lwork == -1:
+        # LAPACK workspace query: report the optimal size, touch nothing
+        buf = (ctypes.c_byte * np.dtype(dt).itemsize).from_address(pwork)
+        np.frombuffer(buf, dtype=dt)[0] = 1  # scratch lives device-side
+        return 0
     av = _view(pa, desca, dt)
     a = _sub(av, ia, ja, m, n)
     A = _to_tm(a, _tile_nb(desca, m, n))

@@ -32,7 +32,7 @@ def test_herbt_band_and_spectrum(N, nb, uplo, dtype):
 
 @pytest.mark.parametrize("N,nb,dtype", [
     (48, 12, jnp.float64),
-    pytest.param(90, 25, jnp.complex128, marks=pytest.mark.slow),
+    (90, 25, jnp.complex128),
 ])
 def test_heev_eigenvalues(N, nb, dtype):
     A0 = generators.plghe(0.0, N, nb, seed=51, dtype=dtype)
@@ -68,7 +68,7 @@ def test_band_to_rect():
 @pytest.mark.parametrize("M,N,nb,dtype", [
     (48, 48, 12, jnp.float64),
     (64, 48, 16, jnp.complex128),
-    pytest.param(48, 64, 16, jnp.float64, marks=pytest.mark.slow),
+    (48, 64, 16, jnp.float64),
 ])
 def test_gesvd_singular_values(M, N, nb, dtype):
     A0 = generators.plrnt(M, N, nb, nb, seed=3872, dtype=dtype)

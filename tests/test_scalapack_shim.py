@@ -116,8 +116,19 @@ def test_pdgeqrf_r_factor(shim, rng):
     A0 = A.copy()
     tau = np.zeros(N)
     work = np.zeros(1)
-    lw, info = ctypes.c_int(-1), ctypes.c_int(99)
+    info = ctypes.c_int(99)
     mi, ni = ctypes.c_int(M), ctypes.c_int(N)
+    # LAPACK two-phase convention: lwork=-1 is a size-only query that
+    # must leave A untouched
+    lw = ctypes.c_int(-1)
+    shim.pdgeqrf_(ctypes.byref(mi), ctypes.byref(ni), _pd(A),
+                  ctypes.byref(_one), ctypes.byref(_one),
+                  _desc(M, N, 32, 32, M), _pd(tau), _pd(work),
+                  ctypes.byref(lw), ctypes.byref(info))
+    assert info.value == 0
+    assert np.array_equal(A, A0)
+    assert work[0] >= 1
+    lw = ctypes.c_int(int(work[0]))
     shim.pdgeqrf_(ctypes.byref(mi), ctypes.byref(ni), _pd(A),
                   ctypes.byref(_one), ctypes.byref(_one),
                   _desc(M, N, 32, 32, M), _pd(tau), _pd(work),

@@ -58,9 +58,11 @@ def measure_peak(n: int = 4096, iters: int = 100, dtype="float32",
             def body(i, carry):
                 acc, bb = carry
                 y = jnp.matmul(a, bb, precision=precision,
-                               preferred_element_type=jnp.float32)
-                s = lax.rsqrt(jnp.mean(y * y) + 1.0).astype(dt)
-                return acc + (y[0, 0] * s).astype(jnp.float32), y * s
+                               preferred_element_type=None
+                               if dt == jnp.float64 else jnp.float32)
+                s = lax.rsqrt(jnp.mean(y * y) + 1.0)
+                return (acc + (y[0, 0] * s).astype(jnp.float32),
+                        (y * s).astype(dt))
             out = lax.fori_loop(
                 0, k, body, (jnp.zeros((), jnp.float32), b))
             return out[0]

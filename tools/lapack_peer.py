@@ -16,12 +16,13 @@ Supported: potrf, gemm, geqrf, getrf, heev, gesvd.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from dplasma_tpu.utils import flops as lawn41  # noqa: E402
 
