@@ -82,7 +82,7 @@ def bench_potrf(N: int, nb: int, dtype=jnp.float32,
 
 def main():
     on_tpu = jax.default_backend() == "tpu"
-    N, nb = (16384, 2048) if on_tpu else (2048, 256)
+    N, nb = (16384, 1024) if on_tpu else (2048, 256)
     gflops = bench_potrf(N, nb)
     peak = measure_peak(
         n=4096 if on_tpu else 1024, iters=60 if on_tpu else 20,
