@@ -45,24 +45,43 @@ def t_desc(A: TileMatrix) -> TileMatrix:
 # -- QR ----------------------------------------------------------------
 
 def geqrf(A: TileMatrix) -> tuple[TileMatrix, TileMatrix]:
-    """A = Q R (dplasma_zgeqrf). Returns (packed factor, T factors)."""
+    """A = Q R (dplasma_zgeqrf). Returns (packed factor, T factors).
+
+    Left-looking block-column sweep: each column block receives all
+    finished panels' reflectors as compact-WY matmuls, then its own
+    panel QR — only that column is written per step (a right-looking
+    sweep re-materializes the whole matrix per panel through XLA's
+    dynamic-update-slice; see ops.potrf)."""
     _check_square_tiles(A, "geqrf")
     nb = A.desc.nb
     KT = A.desc.KT
+    NT = A.desc.NT
     X = A.zero_pad().data
-    Np = A.desc.Np
-    Tm = t_desc(A)
-    Td = Tm.data
+    panels = []  # (v, T) per finished panel
+    outcols = []
 
-    for kk in range(KT):
-        s, e = kk * nb, (kk + 1) * nb
-        packed, v, T = hh.geqrt(X[s:, s:e])
-        X = X.at[s:, s:e].set(packed)
-        Td = Td.at[:, s:e].set(T)
-        if e < Np:
-            X = X.at[s:, e:].set(hh.apply_q(v, T, X[s:, e:], trans="C"))
-        X = pmesh.constrain2d(X)
-    return TileMatrix(X, A.desc), TileMatrix(Td, Tm.desc)
+    for kk in range(NT):
+        s = kk * nb
+        col = X[:, s:s + nb]
+        for j, (vj, Tj) in enumerate(panels):
+            r = j * nb
+            col = jnp.concatenate(
+                [col[:r], hh.apply_q(vj, Tj, col[r:], trans="C")],
+                axis=0) if r else hh.apply_q(vj, Tj, col, trans="C")
+        if kk < KT:
+            packed, v, T = hh.geqrt(col[s:])
+            panels.append((v, T))
+            col = jnp.concatenate([col[:s], packed], axis=0) if s \
+                else packed
+        outcols.append(col)
+
+    full = jnp.concatenate(outcols, axis=1)
+    Tm = t_desc(A)
+    Td = jnp.concatenate([T for _, T in panels], axis=1)
+    if Td.shape[1] < Tm.desc.Np:
+        Td = jnp.pad(Td, ((0, 0), (0, Tm.desc.Np - Td.shape[1])))
+    return (TileMatrix(pmesh.constrain2d(full), A.desc),
+            TileMatrix(Td, Tm.desc))
 
 
 def _qr_panels(Af: TileMatrix, Tf: TileMatrix):

@@ -1,0 +1,54 @@
+"""CLI driver harness (the reference's testing_* binaries + ctest
+invocations, ref tests/Testings.cmake): run a few drivers in-process on
+the CPU mesh with the reference's small odd sizes and -x checks."""
+import numpy as np
+import pytest
+
+from dplasma_tpu.drivers import main
+
+
+@pytest.mark.parametrize("prog,args", [
+    # shm sizes mirror Testings.cmake's odd-size strategy (-N 378 -t 93)
+    ("testing_dpotrf", ["-N", "117", "-t", "25", "-x"]),
+    ("testing_sgemm", ["-N", "96", "-M", "80", "-K", "64", "-t", "32",
+                       "-x"]),
+    ("testing_dgeqrf", ["-N", "96", "-M", "96", "-t", "32", "-x"]),
+    ("testing_dpotrf_dtd", ["-N", "96", "-t", "32", "-x"]),
+    ("testing_dgemm_dtd", ["-N", "64", "-M", "64", "-K", "64", "-t",
+                           "32", "-x"]),
+    ("testing_dpivgen", ["-N", "128", "-t", "16", "-v"]),
+    ("testing_dgetrf_1d", ["-N", "96", "-t", "32", "-x"]),
+    ("testing_dhbrdt", ["-N", "64", "-t", "16", "-x"]),
+    ("testing_dgebrd_ge2gb", ["-N", "64", "-M", "64", "-t", "16", "-x"]),
+    ("testing_dunmqr_hqr", ["-N", "64", "-M", "64", "-t", "16"]),
+    ("testing_dgeqrf_rd", ["-N", "64", "-M", "64", "-t", "16", "-x"]),
+])
+def test_driver_runs_clean(prog, args, capsys):
+    rc = main(args, prog=prog)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "TIME(s)" in out or "pivgen" in out
+    assert "FAILED" not in out
+
+
+def test_driver_distributed_grid(capsys):
+    rc = main(["-N", "128", "-t", "16", "-P", "2", "-Q", "4", "-x"],
+              prog="testing_dpotrf")
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PxQxg=   2 4" in out
+
+
+def test_driver_dot_dump(tmp_path, capsys):
+    dot = str(tmp_path / "dag.dot")
+    rc = main(["-N", "64", "-t", "16", f"--dot={dot}", "-v"],
+              prog="testing_dpotrf")
+    capsys.readouterr()
+    assert rc == 0
+    text = open(dot).read()
+    assert "digraph" in text and "potrf(0)" in text
+
+
+def test_driver_unknown_and_usage(capsys):
+    assert main([], prog=None) == 2
+    assert main(["-N", "8"], prog="testing_dnotanalgo") == 2
