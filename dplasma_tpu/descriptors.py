@@ -214,6 +214,36 @@ class TileMatrix:
         data = out.data.at[idx, idx].set(jnp.asarray(value, self.dtype))
         return self.like(data)
 
+    # -- specialized views (ref SURVEY §2.1 descriptor variants) -------
+    def subtile_view(self, i: int, j: int, mb2: int, nb2: int) \
+            -> "TileMatrix":
+        """Tile (i, j) as its own TileMatrix with finer mb2×nb2 tiling —
+        the ``subtile_desc_create`` analogue (ref src/zpotrf_L.jdf:
+        157-158) backing recursive algorithms (-z/--HNB): the nested
+        sweep runs on the view, :meth:`set_tile` writes it back."""
+        t = self.tile(i, j)
+        return TileMatrix.from_dense(t, mb2, nb2)
+
+    def sym_mirror(self, uplo: str = "L", conj: bool = True) \
+            -> "TileMatrix":
+        """Materialize both triangles from the stored ``uplo`` one —
+        the access path the reference's symmetric block-cyclic
+        descriptor provides implicitly (sym_two_dim_rectangle_cyclic:
+        only one triangle's tiles exist; consumers of the other
+        triangle read the transpose)."""
+        x = self.zero_pad().data
+        if uplo.upper() == "L":
+            lo = jnp.tril(x)
+        else:
+            lo = jnp.triu(x).conj().T if conj else jnp.triu(x).T
+        diag = jnp.diagonal(lo)
+        up = lo.conj().T if conj else lo.T
+        full = lo + up
+        idx = jnp.arange(min(full.shape))
+        full = full.at[idx, idx].set(
+            diag.real.astype(full.dtype) if conj else diag)
+        return self.like(full.astype(self.dtype))
+
     # -- conversion ----------------------------------------------------
     def astype(self, dtype) -> "TileMatrix":
         return self.like(self.data.astype(dtype))
@@ -223,3 +253,56 @@ class TileMatrix:
         return (f"TileMatrix({d.M}x{d.N}, tiles {d.mb}x{d.nb} "
                 f"[{d.MT}x{d.NT}], dist P={d.dist.P} Q={d.dist.Q}, "
                 f"{self.data.dtype})")
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BandMatrix:
+    """LAPACK-band storage: row d of ``data`` holds diagonal ``ku-d``
+    (cols aligned with the global column index), shape
+    (kl+ku+1, N). The band-descriptor analogue (the reference's band
+    specialization of parsec_matrix_block_cyclic and
+    ``parsec_diag_band_to_rect``, ref src/zheev_wrapper.c:18,97) —
+    O(N·band) storage for the band stages of the eigen/SVD chains.
+    """
+
+    data: jax.Array
+    M: int = dataclasses.field(metadata=dict(static=True))
+    N: int = dataclasses.field(metadata=dict(static=True))
+    kl: int = dataclasses.field(metadata=dict(static=True))
+    ku: int = dataclasses.field(metadata=dict(static=True))
+
+    @staticmethod
+    def from_dense(a, kl: int, ku: int) -> "BandMatrix":
+        a = jnp.asarray(a)
+        M, N = a.shape
+        rows = []
+        for d in range(ku, -kl - 1, -1):   # diag ku .. -kl
+            diag = jnp.diagonal(a, offset=d)
+            pre = max(d, 0)
+            row = jnp.zeros((N,), a.dtype)
+            row = row.at[pre:pre + diag.shape[0]].set(diag)
+            rows.append(row)
+        return BandMatrix(jnp.stack(rows), M, N, kl, ku)
+
+    @staticmethod
+    def from_tiles(A: "TileMatrix", kl: int, ku: int) -> "BandMatrix":
+        return BandMatrix.from_dense(A.to_dense(), kl, ku)
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros((self.M, self.N), self.data.dtype)
+        for i, d in enumerate(range(self.ku, -self.kl - 1, -1)):
+            diag = jnp.diagonal(out, offset=d)  # for length only
+            pre = max(d, 0)
+            n = diag.shape[0]
+            r = jnp.arange(n) + max(-d, 0)
+            c = jnp.arange(n) + max(d, 0)
+            out = out.at[r, c].set(self.data[i, pre:pre + n])
+        return out
+
+    def diagonal(self, offset: int = 0) -> jax.Array:
+        assert -self.kl <= offset <= self.ku, offset
+        row = self.ku - offset
+        pre = max(offset, 0)
+        n = min(self.M + min(offset, 0), self.N - max(offset, 0))
+        return self.data[row, pre:pre + n]

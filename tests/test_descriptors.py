@@ -59,3 +59,64 @@ def test_mesh_constrain(devices8):
     with mesh.use_grid(m):
         z = jax.jit(lambda a: mesh.constrain2d(a))(jnp.zeros((7, 5)))
     assert z.shape == (7, 5)
+
+
+def test_subtile_view_roundtrip():
+    import jax.numpy as jnp
+    import numpy as np
+    from dplasma_tpu.descriptors import TileMatrix
+    rng = np.random.default_rng(0)
+    A = TileMatrix.from_dense(
+        jnp.asarray(rng.standard_normal((64, 64))), 16, 16)
+    # view tile (1, 2) with finer 4x4 tiling (the subtile_desc_create
+    # analogue backing recursive algorithms)
+    sub = A.subtile_view(1, 2, 4, 4)
+    assert sub.shape == (16, 16) and sub.desc.mb == 4
+    assert np.allclose(np.asarray(sub.to_dense()),
+                       np.asarray(A.tile(1, 2)))
+    # write back a modified subtile
+    A2 = A.set_tile(1, 2, sub.like(sub.data * 2).to_dense())
+    assert np.allclose(np.asarray(A2.tile(1, 2)),
+                       2 * np.asarray(A.tile(1, 2)))
+
+
+def test_sym_mirror_hermitian():
+    import jax.numpy as jnp
+    import numpy as np
+    from dplasma_tpu.descriptors import TileMatrix
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((20, 20)) + 1j * rng.standard_normal((20, 20))
+    full = a + a.conj().T
+    # keep only the lower triangle; garbage above
+    stored = np.tril(full) + np.triu(rng.standard_normal((20, 20)), 1)
+    A = TileMatrix.from_dense(jnp.asarray(stored), 8, 8)
+    H = A.sym_mirror("L", conj=True)
+    h = np.asarray(H.to_dense())
+    assert np.allclose(h, h.conj().T)
+    assert np.allclose(h, full)
+    # upper storage path
+    storedU = np.triu(full) + np.tril(rng.standard_normal((20, 20)), -1)
+    AU = TileMatrix.from_dense(jnp.asarray(storedU), 8, 8)
+    assert np.allclose(np.asarray(AU.sym_mirror("U").to_dense()), full)
+
+
+def test_band_matrix_roundtrip():
+    import jax.numpy as jnp
+    import numpy as np
+    from dplasma_tpu.descriptors import BandMatrix, TileMatrix
+    rng = np.random.default_rng(2)
+    M, N, kl, ku = 17, 23, 2, 4
+    a = rng.standard_normal((M, N))
+    r = np.arange(M)[:, None]
+    c = np.arange(N)[None, :]
+    band = a * ((c - r <= ku) & (r - c <= kl))
+    B = BandMatrix.from_dense(jnp.asarray(band), kl, ku)
+    assert B.data.shape == (kl + ku + 1, N)  # O(N*band) storage
+    assert np.allclose(np.asarray(B.to_dense()), band)
+    assert np.allclose(np.asarray(B.diagonal(0)), np.diagonal(band))
+    assert np.allclose(np.asarray(B.diagonal(-2)), np.diagonal(band, -2))
+    assert np.allclose(np.asarray(B.diagonal(4)), np.diagonal(band, 4))
+    # from_tiles path
+    A = TileMatrix.from_dense(jnp.asarray(band), 8, 8)
+    B2 = BandMatrix.from_tiles(A, kl, ku)
+    assert np.allclose(np.asarray(B2.to_dense()), band)
