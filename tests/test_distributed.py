@@ -1,0 +1,32 @@
+"""Distributed lifecycle helpers (the MPI_Init/parsec_init analogue,
+ref tests/common.c:640-743) on a single-process virtual mesh."""
+import jax
+import numpy as np
+
+from dplasma_tpu.parallel import distributed, mesh
+
+
+def test_init_fini_single_process():
+    distributed.init()          # no coordinator: single-process no-op
+    assert distributed.process_index() == 0
+    assert distributed.process_count() == 1
+    distributed.fini()
+    distributed.init()          # idempotent / re-entrant
+    distributed.fini()
+
+
+def test_pod_mesh_spans_all_devices(devices8):
+    m = distributed.pod_mesh()
+    assert m.devices.size == len(jax.devices())
+    p, q = m.shape[mesh.ROW_AXIS], m.shape[mesh.COL_AXIS]
+    assert p * q == len(jax.devices())
+    m2 = distributed.pod_mesh(2, 4)
+    assert m2.shape[mesh.ROW_AXIS] == 2
+
+
+def test_local_block_covers_matrix(devices8):
+    m = distributed.pod_mesh(2, 4)
+    rs, cs = distributed.local_block((64, 64), m)
+    # single process owns everything
+    assert (rs.start, rs.stop) == (0, 64)
+    assert (cs.start, cs.stop) == (0, 64)
