@@ -167,7 +167,8 @@ def potrf(drv: Driver):
     ip = drv.ip
     A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
     A = _put(drv, A0)
-    L, _ = drv.progress(lambda a: potrf_mod.potrf(a, "L"), (A,),
+    hnb = max(ip.HMB, 0)  # -z/--HNB: recursive diagonal-tile variant
+    L, _ = drv.progress(lambda a: potrf_mod.potrf_rec(a, "L", hnb), (A,),
                         lawn41.potrf(ip.N, _is_complex(ip.prec_dtype)),
                         dag_fn=lambda rec: potrf_mod.dag(A, "L", rec))
     ret = 0

@@ -98,6 +98,37 @@ def potrf(A: TileMatrix, uplo: str = "L") -> TileMatrix:
     return TileMatrix(pmesh.constrain2d(full), A.desc)
 
 
+def potrf_rec(A: TileMatrix, uplo: str = "L",
+              hnb: int = 0) -> TileMatrix:
+    """Recursive-variant Cholesky (dplasma_zpotrf_rec, the RECURSIVE
+    chore of src/zpotrf_L.jdf:148-172 parameterized by -z/--HNB): the
+    diagonal-tile factorization is itself a nested blocked sweep over
+    ``hnb`` subtiles (via :meth:`TileMatrix.subtile_view`). On TPU this
+    mainly demonstrates the nested-taskpool structure — XLA's own tile
+    cholesky is already blocked — so it defers to :func:`potrf` with a
+    subtiled diagonal kernel."""
+    if hnb <= 0 or hnb >= A.desc.mb:
+        return potrf(A, uplo)
+    from dplasma_tpu.kernels import blas as kb
+    orig = kb.potrf
+
+    def nested(a, lower=True):
+        # nested taskpool: the inner sweep runs on hnb subtiles with the
+        # REAL tile kernel (restore while tracing it — no re-recursion)
+        kb.potrf = orig
+        try:
+            sub = TileMatrix.from_dense(a, hnb, hnb)
+            return potrf(sub, "L" if lower else "U").to_dense()
+        finally:
+            kb.potrf = nested
+
+    kb.potrf = nested
+    try:
+        return potrf(A, uplo)
+    finally:
+        kb.potrf = orig
+
+
 def dag(A: TileMatrix, uplo: str = "L", recorder=None):
     """Record the tile-level POTRF DAG (task classes potrf/trsm/herk/gemm
     with the cubic priorities of src/zpotrf_L.jdf:58-69,116,219 and

@@ -100,3 +100,19 @@ def test_factor_info():
     bad = P.potrf(generators.plghe(-100.0, N, nb, seed=3,
                                    dtype=jnp.float64))
     assert int(I.factor_info(bad)) > 0
+
+
+def test_potrf_rec_matches_flat():
+    """Recursive variant (dplasma_zpotrf_rec, -z/--HNB): nested subtile
+    sweep on the diagonal matches the flat kernel."""
+    import jax.numpy as jnp
+    import numpy as np
+    from dplasma_tpu.ops import generators, checks, potrf as potrf_mod
+    A0 = generators.plghe(117.0, 117, 25, seed=9, dtype=jnp.float64)
+    for uplo in ("L", "U"):
+        L = potrf_mod.potrf_rec(A0, uplo, hnb=8)
+        r, ok = checks.check_potrf(A0, L, uplo)
+        assert ok, (uplo, r)
+        L2 = potrf_mod.potrf(A0, uplo)
+        assert np.allclose(np.asarray(L.to_dense()),
+                           np.asarray(L2.to_dense()), atol=1e-10)
