@@ -1,0 +1,15 @@
+# Plot gemmpeak output (tools/gemmpeak/plot.gnuplot analogue):
+#   python tools/gemmpeak.py --sizes 1024,2048,4096,8192 --data peak.dat
+#   gnuplot -e "datafile='peak.dat'" tools/plot_gemmpeak.gnuplot
+if (!exists("datafile")) datafile = "peak.dat"
+set terminal pngcairo size 900,600
+set output "gemmpeak.png"
+set title "GEMM attainable peak"
+set xlabel "N (square GEMM)"
+set ylabel "GFLOP/s"
+set logscale x 2
+set key left top
+set grid
+plot for [m in "default highest"] \
+    "<awk '$3==\"".m."\"' ".datafile using 4:5 \
+    with linespoints title m
