@@ -145,11 +145,28 @@ def hetrd(A: TileMatrix, uplo: str = "L"):
     return hbrdt(Bm, 2 * A.desc.nb - 1)
 
 
-def heev(A: TileMatrix, uplo: str = "L"):
-    """Eigenvalues of a Hermitian tile matrix (dplasma_zheev, jobz=N):
-    the composed chain herbt ∘ band_to_rect ∘ hbrdt (the reference's
-    parsec_compose pipeline, zheev_wrapper.c:96-103) + on-device
-    tridiagonal eigensolve. Returns ascending eigenvalues (N,)."""
+def heev(A: TileMatrix, uplo: str = "L", method: str = "auto"):
+    """Eigenvalues of a Hermitian tile matrix (dplasma_zheev, jobz=N).
+
+    ``method``:
+    * ``"2stage"`` — the composed chain herbt ∘ band_to_rect ∘ hbrdt
+      (the reference's parsec_compose pipeline, zheev_wrapper.c:96-103)
+      + on-device tridiagonal eigensolve;
+    * ``"direct"`` — XLA's dense Hermitian eigensolver (QDWH-based,
+      MXU-friendly) on the mirrored matrix. The TPU analogue of the
+      reference shipping the final eigenproblem to rank-0 LAPACK
+      (testing_zheev.c): delegate to the vendor solver where it wins;
+    * ``"auto"`` — 2stage while the scan chase stays cheap (its
+      sequential O(N²·chase_cut) rotations dominate past N ≈ 2k),
+      else direct.
+
+    Returns ascending eigenvalues (N,)."""
+    N = A.desc.M
+    if method == "auto":
+        method = "2stage" if N <= 2048 else "direct"
+    if method == "direct":
+        h = _sym_full(A, uplo, conj=True)
+        return jnp.linalg.eigvalsh(h)
     d, e = hetrd(A, uplo)
     if d.shape[0] == 1:
         return d
@@ -259,3 +276,10 @@ def gesvd(A: TileMatrix):
     w = jax.scipy.linalg.eigh_tridiagonal(
         jnp.zeros((L + 1,), d.dtype), off, eigvals_only=True)
     return w[::-1][:K]
+
+
+def gesvd_direct(A: TileMatrix):
+    """Singular values via XLA's dense SVD — the vendor-solver path
+    (the reference's rank-0 LAPACK finish generalized: delegate the
+    whole problem where the platform solver wins; see heev)."""
+    return jnp.linalg.svd(A.to_dense(), compute_uv=False)

@@ -102,3 +102,25 @@ def test_heev_on_mesh(devices8):
     a = np.asarray(_sym_full(A0, "L", conj=True))
     ref = np.linalg.eigvalsh(a)
     assert np.allclose(np.sort(np.asarray(w)), ref, atol=1e-2)
+
+
+def test_heev_direct_matches_2stage():
+    """Vendor-solver path (method='direct', the rank-0-LAPACK-finish
+    analogue) agrees with the two-stage chain."""
+    N, nb = 48, 12
+    A0 = generators.plghe(0.0, N, nb, seed=3, dtype=jnp.float64)
+    w2 = eig.heev(A0, method="2stage")
+    wd = eig.heev(A0, method="direct")
+    assert np.allclose(np.sort(np.asarray(w2)), np.sort(np.asarray(wd)),
+                       atol=1e-11 * N)
+    wa = eig.heev(A0)  # auto at this size = 2stage
+    assert np.allclose(np.sort(np.asarray(wa)), np.sort(np.asarray(w2)),
+                       atol=0)
+
+
+def test_gesvd_direct():
+    M, N, nb = 40, 56, 8
+    A0 = generators.plrnt(M, N, nb, nb, seed=5, dtype=jnp.float64)
+    s = eig.gesvd_direct(A0)
+    ref = np.linalg.svd(np.asarray(A0.to_dense()), compute_uv=False)
+    assert np.allclose(np.asarray(s), ref, atol=1e-10 * max(M, N))
