@@ -1,0 +1,67 @@
+"""FP64-equivalent GEMM from bf16 limb matmuls (kernels.dd — the
+SURVEY §7 "double-double GEMM" hard part). Accuracy is checked in
+units of the standard error bound K·eps64·(|A|·|B|), against a
+longdouble reference, side by side with numpy's own f64 error."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dplasma_tpu.kernels import dd
+
+EPS = np.finfo(np.float64).eps
+
+
+def _err_units(out, a, b):
+    refq = np.asarray(a, np.longdouble) @ np.asarray(b, np.longdouble)
+    mag = np.abs(a) @ np.abs(b)
+    K = a.shape[1]
+    return float(np.max(np.abs(out - refq) / (K * EPS * mag)))
+
+
+@pytest.mark.parametrize("M,K,N", [(64, 512, 64), (48, 4096, 32),
+                                   (33, 100, 57)])
+def test_gemm_f64_equivalent(rng, M, K, N):
+    # wide dynamic range stresses the per-row/col scaling
+    a = rng.standard_normal((M, K)) * np.exp(rng.uniform(-8, 8, (M, 1)))
+    b = rng.standard_normal((K, N)) * np.exp(rng.uniform(-8, 8, (1, N)))
+    out = np.asarray(dd.gemm_f64(jnp.asarray(a), jnp.asarray(b)))
+    e_dd = _err_units(out, a, b)
+    e_np = _err_units(a @ b, a, b)
+    # within a small factor of native f64's own rounding
+    assert e_dd < max(8 * e_np, 0.5), (e_dd, e_np)
+
+
+def test_gemm_f64_beats_f32_by_many_digits(rng):
+    M = K = N = 256
+    a = rng.standard_normal((M, K))
+    b = rng.standard_normal((K, N))
+    out = np.asarray(dd.gemm_f64(jnp.asarray(a), jnp.asarray(b)))
+    f32 = (a.astype(np.float32) @ b.astype(np.float32)).astype(np.float64)
+    ref = a @ b
+    assert np.max(np.abs(out - ref)) < 1e-10
+    assert np.max(np.abs(f32 - ref)) > 1e-6  # f32 is far worse
+
+
+def test_plan_respects_accumulator_width():
+    for K in (64, 1024, 4096, 65536):
+        w, nl = dd._plan(K, 53)
+        import math
+        assert 2 * w + math.ceil(math.log2(K)) <= 24  # exact f32 dots
+        assert w * nl >= 53  # covers the f64 mantissa
+
+
+def test_gemm_dd_alpha_beta(rng):
+    a = rng.standard_normal((32, 64))
+    b = rng.standard_normal((64, 48))
+    c = rng.standard_normal((32, 48))
+    out = np.asarray(dd.gemm_dd(1.5, jnp.asarray(a), jnp.asarray(b),
+                                -0.5, jnp.asarray(c)))
+    assert np.allclose(out, 1.5 * (a @ b) - 0.5 * c, atol=1e-11)
+
+
+def test_bits32_mode(rng):
+    a = rng.standard_normal((64, 1024))
+    b = rng.standard_normal((1024, 64))
+    out = np.asarray(dd.gemm_f64(jnp.asarray(a), jnp.asarray(b), bits=32))
+    ref = a @ b
+    assert np.max(np.abs(out - ref) / np.max(np.abs(ref))) < 1e-8
