@@ -1,0 +1,59 @@
+"""Validate the driver entry points exactly as the driver invokes them.
+
+The driver imports ``__graft_entry__`` (having possibly already
+initialized a 1-device backend) and calls ``dryrun_multichip(8)``
+directly — no conftest, no env pre-set. Round-1 failed this gate
+because the virtual-mesh bootstrap lived only under ``__main__``
+(VERDICT.md weak #1); these tests spawn fresh interpreters with a
+scrubbed environment to prove both bootstrap paths.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fresh_env():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    return env
+
+
+def _run(code: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        env=_fresh_env(),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=1500,  # > the 1200s inner re-exec timeout: never orphan it
+    )
+
+
+@pytest.mark.slow
+def test_dryrun_as_driver_calls_it_backend_preinitialized():
+    """Driver shape: backend already up (1 device), then dryrun(8)."""
+    proc = _run(
+        "import jax; jax.devices(); "
+        "import __graft_entry__ as g; g.dryrun_multichip(8); print('OK')"
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_fresh_interpreter():
+    """No backend yet: in-process virtual-CPU bootstrap path."""
+    proc = _run(
+        "import __graft_entry__ as g; g.dryrun_multichip(8); print('OK')"
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
