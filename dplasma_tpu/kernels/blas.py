@@ -41,12 +41,42 @@ def _acc_type(dtype):
     return dtype
 
 
+def _dd_active(dtype) -> bool:
+    """Should f64/c128 matmuls route through the Ozaki limb GEMM?
+
+    MCA ``dd_gemm``: ``auto`` (TPU only — where native f64 matmul is
+    slow scalar emulation, ~2.5x slower than the limb path), ``always``
+    (any backend; lets the CPU test mesh exercise the exact wiring the
+    TPU uses), ``never``.
+    """
+    if dtype not in (jnp.float64, jnp.complex128):
+        return False
+    from dplasma_tpu.utils import config as _cfg
+
+    mode = (_cfg.mca_get("dd_gemm") or "auto").lower()
+    if mode == "never":
+        return False
+    if mode == "always":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _dd_dot(a, b):
+    """f64/c128 matmul via exact bf16 limb GEMM (kernels.dd)."""
+    from dplasma_tpu.kernels import dd as _dd
+
+    return _dd.mm(a, b)
+
+
 def dot(a, b, ta: bool = False, tb: bool = False, conj_a: bool = False,
         conj_b: bool = False):
     """op(a) @ op(b) with precision/accumulator control.
 
     ``ta``/``tb`` transpose; ``conj_*`` conjugate (for the C/Z cases the
-    reference enumerates as dplasmaNoTrans/Trans/ConjTrans).
+    reference enumerates as dplasmaNoTrans/Trans/ConjTrans). d/z dtypes
+    on MXU hardware route through the FP64-equivalent limb GEMM
+    (kernels.dd) — the d-precision CORE_zgemm role, ref
+    src/cores/CMakeLists.txt + zpotrf_wrapper.c:8 "@precisions ... d".
     """
     res_dtype = jnp.result_type(a.dtype, b.dtype)
     a = a.astype(res_dtype)
@@ -59,6 +89,8 @@ def dot(a, b, ta: bool = False, tb: bool = False, conj_a: bool = False,
         a = a.T
     if tb:
         b = b.T
+    if _dd_active(res_dtype):
+        return _dd_dot(a, b)
     from dplasma_tpu.kernels import pallas_kernels as _pk
     if _pk.eligible(a, b):
         return _pk.matmul(a, b, precision=_PRECISION).astype(res_dtype)
@@ -100,6 +132,9 @@ def potrf(a, lower: bool = True):
     triangle of ``a`` (the opposite triangle may hold scratch, per the
     reference's stored-triangle contract); returns the triangular factor
     with the opposite triangle zeroed."""
+    if _dd_active(a.dtype):
+        from dplasma_tpu.kernels import dd as _dd
+        return _dd.potrf_f64(a, lower=lower)
     if lower:
         return lax.linalg.cholesky(a, symmetrize_input=False)
     # upper storage: the Hermitian matrix's lower representation is a^H;
@@ -110,6 +145,10 @@ def potrf(a, lower: bool = True):
 def trsm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
     """Triangular solve: solves op(A) X = alpha B (side=L) or
     X op(A) = alpha B (side=R). CORE_ztrsm semantics."""
+    if _dd_active(jnp.result_type(a.dtype, b.dtype)):
+        from dplasma_tpu.kernels import dd as _dd
+        return _dd.trsm_f64(a, b, side=side, lower=lower, trans=trans,
+                            unit=unit, alpha=alpha)
     transpose = trans in ("T", "C")
     conj = trans == "C"
     x = lax.linalg.triangular_solve(
@@ -183,6 +222,9 @@ def lauum(a, lower: bool = True):
 
 def trtri(a, *, lower=True, unit=False):
     """Tile triangular inverse via solve against identity."""
+    if _dd_active(a.dtype):
+        from dplasma_tpu.kernels import dd as _dd
+        return _dd.trtri_f64(a, lower=lower, unit=unit)
     n = a.shape[0]
     eye = jnp.eye(n, dtype=a.dtype)
     return lax.linalg.triangular_solve(

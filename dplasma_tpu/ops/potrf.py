@@ -39,11 +39,15 @@ from dplasma_tpu.ops.aux import _tri_mask
 from dplasma_tpu.parallel import mesh as pmesh
 
 
-def potrf(A: TileMatrix, uplo: str = "L") -> TileMatrix:
+def potrf(A: TileMatrix, uplo: str = "L", *, diag_kernel=None) -> TileMatrix:
     """Tile Cholesky: A = L L^H (uplo=L) or A = U^H U (uplo=U).
 
     Left-looking block-column algorithm (see module docstring); the
-    opposite triangle of the result is zero."""
+    opposite triangle of the result is zero. ``diag_kernel`` replaces
+    the diagonal-tile factorizer (kernels.blas.potrf) — the RECURSIVE
+    chore hook (no module-global monkeypatching, round-1 ADVICE).
+    """
+    dk = diag_kernel if diag_kernel is not None else k.potrf
     assert A.desc.mb == A.desc.nb, "potrf needs square tiles"
     assert A.desc.M == A.desc.N, "potrf needs a square matrix"
     nt = A.desc.KT
@@ -64,7 +68,7 @@ def potrf(A: TileMatrix, uplo: str = "L") -> TileMatrix:
                 off = s - j * mb
                 col = col - k.dot(Lj[off:, :], Lj[off:off + mb, :],
                                   tb=True, conj_b=True)
-            lkk = k.potrf(col[:mb], lower=True)
+            lkk = dk(col[:mb], lower=True)
             if s + mb < Mp:
                 pan = k.trsm(lkk, col[mb:], side="R", lower=True,
                              trans="C")
@@ -78,7 +82,7 @@ def potrf(A: TileMatrix, uplo: str = "L") -> TileMatrix:
                 off = s - j * mb
                 row = row - k.dot(Uj[:, off:off + mb], Uj[:, off:],
                                   ta=True, conj_a=True)
-            ukk = k.potrf(row[:, :mb], lower=False)
+            ukk = dk(row[:, :mb], lower=False)
             if s + mb < Mp:
                 pan = k.trsm(ukk, row[:, mb:], side="L", lower=False,
                              trans="C")
@@ -109,24 +113,14 @@ def potrf_rec(A: TileMatrix, uplo: str = "L",
     subtiled diagonal kernel."""
     if hnb <= 0 or hnb >= A.desc.mb:
         return potrf(A, uplo)
-    from dplasma_tpu.kernels import blas as kb
-    orig = kb.potrf
 
     def nested(a, lower=True):
-        # nested taskpool: the inner sweep runs on hnb subtiles with the
-        # REAL tile kernel (restore while tracing it — no re-recursion)
-        kb.potrf = orig
-        try:
-            sub = TileMatrix.from_dense(a, hnb, hnb)
-            return potrf(sub, "L" if lower else "U").to_dense()
-        finally:
-            kb.potrf = nested
+        # nested taskpool: the inner sweep runs on hnb subtiles with
+        # the real tile kernel (plain default — no re-recursion)
+        sub = TileMatrix.from_dense(a, hnb, hnb)
+        return potrf(sub, "L" if lower else "U").to_dense()
 
-    kb.potrf = nested
-    try:
-        return potrf(A, uplo)
-    finally:
-        kb.potrf = orig
+    return potrf(A, uplo, diag_kernel=nested)
 
 
 def dag(A: TileMatrix, uplo: str = "L", recorder=None):

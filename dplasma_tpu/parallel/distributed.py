@@ -111,15 +111,24 @@ def local_block(A_shape, m) -> tuple:
     ``jax.make_array_from_process_local_data`` — the analogue of the
     reference's per-rank local tile allocation
     (parsec_data_allocate, tests/common.h:182-190)."""
+    import math
+
     import numpy as np
     rows, cols = A_shape
     pr = m.shape[pmesh.ROW_AXIS]
     qc = m.shape[pmesh.COL_AXIS]
-    # which mesh coordinates live on this process?
+    # which mesh coordinates live on this process? (assumes each
+    # process owns a contiguous device rectangle, the standard
+    # multi-host mesh layout)
     local = {d for d in jax.local_devices()}
     coords = np.argwhere(np.isin(m.devices, list(local)))
-    r0 = coords[:, 0].min() * (rows // pr)
-    r1 = (coords[:, 0].max() + 1) * (rows // pr)
-    c0 = coords[:, 1].min() * (cols // qc)
-    c1 = (coords[:, 1].max() + 1) * (cols // qc)
+    # GSPMD shard boundaries: every shard is ceil(dim/parts) with the
+    # last one short — floor division gave wrong slices for shapes not
+    # divisible by the grid (round-1 ADVICE)
+    sr = math.ceil(rows / pr)
+    sc = math.ceil(cols / qc)
+    r0 = min(int(coords[:, 0].min()) * sr, rows)
+    r1 = min((int(coords[:, 0].max()) + 1) * sr, rows)
+    c0 = min(int(coords[:, 1].min()) * sc, cols)
+    c1 = min((int(coords[:, 1].max()) + 1) * sc, cols)
     return slice(r0, r1), slice(c0, c1)

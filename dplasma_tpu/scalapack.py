@@ -65,7 +65,7 @@ def dispatch(name: str, args) -> int:
     # host CPU backend — TPU lacks f64 factorization expanders.
     import contextlib
     import jax
-    prec = next((_c(a) for a in args if _c(a) in _NP_DTYPE), "d")
+    prec = _prec_of(args)
     ctx = contextlib.nullcontext()
     if prec == "d":
         # only the d-precision ABI needs x64; don't disturb f32 hosts
@@ -199,6 +199,22 @@ def _c(x) -> str:
     if isinstance(x, bytes):
         return x.decode()
     return str(x)
+
+
+def _prec_of(args) -> str:
+    """First precision letter among char-like args. Pointer-sized ints
+    (or any non-char value) are skipped rather than blowing up chr() —
+    the dispatch must not depend on argument order (round-1 ADVICE)."""
+    for a in args:
+        if isinstance(a, int) and not 0 <= a < 0x110000:
+            continue
+        try:
+            c = _c(a)
+        except (ValueError, OverflowError, UnicodeDecodeError):
+            continue
+        if c in _NP_DTYPE:
+            return c
+    return "d"
 
 
 _HANDLERS = {

@@ -160,3 +160,8 @@ mca_register("gemm.lookahead", "2",
 mca_register("runtime.scheduler", "wavefront",
              "Trace-time tile ordering policy (analog of the 8 PaRSEC "
              "scheduler modules, tests/common.c:35-45).")
+mca_register("dd_gemm", "auto",
+             "FP64-equivalent limb GEMM for f64/c128 matmuls: auto "
+             "(MXU backends only), always, never. The d/z-precision "
+             "CORE_*gemm substrate on hardware without native f64 "
+             "matmul units.")

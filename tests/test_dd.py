@@ -31,6 +31,156 @@ def test_gemm_f64_equivalent(rng, M, K, N):
     assert e_dd < max(8 * e_np, 0.5), (e_dd, e_np)
 
 
+def test_dd_wired_into_tile_kernels(rng, monkeypatch):
+    """MCA dd_gemm=always routes kernels.blas.dot f64/c128 through the
+    limb GEMM — the exact wiring the TPU d-precision path uses."""
+    from dplasma_tpu.kernels import blas as kb
+    from dplasma_tpu.utils import config as cfg
+
+    calls = []
+    orig = dd.gemm_f64
+    monkeypatch.setattr(dd, "gemm_f64", lambda *a, **k: calls.append(1) or orig(*a, **k))
+    monkeypatch.setitem(cfg._MCA_OVERRIDES, "dd_gemm", "always")
+    a = rng.standard_normal((40, 64))
+    b = rng.standard_normal((64, 32))
+    out = np.asarray(kb.dot(jnp.asarray(a), jnp.asarray(b)))
+    assert calls, "dd path not engaged under dd_gemm=always"
+    np.testing.assert_allclose(out, a @ b, rtol=1e-12, atol=1e-12)
+
+    za = a[:, :32] + 1j * a[:, 32:]
+    zb = b[:32] + 1j * b[32:]
+    zout = np.asarray(kb.dot(jnp.asarray(za), jnp.asarray(zb)))
+    np.testing.assert_allclose(zout, za @ zb, rtol=1e-12, atol=1e-12)
+
+    monkeypatch.setitem(cfg._MCA_OVERRIDES, "dd_gemm", "never")
+    calls.clear()
+    np.asarray(kb.dot(jnp.asarray(a), jnp.asarray(b)))
+    assert not calls
+
+
+def test_dd_potrf_end_to_end(rng):
+    """d-precision blocked POTRF runs entirely through the limb GEMM
+    path and still meets the reference residual check (threshold 60,
+    ref tests/testing_zpotrf.c check)."""
+    from dplasma_tpu.descriptors import TileMatrix
+    from dplasma_tpu.ops import checks, generators, potrf as potrf_mod
+    from dplasma_tpu.utils import config as cfg
+
+    cfg.mca_set("dd_gemm", "always")
+    try:
+        N, nb = 192, 64
+        A = generators.plghe(float(N), N, nb, seed=11, dtype=jnp.float64)
+        L = potrf_mod.potrf(A, "L")
+        res, ok = checks.check_potrf(A, L, "L")
+        assert ok, res
+    finally:
+        cfg._MCA_OVERRIDES.pop("dd_gemm", None)
+
+
+@pytest.mark.parametrize("kappa", [1.0, 1e3, 1e6])
+def test_potrf_f64_refinement_accuracy(rng, kappa):
+    """f32-seed + limb-IR tile Cholesky reaches f64-level residuals
+    even for ill-conditioned tiles (the d-precision CORE_zpotrf role)."""
+    n = 96
+    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    d = np.logspace(0, np.log10(kappa), n)
+    A = (q * d) @ q.T
+    A = (A + A.T) / 2
+    L = np.asarray(dd.potrf_f64(jnp.asarray(A), lower=True))
+    resid = np.abs(L @ L.T - A).max() / (np.abs(A).max() * n * EPS)
+    assert resid < 60.0, resid
+    if kappa >= 1e3:
+        # f32 alone is orders of magnitude worse once conditioning bites
+        L32 = np.linalg.cholesky(A.astype(np.float32)).astype(np.float64)
+        r32 = np.abs(L32 @ L32.T - A).max() / (np.abs(A).max() * n * EPS)
+        assert r32 > 100 * max(resid, 1.0)
+
+
+def test_potrf_f64_upper_and_complex(rng):
+    n = 64
+    a = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    A = a @ a.conj().T + n * np.eye(n)
+    U = np.asarray(dd.potrf_f64(jnp.asarray(A), lower=False))
+    resid = np.abs(U.conj().T @ U - A).max() / (np.abs(A).max() * n * EPS)
+    assert resid < 60.0, resid
+
+
+@pytest.mark.parametrize("side,trans", [("L", "N"), ("L", "T"),
+                                        ("R", "N"), ("R", "C")])
+def test_trsm_f64_accuracy(rng, side, trans):
+    n, m = 80, 48
+    T = np.tril(rng.standard_normal((n, n))) + n * np.eye(n)
+    B = rng.standard_normal((n, m) if side == "L" else (m, n))
+    X = np.asarray(dd.trsm_f64(jnp.asarray(T), jnp.asarray(B),
+                               side=side, lower=True, trans=trans,
+                               alpha=2.0))
+    op = T.T if trans in ("T", "C") else T
+    ref = (np.linalg.solve(op, 2.0 * B) if side == "L"
+           else (2.0 * B) @ np.linalg.inv(op))
+    err = np.abs(X - ref).max() / (np.abs(ref).max() * n * EPS)
+    assert err < 100.0, err
+
+
+def test_trsm_f64_stored_triangle_contract(rng):
+    """trsm/trtri must read ONLY the named triangle: a packed L\\U tile
+    (scratch in the opposite triangle) must solve identically to the
+    masked tile — the round-2 review repro (getrf under dd)."""
+    n, m = 48, 32
+    packed = rng.standard_normal((n, n)) + n * np.eye(n)  # both triangles
+    B = rng.standard_normal((m, n))
+    clean = np.tril(packed)
+    out_packed = np.asarray(dd.trsm_f64(jnp.asarray(packed),
+                                        jnp.asarray(B), side="R",
+                                        lower=True, trans="N"))
+    out_clean = np.asarray(dd.trsm_f64(jnp.asarray(clean),
+                                       jnp.asarray(B), side="R",
+                                       lower=True, trans="N"))
+    np.testing.assert_allclose(out_packed, out_clean, rtol=1e-12)
+    # unit-diagonal variant ignores the stored diagonal too
+    u = np.asarray(dd.trtri_f64(jnp.asarray(packed), lower=True,
+                                unit=True))
+    ref = np.linalg.inv(np.tril(packed, -1) + np.eye(n))
+    # unit-lower inverses grow exponentially; compare to the scale of
+    # the result (both sides carry ~kappa*eps64 rounding)
+    np.testing.assert_allclose(u, ref, rtol=1e-6,
+                               atol=1e-12 * np.abs(ref).max())
+
+
+def test_getrf_f64_under_dd(rng):
+    """Blocked f64 LU runs correctly with every trsm/dot on the dd
+    path (the TPU d-precision route)."""
+    from dplasma_tpu.descriptors import TileMatrix
+    from dplasma_tpu.ops import lu as lu_mod
+    from dplasma_tpu.utils import config as cfg
+
+    cfg.mca_set("dd_gemm", "always")
+    try:
+        N, nb = 96, 32
+        a = rng.standard_normal((N, N)) + N * np.eye(N)
+        A = TileMatrix.from_dense(jnp.asarray(a), nb, nb)
+        LU, perm = lu_mod.getrf_1d(A)
+        x = np.asarray(LU.to_dense())
+        L = np.tril(x, -1) + np.eye(N)
+        U = np.triu(x)
+        resid = np.abs(a[np.asarray(perm)] - L @ U).max() / (
+            np.abs(a).max() * N * EPS)
+        assert resid < 100.0, resid
+    finally:
+        cfg._MCA_OVERRIDES.pop("dd_gemm", None)
+
+
+def test_gemm_f64_chunked_deep_k(rng):
+    # K > KC exercises the batched chunk path (exactness must not
+    # degrade with reduction depth — the round-1 clamp bug)
+    M, K, N = 16, 3 * dd.KC + 17, 24
+    a = rng.standard_normal((M, K)) * np.exp(rng.uniform(-6, 6, (M, 1)))
+    b = rng.standard_normal((K, N)) * np.exp(rng.uniform(-6, 6, (1, N)))
+    out = np.asarray(dd.gemm_f64(jnp.asarray(a), jnp.asarray(b)))
+    e_dd = _err_units(out, a, b)
+    e_np = _err_units(a @ b, a, b)
+    assert e_dd < max(8 * e_np, 0.5), (e_dd, e_np)
+
+
 def test_gemm_f64_beats_f32_by_many_digits(rng):
     M = K = N = 256
     a = rng.standard_normal((M, K))
@@ -43,11 +193,13 @@ def test_gemm_f64_beats_f32_by_many_digits(rng):
 
 
 def test_plan_respects_accumulator_width():
-    for K in (64, 1024, 4096, 65536):
-        w, nl = dd._plan(K, 53)
-        import math
-        assert 2 * w + math.ceil(math.log2(K)) <= 24  # exact f32 dots
+    import math
+    for K in (64, 1024, 4096, 65536, 2**20):
+        w, nl, kc = dd._plan(K, 53)
+        assert 2 * w + math.ceil(math.log2(kc)) <= 24  # exact f32 dots
         assert w * nl >= 53  # covers the f64 mantissa
+        # int32 level sums stay exact (ADVICE round-1: no silent clamp)
+        assert ((nl + 1) // 2) * K * (2 ** (2 * w)) < 2 ** 31
 
 
 def test_gemm_dd_alpha_beta(rng):
