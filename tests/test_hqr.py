@@ -31,11 +31,56 @@ def test_pivgen_svd(MT, p, ratio):
     hqr.check_tree(hqr.svd_tree(MT, p, ratio))
 
 
+@pytest.mark.parametrize("domino", [False, True])
+@pytest.mark.parametrize("tsrr", [False, True])
+@pytest.mark.parametrize("a,p", [(2, 2), (3, 2), (2, 3)])
+@pytest.mark.parametrize("MT", [5, 8, 13])
+def test_pivgen_domino_tsrr(MT, a, p, domino, tsrr):
+    tree = hqr.hqr_tree(MT, llvl="greedy", a=a, p=p, domino=domino,
+                        tsrr=tsrr)
+    hqr.check_tree(tree)
+
+
+def test_greedy_is_coupled_not_greedy1p():
+    """The LOW greedy tree is arrival-coupled across columns
+    (dplasma_hqr.c:660-750); GREEDY1P folds each column independently
+    (dplasma_hqr.c:789-836). Their schedules must genuinely differ."""
+    t_g = hqr.hqr_tree(13, llvl="greedy", a=1, p=1)
+    t_1p = hqr.hqr_tree(13, llvl="greedy1p", a=1, p=1)
+    assert any(t_g.schedule(k) != t_1p.schedule(k) for k in range(13))
+    hqr.check_tree(t_g)
+    hqr.check_tree(t_1p)
+
+
+def test_domino_raises_tt_proportion():
+    """Domino converts band rows from TS-grouped kills to TT chain
+    kills (the documented effect, dplasma_hqr.c:1755-1762)."""
+    def tt_count(tree):
+        return sum(1 for k in range(tree.MT) for e in tree.schedule(k)
+                   if e.kind == hqr.TT)
+    base = hqr.hqr_tree(16, llvl="greedy", a=4, p=2, domino=False)
+    dom = hqr.hqr_tree(16, llvl="greedy", a=4, p=2, domino=True)
+    assert tt_count(dom) > tt_count(base)
+
+
+def test_tsrr_rotates_ts_leader():
+    """tsrr round-robins the leader within full aligned TS groups
+    across panels (hqr_genperm, dplasma_hqr.c:1591-1628)."""
+    t = hqr.hqr_tree(12, llvl="flat", a=3, p=1, tsrr=True)
+    base = hqr.hqr_tree(12, llvl="flat", a=3, p=1, tsrr=False)
+    assert any(t.leaders(k) != base.leaders(k) for k in range(12))
+    hqr.check_tree(t)
+
+
 TREES = [
     dict(llvl="flat", hlvl="flat", a=1, p=1),
     dict(llvl="greedy", hlvl="flat", a=2, p=2),
     dict(llvl="binary", hlvl="greedy", a=1, p=3),
     dict(llvl="fibonacci", hlvl="greedy", a=3, p=2),
+    dict(llvl="greedy1p", hlvl="flat", a=2, p=2),
+    dict(llvl="greedy", hlvl="flat", a=2, p=2, domino=True),
+    dict(llvl="flat", hlvl="flat", a=3, p=1, tsrr=True),
+    dict(llvl="greedy", hlvl="greedy", a=2, p=3, domino=True, tsrr=True),
 ]
 
 
