@@ -1,0 +1,63 @@
+"""Layout redistribution engine.
+
+The reference's ``parsec_redistribute`` moves a (sub)matrix between two
+arbitrary block-cyclic distributions — powering the ScaLAPACK wrappers'
+input conversion (ref src/scalapack_wrappers/common.c:26-90) and the
+ADTT LAPACK<->TILED relayouts (src/utils/dplasma_lapack_adtt.c).
+
+TPU-native design: redistribution pivots through the natural-order
+global array. Both endpoints are gather index maps (trace-time tables
+from parallel/layout.py), so the whole operation is two XLA gathers —
+GSPMD turns the sharding change into the minimal all-to-all over the
+mesh, which is exactly the collective schedule the reference's engine
+computes by hand.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dplasma_tpu.descriptors import Dist, TileMatrix
+from dplasma_tpu.parallel.cyclic import CyclicMatrix
+
+
+def redistribute(src: CyclicMatrix | TileMatrix, dist_to: Dist,
+                 mb: int | None = None, nb: int | None = None,
+                 *, size: tuple[int, int] | None = None,
+                 offset_src: tuple[int, int] = (0, 0),
+                 offset_dst: tuple[int, int] = (0, 0)) -> CyclicMatrix:
+    """Copy (a submatrix of) ``src`` into a fresh matrix distributed by
+    ``dist_to`` (optionally retiled to ``mb`` x ``nb``).
+
+    ``size``/``offset_src``/``offset_dst`` mirror parsec_redistribute's
+    submatrix parameters (size_row/size_col, disi/disj): ``size`` rows x
+    cols are read starting at ``offset_src`` and written starting at
+    ``offset_dst``; the target shape grows to fit.
+    """
+    T = src.to_tile() if isinstance(src, CyclicMatrix) else src
+    dense = T.to_dense()
+    M, N = dense.shape
+    si, sj = offset_src
+    if size is None:
+        size = (M - si, N - sj)
+    ti, tj = offset_dst
+    sub = dense[si:si + size[0], sj:sj + size[1]]
+    out_m, out_n = ti + size[0], tj + size[1]
+    mb = mb or T.desc.mb
+    nb = nb or T.desc.nb
+    out = jnp.zeros((out_m, out_n), dense.dtype)
+    out = out.at[ti:ti + size[0], tj:tj + size[1]].set(sub)
+    newT = TileMatrix.from_dense(out, mb, nb, dist_to)
+    return CyclicMatrix.from_tile(newT, dist_to)
+
+
+def lapack_to_tiled(a, mb: int, nb: int,
+                    dist: Dist = Dist()) -> TileMatrix:
+    """ADTT role: adopt a LAPACK (column-major dense) matrix into tiled
+    storage (ref dplasma_lapack_adtt.c LAPACK->TILED)."""
+    return TileMatrix.from_dense(jnp.asarray(a), mb, nb, dist)
+
+
+def tiled_to_lapack(A: TileMatrix):
+    """ADTT role: flatten tiled storage back to the dense LAPACK view
+    (ref dplasma_lapack_adtt.c TILED->LAPACK)."""
+    return A.to_dense()

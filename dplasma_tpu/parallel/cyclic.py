@@ -1,0 +1,241 @@
+"""Realized 2-D block-cyclic distribution.
+
+The reference's ``parsec_matrix_block_cyclic_t`` (ref
+tests/testing_zpotrf.c:100-103, tests/common.c:79-93) owns per-rank
+LOCAL tile storage: rank (p,q) holds tiles {(i,j): owner(i)=p,
+owner(j)=q} packed contiguously, which is what load-balances the
+shrinking trailing submatrix of a factorization. Round-1 carried the
+owner-map algebra (parallel/layout.py) but sharded the global array
+contiguously, leaving supertiles/offsets inert (VERDICT §2.3).
+
+TPU-native realization: :class:`CyclicMatrix` stores the matrix as a
+``(P, Q, mloc, nloc)`` array whose leading axes are sharded one-slab-
+per-device over the ('p','q') mesh — each device's slab IS the
+reference's local tile storage, cyclic order and all. Conversions to
+and from the natural-order global array are two tile-axis gathers
+(trace-time index tables from parallel/layout.py).
+
+:func:`potrf_cyclic` then runs the ScaLAPACK-shaped right-looking
+Cholesky as a ``shard_map`` program: panel broadcast = masked ``psum``
+along 'q', diagonal broadcast = masked ``psum`` along 'p', row-panel
+formation = ``all_gather`` along 'p' + cyclic index arithmetic, local
+trailing update = one local MXU matmul per step. These are exactly the
+collectives the reference's comm engine derives from ``type_remote``
+annotations (src/zpotrf_L.jdf:109-114), riding ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from dplasma_tpu.descriptors import Dist, TileMatrix
+from dplasma_tpu.parallel import layout
+from dplasma_tpu.parallel import mesh as pmesh
+
+
+@dataclasses.dataclass(frozen=True)
+class CyclicDesc:
+    M: int
+    N: int
+    mb: int
+    nb: int
+    dist: Dist
+
+    @property
+    def MT(self):
+        return -(-self.M // self.mb)
+
+    @property
+    def NT(self):
+        return -(-self.N // self.nb)
+
+    @property
+    def MTL(self):
+        """Local row-tile slots per rank (ceil-uniform)."""
+        return max(layout.max_local_count(self.MT, self.dist.P,
+                                          self.dist.kp), 1)
+
+    @property
+    def NTL(self):
+        return max(layout.max_local_count(self.NT, self.dist.Q,
+                                          self.dist.kq), 1)
+
+
+class CyclicMatrix:
+    """Block-cyclic distributed matrix: data (P, Q, MTL*mb, NTL*nb)."""
+
+    def __init__(self, data: jax.Array, desc: CyclicDesc):
+        self.data = data
+        self.desc = desc
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    # -- conversions ---------------------------------------------------
+    @staticmethod
+    def from_tile(A: TileMatrix, dist: Dist | None = None,
+                  mesh=None) -> "CyclicMatrix":
+        """Gather a natural-order TileMatrix into cyclic local slabs."""
+        d = dist or A.desc.dist
+        desc = CyclicDesc(A.desc.M, A.desc.N, A.desc.mb, A.desc.nb, d)
+        MT, NT = desc.MT, desc.NT
+        mb, nb = desc.mb, desc.nb
+        X = A.zero_pad().data  # (MT*mb, NT*nb), natural order
+        P, Q = d.P, d.Q
+        # row tile table: gi[p, l] = global tile of local slot l on p
+        gi = np.array([[layout.global_index(l, p, P, d.kp, d.ip)
+                        for l in range(desc.MTL)] for p in range(P)])
+        gj = np.array([[layout.global_index(l, q, Q, d.kq, d.jq)
+                        for l in range(desc.NTL)] for q in range(Q)])
+        rvalid = (gi < MT)
+        cvalid = (gj < NT)
+        Xr = X.reshape(MT, mb, NT * nb)
+        Xr = jnp.where(jnp.asarray(rvalid)[:, :, None, None],
+                       Xr[jnp.asarray(gi.clip(max=MT - 1))], 0)
+        # (P, MTL, mb, NT*nb) -> columns
+        Xc = Xr.reshape(P, desc.MTL * mb, NT, nb)
+        Xc = jnp.where(jnp.asarray(cvalid)[None, :, None, :, None],
+                       Xc[:, :, jnp.asarray(gj.clip(max=NT - 1))]
+                       .transpose(0, 2, 1, 3, 4), 0)
+        # (P, Q, MTL*mb, NTL, nb) -> (P, Q, mloc, nloc)
+        data = Xc.reshape(P, Q, desc.MTL * mb, desc.NTL * nb)
+        m = mesh or pmesh.active()
+        if (m is not None and m.shape[pmesh.ROW_AXIS] == P
+                and m.shape[pmesh.COL_AXIS] == Q):
+            data = jax.lax.with_sharding_constraint(
+                data, NamedSharding(m, PartitionSpec(
+                    pmesh.ROW_AXIS, pmesh.COL_AXIS, None, None)))
+        return CyclicMatrix(data, desc)
+
+    def to_tile(self) -> TileMatrix:
+        """Scatter cyclic slabs back to the natural-order TileMatrix."""
+        desc = self.desc
+        d = desc.dist
+        MT, NT = desc.MT, desc.NT
+        mb, nb = desc.mb, desc.nb
+        own_r = np.array([layout.owner(i, d.P, d.kp, d.ip)
+                          for i in range(MT)])
+        loc_r = np.array([layout.local_index(i, d.P, d.kp)
+                          for i in range(MT)])
+        own_c = np.array([layout.owner(j, d.Q, d.kq, d.jq)
+                          for j in range(NT)])
+        loc_c = np.array([layout.local_index(j, d.Q, d.kq)
+                          for j in range(NT)])
+        Xr = self.data.reshape(d.P, d.Q, desc.MTL, mb,
+                               desc.NTL, nb)
+        # natural[i, j] = data[own_r[i], own_c[j], loc_r[i], :, loc_c[j], :]
+        g = Xr[jnp.asarray(own_r), :, jnp.asarray(loc_r)]
+        # (MT, Q, mb, NTL, nb)
+        g = g[:, jnp.asarray(own_c), :, jnp.asarray(loc_c)]
+        # (NT, MT, mb, nb) — leading advanced-index axes group together
+        g = g.transpose(1, 2, 0, 3).reshape(MT * mb, NT * nb)
+        from dplasma_tpu.descriptors import TileMatrix as TM
+        out = TM.zeros(desc.M, desc.N, mb, nb, dist=d)
+        full = g[:out.data.shape[0], :out.data.shape[1]]
+        return TM(full, out.desc)
+
+
+def _grow(lslots: int, nb: int, rank, P: int, kp: int, ip: int):
+    """Global tile index per local element row (vectorized, dynamic
+    rank): g(l) = (l//kp * P + (rank - ip) % P) * kp + l % kp."""
+    l = jnp.arange(lslots * nb) // nb
+    return ((l // kp) * P + (rank - ip) % P) * kp + l % kp
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh_shape):
+    d = desc.dist
+    P, Q = d.P, d.Q
+    mb = desc.mb
+    assert desc.mb == desc.nb and desc.M == desc.N
+    KT = min(desc.MT, desc.NT)
+    mloc = desc.MTL * mb
+    nloc = desc.NTL * mb
+    cplx = jnp.iscomplexobj(data)
+
+    def ct(x):
+        return x.conj().T if cplx else x.T
+
+    def body(local):
+        from dplasma_tpu.kernels import blas as kb
+        A = local.reshape(mloc, nloc)
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        grow = _grow(desc.MTL, mb, p, P, d.kp, d.ip)      # (mloc,)
+        gcol = _grow(desc.NTL, mb, q, Q, d.kq, d.jq)      # (nloc,)
+        for k in range(KT):
+            pk = layout.owner(k, P, d.kp, d.ip)
+            qk = layout.owner(k, Q, d.kq, d.jq)
+            lrk = layout.local_index(k, P, d.kp)
+            lck = layout.local_index(k, Q, d.kq)
+            # 1) broadcast block column k along 'q' (panel bcast)
+            cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
+            pan = jax.lax.psum(
+                jnp.where(q == qk, cs, jnp.zeros_like(cs)),
+                pmesh.COL_AXIS)
+            # 2) broadcast diagonal tile along 'p'
+            dt = jax.lax.dynamic_slice_in_dim(pan, lrk * mb, mb, axis=0)
+            ddt = jax.lax.psum(
+                jnp.where(p == pk, dt, jnp.zeros_like(dt)),
+                pmesh.ROW_AXIS)
+            Lkk = kb.potrf(ddt, lower=True)
+            # 3) local panel solve (rows strictly below k)
+            sol = kb.trsm(Lkk, pan, side="R", lower=True, trans="C")
+            below = (grow > k)[:, None]
+            diagrow = ((grow == k) & (p == pk))[:, None]
+            at_k = jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros_like(pan), Lkk, lrk * mb, axis=0)
+            Lpan = jnp.where(below, sol, jnp.where(diagrow, at_k, 0))
+            # 4) owners write the factored panel back
+            keep = (grow >= k)[:, None]
+            newcs = jnp.where(keep, Lpan, cs)
+            A = jnp.where(q == qk,
+                          jax.lax.dynamic_update_slice_in_dim(
+                              A, newcs, lck * mb, axis=1), A)
+            # 5) row panel: all_gather along 'p' + cyclic row pick
+            allg = jax.lax.all_gather(Lpan, pmesh.ROW_AXIS)
+            allg = allg.reshape(P * mloc, mb)
+            jt = gcol                                   # (nloc,) tiles
+            pj = (jt // d.kp + d.ip) % P
+            lj = (jt // (d.kp * P)) * d.kp + jt % d.kp
+            idx = pj * mloc + lj * mb + jnp.arange(nloc) % mb
+            W = jnp.where((jt > k)[:, None], allg[idx], 0)  # (nloc, mb)
+            # 6) local trailing update (one MXU matmul)
+            Lbelow = jnp.where(below, Lpan, 0)
+            A = A - kb.dot(Lbelow, ct(W))
+        return A.reshape(1, 1, mloc, nloc)
+
+    m = pmesh.active()
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    f = shard_map(
+        body, mesh=m,
+        in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                               None),
+        out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None))
+    return f(data)
+
+
+def potrf_cyclic(A: CyclicMatrix, uplo: str = "L") -> CyclicMatrix:
+    """Distributed right-looking Cholesky on block-cyclic local storage
+    (the pdpotrf shape; ref src/zpotrf_L.jdf over
+    parsec_matrix_block_cyclic). Lower only; the global-array
+    left-looking :func:`dplasma_tpu.ops.potrf.potrf` remains the
+    single-chip path."""
+    assert uplo.upper() == "L", "cyclic potrf: lower storage only"
+    m = pmesh.active()
+    assert m is not None, "potrf_cyclic needs an active mesh (use_grid)"
+    ms = (m.shape[pmesh.ROW_AXIS], m.shape[pmesh.COL_AXIS])
+    assert ms == (A.desc.dist.P, A.desc.dist.Q), (
+        f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
+    out = _potrf_cyclic_jit(A.data, A.desc, ms)
+    return CyclicMatrix(out, A.desc)
