@@ -142,6 +142,22 @@ def potrf(a, lower: bool = True):
     return lax.linalg.cholesky(a.conj().T, symmetrize_input=False).conj().T
 
 
+def _inv_trsm_active() -> bool:
+    """Should trsm run as (triangular inverse) x (matmul)?
+
+    Inverting the nb-sized triangle once (cheap solve against the
+    identity) and multiplying is the trick cuBLAS trsm uses internally.
+    MCA ``trsm_inv``: ``auto``/``never`` use the native solve —
+    an A/B grid over all side/uplo/trans configs measured XLA's native
+    solve at 8-44 TF/s vs 6-13 for the inverse form on current MXU
+    hardware (only L/upper/T favors inv) — ``always`` forces the
+    inverse form (any dtype), kept as a per-algorithm tuning knob.
+    """
+    from dplasma_tpu.utils import config as _cfg
+
+    return (_cfg.mca_get("trsm_inv") or "auto").lower() == "always"
+
+
 def trsm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
     """Triangular solve: solves op(A) X = alpha B (side=L) or
     X op(A) = alpha B (side=R). CORE_ztrsm semantics."""
@@ -151,6 +167,15 @@ def trsm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
                             unit=unit, alpha=alpha)
     transpose = trans in ("T", "C")
     conj = trans == "C"
+    if _inv_trsm_active():
+        n = a.shape[0]
+        inv_op = lax.linalg.triangular_solve(
+            a, jnp.eye(n, dtype=a.dtype),
+            left_side=True, lower=lower, transpose_a=transpose,
+            conjugate_a=conj, unit_diagonal=unit)
+        if side == "L":
+            return dot(inv_op, alpha * b)
+        return dot(alpha * b, inv_op)
     x = lax.linalg.triangular_solve(
         a, alpha * b,
         left_side=(side == "L"),
@@ -209,6 +234,28 @@ def getrf_nopiv(a):
         return m
 
     return lax.fori_loop(0, min(a.shape), body, a)
+
+
+def getrf_nopiv_blocked(a, base: int = 32):
+    """Blocked-recursive LU without pivoting: packed L\\U of a square
+    tile. Same contract as :func:`getrf_nopiv`, but the O(n) sequential
+    rank-1 loop only runs inside ``base``-sized diagonal blocks — all
+    off-diagonal work is trsm/matmul (MXU-shaped). Used by the
+    CholeskyQR2 Householder reconstruction panel (ops level never calls
+    unpivoted LU on user data)."""
+    n = a.shape[0]
+    if n <= base:
+        return getrf_nopiv(a)
+    n1 = n // 2
+    a11, a12 = a[:n1, :n1], a[:n1, n1:]
+    a21, a22 = a[n1:, :n1], a[n1:, n1:]
+    p11 = getrf_nopiv_blocked(a11, base)
+    u12 = trsm(p11, a12, side="L", lower=True, unit=True)
+    l21 = trsm(p11, a21, side="R", lower=False)
+    p22 = getrf_nopiv_blocked(a22 - dot(l21, u12), base)
+    top = jnp.concatenate([p11, u12], axis=1)
+    bot = jnp.concatenate([l21, p22], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
 
 
 def lauum(a, lower: bool = True):

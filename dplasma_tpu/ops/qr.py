@@ -47,33 +47,50 @@ def t_desc(A: TileMatrix) -> TileMatrix:
 def geqrf(A: TileMatrix) -> tuple[TileMatrix, TileMatrix]:
     """A = Q R (dplasma_zgeqrf). Returns (packed factor, T factors).
 
-    Left-looking block-column sweep: each column block receives all
-    finished panels' reflectors as compact-WY matmuls, then its own
-    panel QR — only that column is written per step (a right-looking
-    sweep re-materializes the whole matrix per panel through XLA's
-    dynamic-update-slice; see ops.potrf)."""
+    Right-looking sweep on a *shrinking* trailing window: panel k's
+    reflector block hits the whole remaining submatrix as three wide
+    MXU matmuls (compact-WY), then the finished top row-slab and left
+    panel split off and the window shrinks. The window is a fresh value
+    each step — no dynamic-update-slice re-materialization of the full
+    matrix (the pathology that forced ops.potrf left-looking), and the
+    per-step matmuls keep their full (M-k·nb) x (N-k·nb) width instead
+    of the one-column applies of a left-looking sweep."""
     _check_square_tiles(A, "geqrf")
     nb = A.desc.nb
     KT = A.desc.KT
     NT = A.desc.NT
-    X = A.zero_pad().data
-    panels = []  # (v, T) per finished panel
-    outcols = []
+    rest = A.zero_pad().data
+    if KT == NT and rest.shape[1] > A.desc.N:
+        # Tall/square: the right-edge pad columns DO get factored.
+        # Identity-pad them (e_i) instead of zero: the pad reflectors
+        # are then exact no-ops on the valid region (v_p vanishes above
+        # row p >= N, and T's triangularity keeps pad coefficients from
+        # leaking into real columns), while keeping every panel full
+        # rank — the CholeskyQR2 panel breaks down on zero columns.
+        idx = jnp.arange(A.desc.N, rest.shape[1])
+        rest = rest.at[idx, idx].set(jnp.ones((), rest.dtype))
+    panels = []   # (v, T) per finished panel
+    packs = []    # packed panel columns (R diag + V below)
+    rrows = []    # finished nb-row R slabs right of each panel
 
+    for kk in range(KT):
+        packed, v, T = hh.geqrt(rest[:, :nb], rankfull=True)
+        panels.append((v, T))
+        packs.append(packed)
+        trail = rest[:, nb:]
+        if trail.shape[1]:
+            trail = hh.apply_q(v, T, trail, trans="C")
+        rrows.append(trail[:nb])
+        rest = trail[nb:]
+
+    outcols = []
     for kk in range(NT):
-        s = kk * nb
-        col = X[:, s:s + nb]
-        for j, (vj, Tj) in enumerate(panels):
-            r = j * nb
-            col = jnp.concatenate(
-                [col[:r], hh.apply_q(vj, Tj, col[r:], trans="C")],
-                axis=0) if r else hh.apply_q(vj, Tj, col, trans="C")
+        pieces = [rrows[j][:, (kk - j - 1) * nb:(kk - j) * nb]
+                  for j in range(min(kk, KT))]
         if kk < KT:
-            packed, v, T = hh.geqrt(col[s:])
-            panels.append((v, T))
-            col = jnp.concatenate([col[:s], packed], axis=0) if s \
-                else packed
-        outcols.append(col)
+            pieces.append(packs[kk])
+        outcols.append(pieces[0] if len(pieces) == 1
+                       else jnp.concatenate(pieces, axis=0))
 
     full = jnp.concatenate(outcols, axis=1)
     Tm = t_desc(A)

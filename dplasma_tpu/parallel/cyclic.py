@@ -135,10 +135,9 @@ class CyclicMatrix:
         g = g[:, jnp.asarray(own_c), :, jnp.asarray(loc_c)]
         # (NT, MT, mb, nb) — leading advanced-index axes group together
         g = g.transpose(1, 2, 0, 3).reshape(MT * mb, NT * nb)
-        from dplasma_tpu.descriptors import TileMatrix as TM
-        out = TM.zeros(desc.M, desc.N, mb, nb, dist=d)
+        out = TileMatrix.zeros(desc.M, desc.N, mb, nb, dist=d)
         full = g[:out.data.shape[0], :out.data.shape[1]]
-        return TM(full, out.desc)
+        return TileMatrix(full, out.desc)
 
 
 def _grow(lslots: int, nb: int, rank, P: int, kp: int, ip: int):
@@ -149,7 +148,9 @@ def _grow(lslots: int, nb: int, rank, P: int, kp: int, ip: int):
 
 
 @partial(jax.jit, static_argnums=(1, 2))
-def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh_shape):
+def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh):
+    # ``mesh`` (hashable) is part of the jit key: two same-shaped meshes
+    # with different device orders must not share a trace.
     d = desc.dist
     P, Q = d.P, d.Q
     mb = desc.mb
@@ -211,13 +212,12 @@ def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh_shape):
             A = A - kb.dot(Lbelow, ct(W))
         return A.reshape(1, 1, mloc, nloc)
 
-    m = pmesh.active()
     try:
         from jax import shard_map
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
     f = shard_map(
-        body, mesh=m,
+        body, mesh=mesh,
         in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
                                None),
         out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
@@ -237,5 +237,5 @@ def potrf_cyclic(A: CyclicMatrix, uplo: str = "L") -> CyclicMatrix:
     ms = (m.shape[pmesh.ROW_AXIS], m.shape[pmesh.COL_AXIS])
     assert ms == (A.desc.dist.P, A.desc.dist.Q), (
         f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
-    out = _potrf_cyclic_jit(A.data, A.desc, ms)
+    out = _potrf_cyclic_jit(A.data, A.desc, m)
     return CyclicMatrix(out, A.desc)

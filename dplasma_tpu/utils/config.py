@@ -160,6 +160,18 @@ mca_register("gemm.lookahead", "2",
 mca_register("runtime.scheduler", "wavefront",
              "Trace-time tile ordering policy (analog of the 8 PaRSEC "
              "scheduler modules, tests/common.c:35-45).")
+mca_register("trsm_inv", "auto",
+             "Run triangular solves as explicit triangle inverse + "
+             "matmul (cuBLAS-style): auto/never (native XLA solve — "
+             "measured faster on current hardware), always (force the "
+             "inverse form; any dtype). Tuning knob per algorithm.")
+mca_register("qr_panel", "auto",
+             "Panel QR algorithm for the flat geqrf sweep: auto/lapack "
+             "(vendor QR — measured faster on current MXU hardware), "
+             "cholqr (CholeskyQR2 + Householder reconstruction, all "
+             "matmul-shaped work; requires numerically full-rank "
+             "panels). Applies only to ops.qr.geqrf, whose edge tiles "
+             "are identity-padded to keep panels full rank.")
 mca_register("dd_gemm", "auto",
              "FP64-equivalent limb GEMM for f64/c128 matmuls: auto "
              "(MXU backends only), always, never. The d/z-precision "

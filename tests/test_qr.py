@@ -94,6 +94,51 @@ def test_geqrf_on_mesh(devices8):
     assert ok, f"residual {r}"
 
 
+@pytest.mark.parametrize("M,N,nb", [(130, 130, 32), (147, 93, 25)])
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_geqrf_cholqr_panel(M, N, nb, dtype):
+    """The CholeskyQR2 + Householder-reconstruction panel (the MXU
+    backend's default) produces the same packed/T contract as the
+    vendor panel: exercised here on the CPU mesh via the MCA switch,
+    mirroring the dd_gemm=always pattern."""
+    from dplasma_tpu.utils import config as cfg
+    cfg.mca_set("qr_panel", "cholqr")
+    try:
+        A0 = generators.plrnt(M, N, nb, nb, seed=3872, dtype=dtype)
+        Af, Tf = jax.jit(qr.geqrf)(A0)
+        Q, R = _qr_parts(Af, Tf)
+        r, ok = checks.check_qr(A0, Q, R)
+        assert ok, f"|A-QR| residual {r}"
+        ro, oko = checks.check_orthogonality(Q)
+        assert oko, f"orthogonality residual {ro}"
+    finally:
+        cfg.mca_set("qr_panel", "auto")
+
+
+def test_getrf_nopiv_blocked_matches_unblocked(rng):
+    from dplasma_tpu.kernels import blas as kb
+    a = jnp.asarray(rng.normal(size=(96, 96)) + 96 * np.eye(96))
+    ref = kb.getrf_nopiv(a)
+    got = kb.getrf_nopiv_blocked(a, base=16)
+    assert np.allclose(np.asarray(got), np.asarray(ref), atol=1e-10)
+
+
+def test_trsm_inv_mode_matches_native(rng):
+    from dplasma_tpu.kernels import blas as kb
+    from dplasma_tpu.utils import config as cfg
+    t = jnp.asarray(np.tril(rng.normal(size=(32, 32))) + 32 * np.eye(32),
+                    jnp.float32)
+    b = jnp.asarray(rng.normal(size=(32, 64)), jnp.float32)
+    ref = kb.trsm(t, b, side="L", lower=True, trans="N")
+    cfg.mca_set("trsm_inv", "always")
+    try:
+        got = kb.trsm(t, b, side="L", lower=True, trans="N")
+    finally:
+        cfg.mca_set("trsm_inv", "auto")
+    assert np.allclose(np.asarray(got), np.asarray(ref),
+                       rtol=1e-4, atol=1e-4)
+
+
 def test_stacked_qr_ts_tt_kernels():
     """TS/TT coupling kernel: QR of [R_top; tile] reconstructs the stack
     and the applier reproduces Q^H on a coupled pair (CORE_ztsqrt/ztsmqr
