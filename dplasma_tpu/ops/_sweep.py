@@ -1,0 +1,34 @@
+"""Shared assembly for shrinking-window factorization sweeps.
+
+The right-looking geqrf/getrf sweeps keep the trailing submatrix as a
+fresh value per step (no dynamic-update-slice rematerialization of the
+full matrix) and stitch the global packed factor back together at the
+end — the dual of the reference's in-place tile writes (zpotrf_L.jdf /
+zgetrf_1d.jdf write tiles through the PaRSEC data copies)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def assemble_sweep(packs, urows, KT: int, NT: int, nb: int,
+                   reorder=None):
+    """Stitch per-step panel columns + finished row-slabs into the
+    global packed factor. ``packs[k]`` is step k's factored panel
+    column (top nb rows final), ``urows[k]`` the finished nb-row slab
+    right of it. ``reorder``, when given, maps column-block index ->
+    traced row-gather indices for the below-diagonal part (deferred
+    pivoting)."""
+    outcols = []
+    for kk in range(NT):
+        pieces = [urows[j][:, (kk - j - 1) * nb:(kk - j) * nb]
+                  for j in range(min(kk, KT))]
+        if kk < KT:
+            pan = packs[kk]
+            pieces.append(pan[:nb])
+            if pan.shape[0] > nb:
+                below = pan[nb:] if reorder is None else \
+                    pan[reorder(kk)]
+                pieces.append(below)
+        outcols.append(pieces[0] if len(pieces) == 1
+                       else jnp.concatenate(pieces, axis=0))
+    return jnp.concatenate(outcols, axis=1)

@@ -40,6 +40,7 @@ from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.kernels import blas as k
 from dplasma_tpu.kernels import householder as hh
 from dplasma_tpu.ops import blas3
+from dplasma_tpu.ops._sweep import assemble_sweep
 from dplasma_tpu.parallel import mesh as pmesh
 
 
@@ -91,61 +92,174 @@ def laswp(A: TileMatrix, perm, inverse: bool = False) -> TileMatrix:
 
 def getrf_nopiv(A: TileMatrix) -> TileMatrix:
     """Blocked right-looking LU without pivoting
-    (dplasma_zgetrf_nopiv). Returns packed L\\U (unit L implicit)."""
+    (dplasma_zgetrf_nopiv). Returns packed L\\U (unit L implicit).
+
+    Shrinking-window sweep: the trailing submatrix is a fresh value
+    each step (no dynamic-update-slice rematerialization of the full
+    matrix) and each Schur update is one full-width MXU matmul."""
     assert A.desc.mb == A.desc.nb, "getrf needs square tiles"
     nb = A.desc.nb
     KT = A.desc.KT
-    X = A.pad_diag().data
-    Np = A.desc.Np
+    NT = A.desc.NT
+    rest = A.pad_diag().data
+    packs, urows = [], []
     for kk in range(KT):
-        s, e = kk * nb, (kk + 1) * nb
-        d = k.getrf_nopiv(X[s:e, s:e])
-        X = X.at[s:e, s:e].set(d)
-        if e < Np:
-            u12 = k.trsm(d, X[s:e, e:], side="L", lower=True, unit=True)
-            X = X.at[s:e, e:].set(u12)
-        if e < X.shape[0]:
-            l21 = k.trsm(d, X[e:, s:e], side="R", lower=False)
-            X = X.at[e:, s:e].set(l21)
-            if e < Np:
-                X = X.at[e:, e:].add(-k.dot(l21, u12))
-        X = pmesh.constrain2d(X)
-    return TileMatrix(X, A.desc)
+        col = rest[:, :nb]
+        d = k.getrf_nopiv(col[:nb])
+        if col.shape[0] > nb:
+            pan = jnp.concatenate(
+                [d, k.trsm(d, col[nb:], side="R", lower=False)], axis=0)
+        else:
+            pan = d
+        packs.append(pan)
+        trail = rest[:, nb:]
+        if trail.shape[1]:
+            u12 = k.trsm(d, trail[:nb], side="L", lower=True, unit=True)
+            urows.append(u12)
+            trail = trail[nb:]
+            if trail.shape[0]:
+                trail = trail - k.dot(pan[nb:], u12)
+        else:
+            urows.append(trail[:nb])
+        rest = trail
+    full = assemble_sweep(packs, urows, KT, NT, nb)
+    return TileMatrix(pmesh.constrain2d(full), A.desc)
 
 
 # -- partial pivoting (1d / ptgpanel) ----------------------------------
+
+# VMEM row limit for XLA's LuDecompositionBlock custom call (full panel
+# height x 128-column blocks must fit scoped VMEM; 16384x128 f32
+# overflows the 16 MB budget on current hardware).
+_LU_CHUNK = 8192
+# Sub-panel width for the nested in-panel sweep (0 = disabled). The LU
+# custom call's cost is ~linear in rows x cols, so column-splitting the
+# panel saves no slow-call time (measured: a 128-wide nested sweep was
+# net slower from its own gather/update overheads); kept as an MCA
+# tuning knob for hardware with superlinear panel cost.
+_LU_IB = 0
+
+
+def _base_lu(panel, chunk: int | None = None):
+    """Pivoted LU of one narrow tall sub-panel: direct XLA LU when the
+    panel fits the custom call's VMEM row budget, else CALU tournament
+    pivoting (Grigori/Demmel CALU — also the shape of the reference's
+    distributed panel, src/zgetrf_ptgpanel.jdf): row chunks elect ib
+    candidate pivot rows each via independent chunk LUs (one batched
+    call), a second-level LU of the stacked candidates picks the
+    winners, and the remaining rows are solved against the winners' U.
+    Returns (packed m x ib L\\U with unit L, perm) with
+    ``panel[perm] = L U``."""
+    m, ib = panel.shape
+    if chunk is None:
+        from dplasma_tpu.utils import config as _cfg
+        chunk = _cfg.mca_get_int("lu.panel_chunk", _LU_CHUNK)
+    chunk = max(chunk, ib)  # a chunk narrower than the panel cannot
+    if m <= chunk:          # elect ib candidates — clamp, don't crash
+        lu, _, perm = lax.linalg.lu(panel)
+        return lu, perm
+    C = -(-m // chunk)
+    pad = C * chunk - m
+    ap = jnp.pad(panel, ((0, pad), (0, 0)))
+    chunks = ap.reshape(C, chunk, ib)
+    # lax.map, not vmap: the batched LU custom call co-resides every
+    # batch member's panel in scoped VMEM and overflows for C*chunk
+    # beyond ~16k rows; sequential chunk LUs keep the footprint flat.
+    _, _, cperm = lax.map(lambda c: lax.linalg.lu(c), chunks)
+    cand_pos = cperm[:, :ib]                                # (C, ib)
+    cands = jnp.take_along_axis(chunks, cand_pos[:, :, None], axis=1)
+    cand_glob = cand_pos + (jnp.arange(C) * chunk)[:, None]
+    # recurse for the second level: C*ib candidate rows can themselves
+    # exceed the custom call's VMEM row budget for very tall panels
+    lu2, perm2 = _base_lu(cands.reshape(C * ib, ib), chunk)
+    win_rows = cand_glob.reshape(-1)[perm2[:ib]]            # (ib,)
+    # window permutation: winners first in elimination order, the rest
+    # below in stable original order
+    rank = jnp.zeros((m + pad,), jnp.int32).at[win_rows].set(
+        jnp.arange(ib, dtype=jnp.int32))
+    is_w = jnp.zeros((m + pad,), bool).at[win_rows].set(True)
+    key = jnp.where(is_w, rank,
+                    ib + jnp.arange(m + pad, dtype=jnp.int32))[:m]
+    perm = jnp.argsort(key)
+    top = lu2[:ib]                     # packed L11\U11 of winner rows
+    rest = panel[perm[ib:]]
+    l21 = k.trsm(jnp.triu(top), rest, side="R", lower=False)
+    return jnp.concatenate([top, l21], axis=0), perm
+
+
+def _lu_sweep(X, bw: int, panel_fn):
+    """Generic pivoted shrinking-window LU sweep at block width ``bw``:
+    right-looking, with *deferred* pivot bookkeeping — each block's
+    permutation is applied to the shrinking trailing window only (one
+    gather), never to already-factored left columns; the packed factor
+    is stitched at the end from traced row ids. Returns
+    (packed L\\U, perm) with ``X[perm] = L U``. Used at two levels:
+    the nb-wide matrix sweep and the ib-wide in-panel sweep."""
+    Mp, Np = X.shape
+    KT = min(Mp, Np) // bw
+    NT = -(-Np // bw)
+    rest = X
+    ids = jnp.arange(Mp)
+    packs, urows, step_ids = [], [], []
+    for kk in range(KT):
+        pan, perm = panel_fn(rest[:, :bw])
+        idsp = ids[perm]
+        step_ids.append(idsp)
+        packs.append(pan)
+        trail = rest[:, bw:]
+        if trail.shape[1]:
+            trail = trail[perm]
+            u12 = k.trsm(pan[:bw], trail[:bw], side="L", lower=True,
+                         unit=True)
+            urows.append(u12)
+            trail = trail[bw:]
+            if trail.shape[0]:
+                trail = trail - k.dot(pan[bw:], u12)
+        else:
+            urows.append(trail[:bw])
+        rest = trail
+        ids = idsp[bw:]
+
+    final_ids = jnp.concatenate([si[:bw] for si in step_ids] + [ids])
+
+    def reorder(kk):
+        sids = step_ids[kk]
+        wpos = jnp.zeros((Mp,), jnp.int32).at[sids].set(
+            jnp.arange(sids.shape[0], dtype=jnp.int32))
+        return wpos[final_ids[(kk + 1) * bw:]]
+
+    full = assemble_sweep(packs, urows, KT, NT, bw, reorder=reorder)
+    return full, final_ids
+
+
+def _panel_lu(panel, ib: int | None = None):
+    """Pivoted LU of one nb-wide tall panel: a nested ib-wide
+    shrinking-window sweep (full-height pivot search per sub-panel —
+    LAPACK-blocked-getrf pivot quality) whose base case is
+    :func:`_base_lu`. Keeps the slow LU custom call to O(M*ib*nb) flops
+    and turns the rest of the panel into matmuls."""
+    m, nb = panel.shape
+    if ib is None:
+        from dplasma_tpu.utils import config as _cfg
+        ib = _cfg.mca_get_int("lu.panel_ib", _LU_IB)
+    if ib <= 0 or nb <= ib or nb % ib or m % ib:
+        return _base_lu(panel)
+    return _lu_sweep(panel, ib, _base_lu)
+
 
 def getrf_1d(A: TileMatrix):
     """Partial-pivoting blocked LU (dplasma_zgetrf_1d). Returns
     (packed L\\U, perm) with semantics ``A[perm] = L U``.
 
-    The reference's parallel panel (CORE_zgetrf_rectil on a 1-D
-    distribution) is one ``lax.linalg.lu`` per panel here; pivot
-    search over the full column is XLA's argmax reduce inside it.
+    Two nested shrinking-window right-looking sweeps (:func:`_lu_sweep`
+    over nb-wide panels; each panel an ib-wide inner sweep) with
+    deferred pivot bookkeeping — the reference instead chains zlaswp
+    row swaps through finished tiles (zgetrf_1d_wrapper.c:55-97) and
+    hand-distributes the panel (CORE_zgetrf_rectil / the ptgpanel JDF).
     """
     assert A.desc.mb == A.desc.nb, "getrf needs square tiles"
-    nb = A.desc.nb
-    KT = A.desc.KT
-    X = A.pad_diag().data
-    Mp, Np = X.shape
-    perm_g = jnp.arange(Mp)
-    for kk in range(KT):
-        s, e = kk * nb, (kk + 1) * nb
-        lu, _, perm = lax.linalg.lu(X[s:, s:e])
-        X = X.at[s:, s:e].set(lu)
-        if s > 0:
-            X = X.at[s:, :s].set(X[s:, :s][perm, :])
-        if e < Np:
-            right = X[s:, e:][perm, :]
-            d = lu[:nb, :]
-            u12 = k.trsm(d, right[:nb, :], side="L", lower=True, unit=True)
-            X = X.at[s:e, e:].set(u12)
-            if e < Mp:
-                X = X.at[e:, e:].set(
-                    right[nb:, :] - k.dot(lu[nb:, :], u12))
-        perm_g = perm_g.at[s:].set(perm_g[s:][perm])
-        X = pmesh.constrain2d(X)
-    return TileMatrix(X, A.desc), perm_g
+    full, final_ids = _lu_sweep(A.pad_diag().data, A.desc.nb, _panel_lu)
+    return TileMatrix(pmesh.constrain2d(full), A.desc), final_ids
 
 
 def getrf_ptgpanel(A: TileMatrix):

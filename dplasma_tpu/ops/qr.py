@@ -27,6 +27,7 @@ from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.kernels import blas as k
 from dplasma_tpu.kernels import householder as hh
 from dplasma_tpu.ops import blas3
+from dplasma_tpu.ops._sweep import assemble_sweep
 from dplasma_tpu.parallel import mesh as pmesh
 
 
@@ -83,16 +84,7 @@ def geqrf(A: TileMatrix) -> tuple[TileMatrix, TileMatrix]:
         rrows.append(trail[:nb])
         rest = trail[nb:]
 
-    outcols = []
-    for kk in range(NT):
-        pieces = [rrows[j][:, (kk - j - 1) * nb:(kk - j) * nb]
-                  for j in range(min(kk, KT))]
-        if kk < KT:
-            pieces.append(packs[kk])
-        outcols.append(pieces[0] if len(pieces) == 1
-                       else jnp.concatenate(pieces, axis=0))
-
-    full = jnp.concatenate(outcols, axis=1)
+    full = assemble_sweep(packs, rrows, KT, NT, nb)
     Tm = t_desc(A)
     Td = jnp.concatenate([T for _, T in panels], axis=1)
     if Td.shape[1] < Tm.desc.Np:

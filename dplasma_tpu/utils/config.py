@@ -160,6 +160,11 @@ mca_register("gemm.lookahead", "2",
 mca_register("runtime.scheduler", "wavefront",
              "Trace-time tile ordering policy (analog of the 8 PaRSEC "
              "scheduler modules, tests/common.c:35-45).")
+mca_register("lu.panel_chunk", "4096",
+             "Row-chunk height for the CALU tournament-pivoting LU "
+             "panel; panels taller than this elect pivot candidates "
+             "per chunk (XLA's LU custom call overflows scoped VMEM "
+             "past 8192 rows x 128 cols on current hardware).")
 mca_register("trsm_inv", "auto",
              "Run triangular solves as explicit triangle inverse + "
              "matmul (cuBLAS-style): auto/never (native XLA solve — "

@@ -97,10 +97,9 @@ def test_geqrf_on_mesh(devices8):
 @pytest.mark.parametrize("M,N,nb", [(130, 130, 32), (147, 93, 25)])
 @pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
 def test_geqrf_cholqr_panel(M, N, nb, dtype):
-    """The CholeskyQR2 + Householder-reconstruction panel (the MXU
-    backend's default) produces the same packed/T contract as the
-    vendor panel: exercised here on the CPU mesh via the MCA switch,
-    mirroring the dd_gemm=always pattern."""
+    """The CholeskyQR2 + Householder-reconstruction panel (opt-in via
+    MCA qr_panel=cholqr; auto resolves to the vendor panel everywhere)
+    produces the same packed/T contract as the vendor panel."""
     from dplasma_tpu.utils import config as cfg
     cfg.mca_set("qr_panel", "cholqr")
     try:
