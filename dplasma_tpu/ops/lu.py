@@ -263,9 +263,28 @@ def getrf_1d(A: TileMatrix):
 
 
 def getrf_ptgpanel(A: TileMatrix):
-    """dplasma_zgetrf_ptgpanel parity entry: same math as getrf_1d —
-    the reference's hand-distributed panel (zgetrf_ptgpanel.jdf) is
-    what GSPMD does to the panel ``lu`` under a mesh."""
+    """Distributed-parallel-panel LU (dplasma_zgetrf_ptgpanel,
+    src/zgetrf_ptgpanel.jdf). Under an active mesh with a nontrivial
+    process grid this runs the realized distributed panel
+    (:func:`dplasma_tpu.parallel.cyclic.getrf_cyclic`): per-row-rank
+    candidate election, an ICI all_gather playoff, masked-psum pivot
+    row exchange — the shard_map re-design of the reference's 1,076
+    JDF lines. Single-process grids fall back to :func:`getrf_1d`
+    (same (LU, perm) contract either way)."""
+    m = pmesh.active()
+    if m is not None and A.desc.mb == A.desc.nb:
+        P = m.shape[pmesh.ROW_AXIS]
+        Q = m.shape[pmesh.COL_AXIS]
+        if P * Q > 1:
+            from dplasma_tpu.descriptors import Dist
+            from dplasma_tpu.parallel import cyclic
+            d = A.desc.dist
+            if (d.P, d.Q) != (P, Q):  # grid comes from the mesh; keep
+                d = Dist(P=P, Q=Q)    # dist's kp/kq only when it fits
+            C = cyclic.CyclicMatrix.from_tile(A, d)
+            F, perm = cyclic.getrf_cyclic(C)
+            full = F.to_tile().data[perm]
+            return TileMatrix(pmesh.constrain2d(full), A.desc), perm
     return getrf_1d(A)
 
 

@@ -33,6 +33,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
 from dplasma_tpu.descriptors import Dist, TileMatrix
 from dplasma_tpu.parallel import layout
 from dplasma_tpu.parallel import mesh as pmesh
@@ -223,6 +228,161 @@ def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh):
         out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
                                 None))
     return f(data)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh):
+    """Distributed tournament-pivoting LU over cyclic local slabs —
+    the reference's hand-distributed parallel panel
+    (src/zgetrf_ptgpanel.jdf: per-rank panel elimination + pivot
+    exchange over MPI) as a shard_map program: each row-rank elects mb
+    candidate pivot rows from its local slab with one local LU, an
+    all_gather along 'p' stages the playoff, a replicated LU of the
+    P*mb candidates picks the winners (CALU tournament — same pivot
+    quality class as the reference's distributed partial pivoting),
+    and winner rows are exchanged by masked psum. Factor rows stay in
+    their owners' slabs (pivoting is deferred to the returned global
+    permutation, never materialized as row motion — on TPU a gather at
+    the end beats KT rounds of row swaps over ICI).
+
+    Returns (local factor slabs, win_gids (KT, mb) global element-row
+    ids in elimination order, active_left (P, mloc) bools)."""
+    d = desc.dist
+    P, Q = d.P, d.Q
+    mb = desc.mb
+    assert desc.mb == desc.nb, "getrf_cyclic needs square tiles"
+    KT = min(desc.MT, desc.NT)
+    mloc = desc.MTL * mb
+    nloc = desc.NTL * mb
+
+    def body(local):
+        from dplasma_tpu.kernels import blas as kb
+        A = local.reshape(mloc, nloc)
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        grow = _grow(desc.MTL, mb, p, P, d.kp, d.ip)       # (mloc,) tiles
+        gcol = _grow(desc.NTL, mb, q, Q, d.kq, d.jq)       # (nloc,) tiles
+        gid = grow * mb + jnp.arange(mloc) % mb            # element rows
+        gcid = gcol * mb + jnp.arange(nloc) % mb           # element cols
+        # well-posed padding: factor blkdiag(A, I) — put 1.0 on the pad
+        # diagonal locally (conversions force-zero the pad region, so
+        # callers cannot pre-set it)
+        K = min(desc.M, desc.N)
+        padrow = (gid >= K) & (gid < KT * mb)
+        eq = (gid[:, None] == gcid[None, :]) & padrow[:, None]
+        A = jnp.where(eq, jnp.ones((), A.dtype), A)
+        active = jnp.ones((mloc,), bool)
+        wins = []
+        for k in range(KT):
+            qk = layout.owner(k, Q, d.kq, d.jq)
+            lck = layout.local_index(k, Q, d.kq)
+            # 1) panel broadcast along 'q'
+            cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
+            pan = jax.lax.psum(
+                jnp.where(q == qk, cs, jnp.zeros_like(cs)),
+                pmesh.COL_AXIS)
+            panm = jnp.where(active[:, None], pan, 0)
+            # 2) local candidate election (one local LU per row-rank,
+            #    concurrently across 'p' — the distributed panel)
+            _, _, cperm = jax.lax.linalg.lu(panm)
+            cand_pos = cperm[:mb]                          # (mb,) local
+            cands = panm[cand_pos]
+            # 3) playoff: all_gather candidates along 'p', replicated LU
+            allc = jax.lax.all_gather(cands, pmesh.ROW_AXIS)
+            allid = jax.lax.all_gather(gid[cand_pos], pmesh.ROW_AXIS)
+            lu2, _, perm2 = jax.lax.linalg.lu(allc.reshape(P * mb, mb))
+            wr = perm2[:mb]                                # stack index
+            win_gids = allid.reshape(P * mb)[wr]
+            top = lu2[:mb]                       # packed L11\U11 rows
+            wins.append(win_gids)
+            # 4) my winners -> local rows; retire them from the active set
+            mine = (wr // mb) == p
+            win_lrow = jnp.where(mine, cand_pos[wr % mb], mloc)
+            elim = jnp.zeros((mloc + 1,), bool).at[win_lrow].set(
+                True, mode="drop")[:mloc]
+            # 5) winner rows' current values for MY columns (masked psum
+            #    along 'p' — the pivot-row exchange)
+            sel = jnp.where(mine[:, None],
+                            A[jnp.where(mine, win_lrow, 0)], 0)
+            wrows = jax.lax.psum(sel, pmesh.ROW_AXIS)      # (mb, nloc)
+            u12 = kb.trsm(top, wrows, side="L", lower=True, unit=True)
+            trailing = (gcol > k)[None, :]
+            u12 = jnp.where(trailing, u12, 0)
+            # 6) local L column + Schur update of my trailing columns
+            l21 = kb.trsm(jnp.triu(top), panm, side="R", lower=False)
+            l21 = jnp.where((active & ~elim)[:, None], l21, 0)
+            A = A - kb.dot(l21, u12)
+            # 7) owners write the L column into the panel block
+            newcs = jnp.where((active & ~elim)[:, None], l21, cs)
+            A = jnp.where(q == qk,
+                          jax.lax.dynamic_update_slice_in_dim(
+                              A, newcs, lck * mb, axis=1), A)
+            # 8) winner rows take their factor content (U12 on trailing
+            #    columns, packed L11\U11 in the panel block)
+            row_new = jnp.where(trailing, u12, wrows)
+            pancols = jnp.zeros((nloc,), bool).at[
+                lck * mb + jnp.arange(mb)].set(q == qk)
+            paste = jnp.zeros((mb, nloc), A.dtype)
+            paste = jax.lax.dynamic_update_slice_in_dim(
+                paste, top, lck * mb, axis=1)
+            row_new = jnp.where(pancols[None, :], paste, row_new)
+            A = A.at[win_lrow].set(jnp.where(mine[:, None], row_new,
+                                             A[jnp.where(mine, win_lrow, 0)]),
+                                   mode="drop")
+            active = active & ~elim
+        winsA = jnp.stack(wins)                            # (KT, mb)
+        return (A.reshape(1, 1, mloc, nloc),
+                winsA[None, None],
+                active[None, None])
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                               None),
+        out_specs=(PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                 None),
+                   PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                 None),
+                   PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None)))
+    return f(data)
+
+
+def getrf_cyclic(A: CyclicMatrix):
+    """Distributed partial-pivoting LU on block-cyclic local storage
+    (the pdgetrf / zgetrf_ptgpanel shape). Returns
+    (factor CyclicMatrix — rows in place, perm) with the
+    :func:`dplasma_tpu.ops.lu.getrf_1d` contract ``A[perm] = L U``
+    after gathering rows by ``perm``."""
+    m = pmesh.active()
+    assert m is not None, "getrf_cyclic needs an active mesh (use_grid)"
+    ms = (m.shape[pmesh.ROW_AXIS], m.shape[pmesh.COL_AXIS])
+    assert ms == (A.desc.dist.P, A.desc.dist.Q), (
+        f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
+    out, wins, active = _getrf_cyclic_jit(A.data, A.desc, m)
+    desc = A.desc
+    d = desc.dist
+    mb = desc.mb
+    Mp = desc.MT * mb
+    KT = min(desc.MT, desc.NT)
+    win_flat = wins[0, 0].reshape(-1)
+    nleft = Mp - KT * mb  # static: winners cover exactly KT*mb rows
+    if nleft:
+        # leftover rows (tall case), ascending global id, excluding
+        # over-allocated pad slots — traced (getrf_cyclic stays
+        # jit-compatible; the row-id table itself is static layout)
+        P = d.P
+        mloc = desc.MTL * mb
+        gids = jnp.asarray(np.concatenate([
+            np.asarray([layout.global_index(l // mb, p, P, d.kp, d.ip)
+                        * mb + l % mb for l in range(mloc)])
+            for p in range(P)]))
+        act = active[:, 0].reshape(-1)
+        key = jnp.where(act & (gids < Mp), gids, Mp + 1)
+        left = jnp.sort(key)[:nleft].astype(win_flat.dtype)
+        perm = jnp.concatenate([win_flat, left])
+    else:
+        perm = win_flat
+    return CyclicMatrix(out, desc), perm[:Mp]
 
 
 def potrf_cyclic(A: CyclicMatrix, uplo: str = "L") -> CyclicMatrix:
