@@ -1,20 +1,28 @@
-"""Headline benchmark: DPOTRF GFlop/s on the available accelerator.
+"""Headline benchmark ladder — the BASELINE.md configs on real hardware.
 
 Mirrors the reference's measurement semantics: LAWN-41 flop formulas and
 ``gflops = flops/1e9 / sync_time_elapsed`` (ref tests/common.h:136-145,
 src/flops.h:12-22). The reference publishes no absolute numbers
 (BASELINE.md), so ``vs_baseline`` is reported against the north-star
-target of 70% machine peak (BASELINE.json): we self-measure peak with a
-GEMM microbench (the reference's tools/gemmpeak analog) and report
-``(potrf_pct_peak / 0.70)`` — 1.0 means the target is met.
+target of 70% machine peak (BASELINE.json):
+
+* f32 ops are measured against a full-f32-accuracy GEMM microbench peak
+  (bf16x6 passes, ``Precision.HIGHEST`` — the tools/gemmpeak analog);
+* FP64-equivalent ops (the metric of record: BASELINE.json targets
+  "TPU FP64-equivalent peak on DPOTRF and DGEMM") run the d-precision
+  compute path (kernels/dd Ozaki limb GEMM + f32-seed iterative
+  refinement tile kernels) and are measured against the exact bf16
+  limb-product bound: bf16 peak / (nl*(nl+1)/2) limb matmuls.
+
+``vs_baseline`` = (pct_of_peak / 0.70); 1.0 means the target is met.
+The headline metric is dpotrf_f64equiv; the full ladder rides in the
+``ladder`` field of the same single JSON line.
 
 Timing methodology (tunneled-device safe): the op under test runs K_lo
 and K_hi times inside ONE jit (fori_loop, input perturbed per iteration
 so nothing hoists); per-run time is (t_hi - t_lo)/(K_hi - K_lo), which
 cancels the fixed dispatch+fetch latency of remote transports (~100 ms
 here). min-of-3 on each endpoint.
-
-Prints exactly ONE JSON line.
 """
 from __future__ import annotations
 
@@ -24,14 +32,19 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from dplasma_tpu.descriptors import TileMatrix  # noqa: E402
-from dplasma_tpu.ops import generators, potrf as potrf_mod  # noqa: E402
+from dplasma_tpu.kernels import blas as kb  # noqa: E402
+from dplasma_tpu.ops import generators, lu as lu_mod  # noqa: E402
+from dplasma_tpu.ops import potrf as potrf_mod, qr as qr_mod  # noqa: E402
 from dplasma_tpu.utils import flops as lawn41  # noqa: E402
 from tools.gemmpeak import measure_peak  # noqa: E402
 
@@ -39,7 +52,7 @@ from tools.gemmpeak import measure_peak  # noqa: E402
 def _sync(x):
     # On some transports block_until_ready returns before remote execution
     # completes; a (tiny) device fetch is a true sync barrier.
-    np.asarray(x.ravel()[:1])
+    np.asarray(jnp.ravel(x)[:1])
 
 
 def _per_run_seconds(loop, lo: int, hi: int, reps: int = 3) -> float:
@@ -57,42 +70,154 @@ def _per_run_seconds(loop, lo: int, hi: int, reps: int = 3) -> float:
     return max((times[hi] - times[lo]) / (hi - lo), 1e-12)
 
 
-def bench_potrf(N: int, nb: int, dtype=jnp.float32,
-                lo: int = 1, hi: int = 6) -> float:
-    A0 = generators.plghe(float(N), N, nb, seed=3872, dtype=dtype)
-    desc = A0.desc
-    data = A0.data
-    diag = jnp.arange(data.shape[0])
+def _op_loop(data, step):
+    """fori_loop harness: per-iteration diagonal perturbation (same DAG,
+    unhoistable), full-result consumption (no dead-code elimination)."""
+    diag = jnp.arange(min(data.shape))
 
     @jax.jit
     def loop(k, d):
         def body(i, acc):
-            # i-dependent diagonal shift: same DAG, unhoistable
-            shift = (i.astype(d.dtype) + 1.0) * 1e-6
-            a = d.at[diag, diag].add(shift)
-            L = potrf_mod.potrf(TileMatrix(a, desc), "L")
-            # consume the WHOLE factor: a [0,0]-only read would let
-            # XLA dead-code-eliminate all panels past the first
-            return acc + jnp.sum(L.data).astype(jnp.float32)
+            shift = (i.astype(jnp.float32) + 1.0) * 1e-6
+            a = d.at[diag, diag].add(shift.astype(d.dtype))
+            outs = step(a)
+            return acc + sum(jnp.sum(jnp.real(o)).astype(jnp.float32)
+                             for o in jax.tree_util.tree_leaves(outs))
         return lax.fori_loop(0, k, body, jnp.zeros((), jnp.float32))
 
-    t = _per_run_seconds(lambda kk: loop(kk, data), lo, hi)
+    return lambda kk: loop(kk, data)
+
+
+def bench_potrf(N, nb, dtype=jnp.float32, lo=1, hi=6):
+    A0 = generators.plghe(float(N), N, nb, seed=3872, dtype=dtype)
+
+    def step(a):
+        return potrf_mod.potrf(TileMatrix(a, A0.desc), "L").data
+
+    t = _per_run_seconds(_op_loop(A0.data, step), lo, hi)
     return lawn41.potrf(N) / 1e9 / t
 
 
+def bench_gemm(N, dtype=jnp.float32, lo=1, hi=6):
+    rng = np.random.default_rng(3872)
+    a = jnp.asarray(rng.standard_normal((N, N)), dtype)
+    b = jnp.asarray(rng.standard_normal((N, N)), dtype)
+
+    def step(x):
+        return kb.dot(x, b)
+
+    t = _per_run_seconds(_op_loop(a, step), lo, hi)
+    return 2.0 * N ** 3 / 1e9 / t
+
+
+def bench_geqrf(N, nb, dtype=jnp.float32, lo=1, hi=4):
+    A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=dtype)
+
+    def step(a):
+        Af, Tf = qr_mod.geqrf(TileMatrix(a, A0.desc))
+        return Af.data, Tf.data
+
+    t = _per_run_seconds(_op_loop(A0.data, step), lo, hi)
+    return lawn41.geqrf(N, N) / 1e9 / t
+
+
+def bench_getrf(N, nb, dtype=jnp.float32, lo=1, hi=4):
+    A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=dtype)
+
+    def step(a):
+        LU, perm = lu_mod.getrf_1d(TileMatrix(a, A0.desc))
+        return LU.data, perm
+
+    t = _per_run_seconds(_op_loop(A0.data, step), lo, hi)
+    return lawn41.getrf(N, N) / 1e9 / t
+
+
+def _dd_bound_products(K: int) -> int:
+    """Limb matmuls per FP64-equivalent GEMM at reduction depth K."""
+    from dplasma_tpu.kernels import dd
+    _, nl, _ = dd._plan(K, 53)
+    return nl * (nl + 1) // 2
+
+
 def main():
-    on_tpu = jax.default_backend() == "tpu"
-    N, nb = (16384, 1024) if on_tpu else (2048, 256)
-    gflops = bench_potrf(N, nb)
-    peak = measure_peak(
-        n=4096 if on_tpu else 1024, iters=60 if on_tpu else 20,
-        dtype="float32", precision=jax.lax.Precision.HIGHEST)
-    pct_peak = gflops / peak if peak > 0 else 0.0
+    on_tpu = jax.default_backend() != "cpu"
+    ladder = []
+
+    def add(metric, value, unit, vs):
+        ladder.append({"metric": metric, "value": round(value, 2),
+                       "unit": unit, "vs_baseline": round(vs, 4)})
+
+    if on_tpu:
+        peak32 = measure_peak(n=4096, iters=60, dtype="float32",
+                              precision=jax.lax.Precision.HIGHEST)
+        bf16_peak = measure_peak(n=4096, iters=60, dtype="bfloat16",
+                                 precision=None)
+        cfgs32 = [("spotrf", bench_potrf, dict(N=16384, nb=1024)),
+                  ("sgemm", bench_gemm, dict(N=8192)),
+                  ("sgeqrf", bench_geqrf, dict(N=8192, nb=1024)),
+                  ("sgetrf", bench_getrf, dict(N=16384, nb=1024))]
+        # f64-equiv sizes are compile-payload-bound on the tunneled
+        # transport (the dd limb expansion per tile op is a large
+        # graph); each entry lists fallbacks tried in order
+        dd_gemm_ns = (4096, 2048)
+        dd_potrf_cfgs = ((4096, 2048), (2048, 1024), (1024, 512))
+    else:  # CI / smoke path: tiny shapes, same code
+        peak32 = measure_peak(n=1024, iters=20, dtype="float32",
+                              precision=jax.lax.Precision.HIGHEST)
+        bf16_peak = peak32
+        cfgs32 = [("spotrf", bench_potrf, dict(N=2048, nb=256)),
+                  ("sgemm", bench_gemm, dict(N=2048)),
+                  ("sgeqrf", bench_geqrf, dict(N=1024, nb=256)),
+                  ("sgetrf", bench_getrf, dict(N=1024, nb=256))]
+        dd_gemm_ns = (1024,)
+        dd_potrf_cfgs = ((1024, 256),)
+
+    for name, fn, kw in cfgs32:
+        try:
+            g = fn(dtype=jnp.float32, **kw)
+            add(f"{name}_gflops_n{kw['N']}", g, "GFlop/s",
+                (g / peak32) / 0.70)
+        except Exception as exc:  # noqa: BLE001 — report what ran
+            ladder.append({"metric": f"{name}_n{kw['N']}",
+                           "error": str(exc)[:200]})
+
+    # FP64-equivalent ladder (the metric of record): the d-precision
+    # compute path — Ozaki limb GEMM + IR tile kernels (kernels/dd)
+    dd_bound = bf16_peak / _dd_bound_products(dd_gemm_ns[0])
+    for n in dd_gemm_ns:
+        try:
+            dgemm = bench_gemm(n, dtype=jnp.float64)
+            add(f"dgemm_f64equiv_gflops_n{n}", dgemm, "GFlop/s",
+                (dgemm / dd_bound) / 0.70)
+            break
+        except Exception as exc:  # noqa: BLE001
+            ladder.append({"metric": f"dgemm_f64equiv_n{n}",
+                           "error": str(exc)[:200]})
+    head = None
+    for n, nb in dd_potrf_cfgs:
+        try:
+            dpotrf = bench_potrf(n, nb, dtype=jnp.float64, hi=4)
+            add(f"dpotrf_f64equiv_gflops_n{n}", dpotrf, "GFlop/s",
+                (dpotrf / dd_bound) / 0.70)
+            head = ladder[-1]
+            break
+        except Exception as exc:  # noqa: BLE001
+            ladder.append({"metric": f"dpotrf_f64equiv_n{n}",
+                           "error": str(exc)[:200]})
+
+    if head is None:  # fall back to the strongest measured entry
+        head = next((x for x in ladder if "value" in x),
+                    {"metric": "none", "value": 0.0, "unit": "GFlop/s",
+                     "vs_baseline": 0.0})
     print(json.dumps({
-        "metric": f"dpotrf_gflops_n{N}_{jax.default_backend()}",
-        "value": round(gflops, 2),
-        "unit": "GFlop/s",
-        "vs_baseline": round(pct_peak / 0.70, 4),
+        "metric": head["metric"] + f"_{jax.default_backend()}",
+        "value": head["value"],
+        "unit": head["unit"],
+        "vs_baseline": head["vs_baseline"],
+        "ladder": ladder,
+        "peaks": {"f32_highest_gflops": round(peak32, 1),
+                  "bf16_gflops": round(bf16_peak, 1),
+                  "f64equiv_bound_gflops": round(dd_bound, 1)},
     }))
 
 

@@ -116,6 +116,116 @@ def herm_band_to_tridiag(X, N: int, b: int):
 
 
 # ---------------------------------------------------------------------
+# Blocked SBR on band storage (stage 2, wide bands)
+# ---------------------------------------------------------------------
+
+def to_lower_band(X, D: int, N: int, margin: int = 0):
+    """Column-aligned lower-band storage from a dense (Hermitian) array:
+    S[k, c] = X[c + k, c] for k in [0, D). O(N*D) memory — the band
+    working set of the stage-2 sweeps (ref zhbrdt.jdf operates on the
+    band object; SURVEY §5.7). ``margin`` adds zero columns so windowed
+    sweeps never clip."""
+    Nc = N + margin
+    c = jnp.arange(Nc)[None, :]
+    k = jnp.arange(D)[:, None]
+    r = c + k
+    valid = (r < min(N, X.shape[0])) & (c < min(N, X.shape[1]))
+    return jnp.where(valid, X[r.clip(0, X.shape[0] - 1),
+                              c.clip(0, X.shape[1] - 1)], 0)
+
+
+def lower_band_to_dense(S, N: int):
+    """Inverse of :func:`to_lower_band` (lower triangle only)."""
+    D = S.shape[0]
+    out = jnp.zeros((N, N), S.dtype)
+    r = jnp.arange(N)[:, None]
+    c = jnp.arange(N)[None, :]
+    k = r - c
+    valid = (k >= 0) & (k < D)
+    return jnp.where(valid, S[k.clip(0, D - 1), c.clip(0, S.shape[1] - 1)],
+                     0)
+
+
+def herm_band_to_tridiag_banded(S, N: int, b: int):
+    """Band -> tridiagonal bulge chase on O(N·b) *full-band* storage
+    (both triangles, col-aligned): the same scan-compiled Givens chase
+    as :func:`herm_band_to_tridiag`, with the dense row/column strips
+    replaced by band-array strips. Every rotation acts at a fixed
+    geometry relative to its own (i-1)-centred window, so the strip
+    indices into the window are STATIC — each step is one
+    dynamic_slice + static gathers. ``S`` is lower storage (>= b+1
+    rows); returns (d, e) real."""
+    if N <= 2 or b <= 1:
+        d = jnp.real(S[0, :N])
+        e = jnp.abs(S[1, :N - 1]) if N > 1 else \
+            jnp.zeros((0,), jnp.real(S).dtype)
+        return d, e
+    sched = herm_chase_schedule(N, b)
+    D = b + 2                      # band + bulge margin
+    L = 2 * D + 2
+    P = D + 1
+    # full-band col-aligned storage F[D + off, c] = X[c + off, c] for
+    # off in [-D, D], with P zero columns of margin on both sides
+    H = 2 * D + 1
+    Nc = N + 2 * P
+    F = jnp.zeros((H, Nc), S.dtype)
+    nk = min(D + 1, S.shape[0])
+    F = F.at[D + jnp.arange(nk), P:P + N].set(S[:nk, :N])  # lower+diag
+    for kk in range(1, nk):        # upper mirror: X[c-k, c]=conj(S[k,c-k])
+        F = F.at[D - kk, P + kk:P + N].set(jnp.conj(S[kk, :N - kk]))
+
+    # static strip geometry relative to the window at columns
+    # [c0, c0+L), c0 = i-1-D:  row r=i-1+dr at col c0+t sits at band row
+    # D + (i-1+dr) - (c0+t) = 2D + dr - t; col c=i-1+dc at row c0+t sits
+    # at band row t - 1 - ... = D + (c0+t) - (i-1+dc) = t - dc.
+    tL = np.arange(L)
+    idx_r0 = 2 * D - tL
+    idx_r1 = 2 * D + 1 - tL
+    idx_cA = tL                    # col i-1 strip over rows [c0, c0+L)
+    idx_cB = tL - 1                # col i strip
+    ok_r0 = (idx_r0 >= 0) & (idx_r0 < H)
+    ok_r1 = (idx_r1 >= 0) & (idx_r1 < H)
+    ok_cA = (idx_cA >= 0) & (idx_cA < H)
+    ok_cB = (idx_cB >= 0) & (idx_cB < H)
+    j_r0 = jnp.asarray(idx_r0.clip(0, H - 1))
+    j_r1 = jnp.asarray(idx_r1.clip(0, H - 1))
+    j_cA = jnp.asarray(idx_cA.clip(0, H - 1))
+    j_cB = jnp.asarray(idx_cB.clip(0, H - 1))
+    tj = jnp.arange(L)
+
+    def step(F, ic):
+        i, c = ic[0] + P, ic[1] + P
+        f = F[D + (i - 1) - c, c]
+        g = F[D + i - c, c]
+        cs, sn = _lartg(f, g)
+        c0 = i - 1 - D
+        # rows (i-1, i): A <- G A on the window's anti-diagonals
+        win = lax.dynamic_slice(F, (jnp.zeros_like(c0), c0), (H, L))
+        r0 = jnp.where(ok_r0, win[j_r0, tj], 0)
+        r1 = jnp.where(ok_r1, win[j_r1, tj], 0)
+        n0 = cs * r0 + sn * r1
+        n1 = -jnp.conj(sn) * r0 + cs * r1
+        win = win.at[j_r0, tj].set(jnp.where(ok_r0, n0, win[j_r0, tj]))
+        win = win.at[j_r1, tj].set(jnp.where(ok_r1, n1, win[j_r1, tj]))
+        F = lax.dynamic_update_slice(F, win, (jnp.zeros_like(c0), c0))
+        # cols (i-1, i): A <- A G^H on the columns' contiguous offsets
+        win2 = lax.dynamic_slice(F, (jnp.zeros_like(c0), i - 1), (H, 2))
+        sA = jnp.where(ok_cA, win2[j_cA, 0], 0)
+        sB = jnp.where(ok_cB, win2[j_cB, 1], 0)
+        nA = cs * sA + jnp.conj(sn) * sB
+        nB = -sn * sA + cs * sB
+        win2 = win2.at[j_cA, 0].set(jnp.where(ok_cA, nA, win2[j_cA, 0]))
+        win2 = win2.at[j_cB, 1].set(jnp.where(ok_cB, nB, win2[j_cB, 1]))
+        F = lax.dynamic_update_slice(F, win2, (jnp.zeros_like(c0), i - 1))
+        return F, None
+
+    F, _ = lax.scan(step, F, jnp.asarray(sched))
+    d = jnp.real(F[D, P:P + N])
+    e = jnp.abs(F[D + 1, P:P + N - 1])
+    return d, e
+
+
+# ---------------------------------------------------------------------
 # Upper-bidiagonal band -> bidiagonal
 # ---------------------------------------------------------------------
 

@@ -97,52 +97,73 @@ def herbt(A: TileMatrix, uplo: str = "L"):
 def band_to_rect(B: TileMatrix, bw: int):
     """Extract the Hermitian band into LAPACK lower-band storage
     (bw+1, N): row d holds diagonal d (the parsec_diag_band_to_rect
-    analog, zheev_wrapper.c:97-98)."""
+    analog, zheev_wrapper.c:97-98). Delegates to the vectorized
+    ops.band.to_lower_band (one gather, same layout)."""
+    from dplasma_tpu.ops import band as band_mod
     x = B.to_dense()
-    N = x.shape[0]
-    rows = []
-    for d in range(bw + 1):
-        diag = jnp.diagonal(x, offset=-d)
-        rows.append(jnp.pad(diag, (0, N - diag.shape[0])))
-    return jnp.stack(rows)
+    return band_mod.to_lower_band(x, bw + 1, x.shape[0])
 
 
 _CHASE_CUT = 64  # bandwidth below which the scan bulge chase takes over
+_EIG_NB = 256    # stage-1 band width for the heev chain (see heev)
 
 
-def hbrdt(B: TileMatrix, bw: int, chase_cut: int = _CHASE_CUT):
+def hbrdt(B, bw: int, chase_cut: int = _CHASE_CUT):
     """Band → tridiagonal (dplasma_zhbrdt analog), two regimes:
 
-    * wide bands: blocked band-halving sweeps — MXU matmuls, one
-      unrolled panel loop per width level (see module docstring); a
-      sweep with panel width w leaves true bandwidth 2w-1;
-    * bands ≤ ``chase_cut``: ONE ``lax.scan`` bulge chase over a
-      precomputed Givens schedule (ops.band) — the reference's
-      sequential chase (zhbrdt.jdf:41-60) with O(1) compile cost.
+    * wide bands: blocked band-halving two-sided sweeps — MXU matmuls
+      (see module docstring); a sweep with panel width w leaves true
+      bandwidth <= 2w-1;
+    * bands ≤ ``chase_cut``: ONE ``lax.scan`` Givens bulge chase on
+      O(N·band) full-band storage
+      (ops.band.herm_band_to_tridiag_banded) — the reference's
+      sequential chase (zhbrdt.jdf:41-60) with O(1) compile cost and
+      the band working set of its band object (zheev_wrapper.c:97).
 
-    ``bw`` is the TRUE bandwidth of B. Returns (d, e) real."""
+    ``B`` is a TileMatrix (dense-stored band) or a
+    ``descriptors.BandMatrix``; with a BandMatrix and bw <= chase_cut
+    the whole reduction stays on O(N·band) storage. ``bw`` is the TRUE
+    bandwidth. Returns (d, e) real."""
+    from dplasma_tpu.descriptors import BandMatrix
     from dplasma_tpu.ops import band as band_mod
-    X = B.zero_pad().data
-    N = B.desc.M
+    if isinstance(B, BandMatrix):
+        N = B.N
+        S0 = B.data[B.ku:]             # col-aligned lower rows
+    else:
+        N = B.desc.M
+        S0 = None
     b = min(bw, max(N - 1, 1))
-    while b > max(1, chase_cut):
-        w = max(1, (b + 1) // 4)  # panel w leaves band 2w-1 ~ b/2
-        X = _two_sided_band_sweep(X, w, N)
-        b = 2 * w - 1
+    if b > max(1, chase_cut):
+        if S0 is None:
+            X = B.zero_pad().data
+        else:  # wide-band sweeps run dense (two-sided fill is global)
+            low = band_mod.lower_band_to_dense(S0, N)
+            X = low + jnp.tril(low, -1).conj().T
+        while b > max(1, chase_cut):
+            w = max(1, (b + 1) // 4)   # panel w leaves band 2w-1 ~ b/2
+            X = _two_sided_band_sweep(X, w, N)
+            b = 2 * w - 1
+        S0 = band_mod.to_lower_band(X, b + 1, N)
+    elif S0 is None:
+        S0 = band_mod.to_lower_band(B.zero_pad().data, b + 1, N)
     if b > 1:
-        return band_mod.herm_band_to_tridiag(X, N, b)
-    d = jnp.real(jnp.diagonal(X))[:N]
-    e = jnp.abs(jnp.diagonal(X, offset=-1))[:N - 1]
+        return band_mod.herm_band_to_tridiag_banded(S0[:b + 1], N, b)
+    d = jnp.real(S0[0, :N])
+    rdt = d.dtype
+    if N > 1 and S0.shape[0] > 1:
+        e = jnp.abs(S0[1, :N - 1]).astype(rdt)
+    else:  # diagonal input (bandwidth 0) or N == 1
+        e = jnp.zeros((max(N - 1, 0),), rdt)
     return d, e
 
 
 def hetrd(A: TileMatrix, uplo: str = "L"):
     """Dense Hermitian → tridiagonal, two-stage (dplasma_zhetrd):
-    herbt to band 2nb-1, then band reduction to 1. Returns (d, e).
+    herbt to band nb, then band reduction to 1. Returns (d, e).
     The complex off-diagonal is phase-rotated real (a diagonal unitary
     similarity — eigenvalues unchanged), as LAPACK zhetrd does."""
     Bm, _, _ = herbt(A, uplo)
-    return hbrdt(Bm, 2 * A.desc.nb - 1)
+    return hbrdt(Bm, A.desc.nb)  # herbt leaves true bandwidth nb
 
 
 def heev(A: TileMatrix, uplo: str = "L", method: str = "auto"):
@@ -156,18 +177,29 @@ def heev(A: TileMatrix, uplo: str = "L", method: str = "auto"):
       MXU-friendly) on the mirrored matrix. The TPU analogue of the
       reference shipping the final eigenproblem to rank-0 LAPACK
       (testing_zheev.c): delegate to the vendor solver where it wins;
-    * ``"auto"`` — 2stage while the scan chase stays cheap (its
-      sequential O(N²·chase_cut) rotations dominate past N ≈ 2k),
-      else direct.
+    * ``"auto"`` — direct below N=1024 (vendor-solver overheads beat
+      the chain's fixed costs there) and above N=4096 (the chase's
+      O(N²/2)-entry rotation schedule becomes a host-memory/latency
+      wall — a multi-bulge chase would lift this); 2stage between.
 
     Returns ascending eigenvalues (N,)."""
     N = A.desc.M
     if method == "auto":
-        method = "2stage" if N <= 2048 else "direct"
+        method = "2stage" if 1024 <= N <= 4096 else "direct"
     if method == "direct":
         h = _sym_full(A, uplo, conj=True)
         return jnp.linalg.eigvalsh(h)
-    d, e = hetrd(A, uplo)
+    nb_e = min(A.desc.nb, _EIG_NB)
+    if nb_e != A.desc.nb:
+        # re-tile for the chain: stage 1 (herbt) leaves true bandwidth
+        # nb, and stage 2's halving sweeps cost ~8N³/3 regardless of
+        # start width — a narrow band trims sweep count while staying
+        # MXU-wide
+        A = TileMatrix.from_dense(_sym_full(A, uplo, conj=True),
+                                  nb_e, nb_e, A.desc.dist)
+        uplo = "L"
+    Bm, _, _ = herbt(A, uplo)
+    d, e = hbrdt(Bm, nb_e)  # herbt leaves true bandwidth nb
     if d.shape[0] == 1:
         return d
     return jax.scipy.linalg.eigh_tridiagonal(
