@@ -70,22 +70,25 @@ def _per_run_seconds(loop, lo: int, hi: int, reps: int = 3) -> float:
     return max((times[hi] - times[lo]) / (hi - lo), 1e-12)
 
 
-def _op_loop(data, step):
+def _op_loop(data, step, *extras):
     """fori_loop harness: per-iteration diagonal perturbation (same DAG,
-    unhoistable), full-result consumption (no dead-code elimination)."""
+    unhoistable), full-result consumption (no dead-code elimination).
+    ``extras`` are threaded through as jit ARGUMENTS — captured as
+    closure constants they get embedded in the compile payload (256 MB
+    at N=8192 f32: the tunneled compile service rejects the request)."""
     diag = jnp.arange(min(data.shape))
 
     @jax.jit
-    def loop(k, d):
+    def loop(k, d, *ex):
         def body(i, acc):
             shift = (i.astype(jnp.float32) + 1.0) * 1e-6
             a = d.at[diag, diag].add(shift.astype(d.dtype))
-            outs = step(a)
+            outs = step(a, *ex)
             return acc + sum(jnp.sum(jnp.real(o)).astype(jnp.float32)
                              for o in jax.tree_util.tree_leaves(outs))
         return lax.fori_loop(0, k, body, jnp.zeros((), jnp.float32))
 
-    return lambda kk: loop(kk, data)
+    return lambda kk: loop(kk, data, *extras)
 
 
 def bench_potrf(N, nb, dtype=jnp.float32, lo=1, hi=6):
@@ -102,11 +105,8 @@ def bench_gemm(N, dtype=jnp.float32, lo=1, hi=6):
     rng = np.random.default_rng(3872)
     a = jnp.asarray(rng.standard_normal((N, N)), dtype)
     b = jnp.asarray(rng.standard_normal((N, N)), dtype)
-
-    def step(x):
-        return kb.dot(x, b)
-
-    t = _per_run_seconds(_op_loop(a, step), lo, hi)
+    t = _per_run_seconds(
+        _op_loop(a, lambda x, bb: kb.dot(x, bb), b), lo, hi)
     return 2.0 * N ** 3 / 1e9 / t
 
 

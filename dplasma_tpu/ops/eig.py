@@ -48,7 +48,10 @@ def _two_sided_band_sweep(X, nbp: int, N: int):
     Mp = X.shape[0]
     for s in range(0, N - nbp - 1, nbp):
         e = s + nbp
-        if e >= Mp:
+        if e >= Mp or Mp - e < nbp:
+            # a tail panel with fewer rows than columns has nothing to
+            # eliminate within the sweep's contract: remaining depth
+            # Mp-1-s < 2*nbp already fits the <= 2w-1 output bandwidth
             break
         panel = X[e:, s:e]
         packed, v, T = hh.geqrt(panel)
@@ -247,7 +250,7 @@ def _bidiag_reduce(X, nbp: int, M: int, N: int):
     Mp, Np = X.shape
     for s in range(0, min(M, N), nbp):
         e = s + nbp
-        if e > Mp:
+        if e > Mp or Mp - s < nbp:
             break
         packed, v, T = hh.geqrt(X[s:, s:e])
         r = jnp.triu(packed[:nbp, :])

@@ -94,10 +94,10 @@ def test_halving_sweep_plus_chase_handoff():
 def test_gebrd_halving_regime():
     import jax.numpy as jnp
     from dplasma_tpu.ops import eig, generators
-    M, N, nb = 48, 40, 8
+    M, N, nb = 32, 28, 8
     A0 = generators.plrnt(M, N, nb, nb, seed=4, dtype=jnp.float64)
     d1, e1 = eig.gebrd(A0)                 # chase-only
-    d2, e2 = eig.gebrd(A0, chase_cut=4)    # halving sweeps + chase
+    d2, e2 = eig.gebrd(A0, chase_cut=4)    # TWO halving sweeps + chase
     ref = np.linalg.svd(np.asarray(A0.to_dense()), compute_uv=False)
     for d, e in ((d1, e1), (d2, e2)):
         K = min(M, N)

@@ -30,3 +30,34 @@ def test_local_block_covers_matrix(devices8):
     # single process owns everything
     assert (rs.start, rs.stop) == (0, 64)
     assert (cs.start, cs.stop) == (0, 64)
+
+
+def test_local_block_make_array_flow(devices8):
+    """Simulated multi-host input build: local_block's slices feed
+    jax.make_array_from_process_local_data and reassemble the global
+    array exactly (single-process simulation of the per-rank
+    allocation flow, ref tests/common.h:182-190). On one process the
+    local block is the whole array; the shard boundaries are also
+    checked directly against GSPMD's ceil-split for a ragged shape."""
+    import math
+
+    import jax
+    from dplasma_tpu.parallel import distributed as dist
+    from dplasma_tpu.parallel import mesh as pmesh
+
+    m = pmesh.make_mesh(2, 4, devices8)
+    rows, cols = 38, 52  # divisible, as make_array_from_... requires
+    rs, cs = dist.local_block((rows, cols), m)
+    # single process owns every device -> full array
+    assert (rs.start, rs.stop) == (0, rows)
+    assert (cs.start, cs.stop) == (0, cols)
+    A = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+    from jax.sharding import NamedSharding, PartitionSpec
+    sh = NamedSharding(m, PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS))
+    arr = jax.make_array_from_process_local_data(sh, A[rs, cs],
+                                                 (rows, cols))
+    np.testing.assert_array_equal(np.asarray(arr), A)
+    # ragged shape: the single-process block still covers everything
+    rs2, cs2 = dist.local_block((37, 53), m)
+    assert (rs2.start, rs2.stop) == (0, 37)
+    assert (cs2.start, cs2.stop) == (0, 53)

@@ -126,13 +126,30 @@ def test_gesvd_direct():
     assert np.allclose(np.asarray(s), ref, atol=1e-10 * max(M, N))
 
 
+def test_hbrdt_band_matrix_wide():
+    """BandMatrix input with bw above the chase cut: exercises the
+    densify-for-halving branch (lower_band_to_dense + Hermitian
+    mirror) ahead of the banded chase."""
+    from dplasma_tpu.descriptors import BandMatrix
+    rng = np.random.default_rng(11)
+    N, b = 120, 72
+    a = np.tril(rng.standard_normal((N, N))) * (np.abs(np.subtract.outer(
+        np.arange(N), np.arange(N))) <= b)
+    h = a + a.T - np.diag(np.diag(a))
+    Bb = BandMatrix.from_dense(jnp.asarray(h), kl=b, ku=0)
+    d, e = eig.hbrdt(Bb, b)
+    got = np.sort(np.asarray(jax.scipy.linalg.eigh_tridiagonal(
+        d, e, eigvals_only=True)))
+    assert np.allclose(got, np.linalg.eigvalsh(h), atol=1e-10 * N)
+
+
 def test_hbrdt_band_matrix_input():
     """hbrdt accepts the O(N·band) BandMatrix object (the reference's
-    band descriptor, zheev_wrapper.c:97) end to end — wide band so both
-    the SBR sweep and the banded chase run on band storage."""
+    band descriptor, zheev_wrapper.c:97) end to end — band within the
+    chase cut, so the whole reduction stays on O(N·band) storage."""
     from dplasma_tpu.descriptors import BandMatrix
     rng = np.random.default_rng(7)
-    N, b = 180, 80
+    N, b = 160, 48
     a = np.tril(rng.standard_normal((N, N))) * (np.abs(np.subtract.outer(
         np.arange(N), np.arange(N))) <= b)
     h = a + a.T - np.diag(np.diag(a))
@@ -148,7 +165,7 @@ def test_heev_2stage_wide_band_matches_direct():
     """2stage at a size whose stage-1 band (2*nb-1 = 255... clipped by
     _EIG_NB) exceeds the chase cut: SBR + banded chase against the
     vendor solver, tight tolerance."""
-    N, nb = 640, 128
+    N, nb = 448, 128
     A0 = generators.plghe(0.0, N, nb, seed=11, dtype=jnp.float64)
     w2 = np.sort(np.asarray(eig.heev(A0, method="2stage")))
     ref = np.linalg.eigvalsh(np.asarray(_sym_full(A0, "L", conj=True)))
