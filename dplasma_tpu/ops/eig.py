@@ -180,15 +180,17 @@ def heev(A: TileMatrix, uplo: str = "L", method: str = "auto"):
       MXU-friendly) on the mirrored matrix. The TPU analogue of the
       reference shipping the final eigenproblem to rank-0 LAPACK
       (testing_zheev.c): delegate to the vendor solver where it wins;
-    * ``"auto"`` — direct below N=1024 (vendor-solver overheads beat
-      the chain's fixed costs there) and above N=4096 (the chase's
-      O(N²/2)-entry rotation schedule becomes a host-memory/latency
-      wall — a multi-bulge chase would lift this); 2stage between.
+    * ``"auto"`` — the vendor solver: the chase's O(N²/2) sequential
+      rotations are latency-bound poison on accelerators (measured
+      270x slower than eigvalsh at N=1024 on one chip; a multi-bulge
+      blocked chase is the known fix, and the banded-storage chase is
+      structured for it). The 2stage chain is the explicit
+      composed-pipeline path (the reference's parsec_compose shape),
+      correct at every size and O(N·band) in stage 2.
 
     Returns ascending eigenvalues (N,)."""
-    N = A.desc.M
     if method == "auto":
-        method = "2stage" if 1024 <= N <= 4096 else "direct"
+        method = "direct"
     if method == "direct":
         h = _sym_full(A, uplo, conj=True)
         return jnp.linalg.eigvalsh(h)
@@ -250,7 +252,7 @@ def _bidiag_reduce(X, nbp: int, M: int, N: int):
     Mp, Np = X.shape
     for s in range(0, min(M, N), nbp):
         e = s + nbp
-        if e > Mp or Mp - s < nbp:
+        if e > Mp:
             break
         packed, v, T = hh.geqrt(X[s:, s:e])
         r = jnp.triu(packed[:nbp, :])

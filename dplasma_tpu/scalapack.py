@@ -114,8 +114,7 @@ def _h_potrf(uplo, prec, n, pa, ia, ja, desca):
     L = potrf_mod.potrf(A, u)
     info = int(info_mod.factor_info(L, u))
     ld = np.asarray(L.to_dense(), dtype=dt)
-    mask = np.tril(np.ones((n, n), bool)) if u == "L" else \
-        np.triu(np.ones((n, n), bool))
+    mask = _np_tri_mask(n, u)
     a[mask] = ld[mask]
     return info
 
@@ -217,6 +216,121 @@ def _prec_of(args) -> str:
     return "d"
 
 
+def _np_tri_mask(n: int, uplo: str, unit: bool = False) -> np.ndarray:
+    """Boolean triangle write-back mask (shared by the factor/inverse
+    handlers); ``unit`` excludes the implicit unit diagonal."""
+    m = np.tril(np.ones((n, n), bool)) if uplo == "L" else \
+        np.triu(np.ones((n, n), bool))
+    if unit:
+        np.fill_diagonal(m, False)
+    return m
+
+
+def _diag_info(diag_vals) -> int:
+    """LAPACK INFO from a factor diagonal: first zero/non-finite slot
+    (1-based), else 0."""
+    bad = np.nonzero((diag_vals == 0) | ~np.isfinite(diag_vals))[0]
+    return int(bad[0]) + 1 if bad.size else 0
+
+
+def _h_potrs(uplo, prec, n, nrhs, pa, ia, ja, desca,
+             pb, ib, jb, descb):
+    from dplasma_tpu.ops import potrf as potrf_mod
+    dt = _NP_DTYPE[_c(prec)]
+    u = _c(uplo).upper()
+    a = _sub(_view(pa, desca, dt), ia, ja, n, n)
+    b = _sub(_view(pb, descb, dt), ib, jb, n, nrhs)
+    nb = _tile_nb(desca, n, n)
+    X = potrf_mod.potrs(_to_tm(a, nb), _to_tm(b, nb), u)
+    b[:] = np.asarray(X.to_dense(), dtype=dt)
+    return 0
+
+
+def _h_posv(uplo, prec, n, nrhs, pa, ia, ja, desca, pb, ib, jb, descb):
+    from dplasma_tpu.ops import info as info_mod, potrf as potrf_mod
+    dt = _NP_DTYPE[_c(prec)]
+    u = _c(uplo).upper()
+    a = _sub(_view(pa, desca, dt), ia, ja, n, n)
+    b = _sub(_view(pb, descb, dt), ib, jb, n, nrhs)
+    nb = _tile_nb(desca, n, n)
+    L, X = potrf_mod.posv(_to_tm(a, nb), _to_tm(b, nb), u)
+    info = int(info_mod.factor_info(L, u))
+    ld = np.asarray(L.to_dense(), dtype=dt)
+    mask = _np_tri_mask(n, u)
+    a[mask] = ld[mask]
+    if info == 0:  # LAPACK contract: B untouched when INFO > 0
+        b[:] = np.asarray(X.to_dense(), dtype=dt)
+    return info
+
+
+def _h_gesv(prec, n, nrhs, pa, ia, ja, desca, pipiv,
+            pb, ib, jb, descb):
+    from dplasma_tpu.ops import lu
+    dt = _NP_DTYPE[_c(prec)]
+    a = _sub(_view(pa, desca, dt), ia, ja, n, n)
+    b = _sub(_view(pb, descb, dt), ib, jb, n, nrhs)
+    nb = _tile_nb(desca, n, n)
+    LU, perm, X = lu.gesv_1d(_to_tm(a, nb), _to_tm(b, nb))
+    a[:] = np.asarray(LU.to_dense(), dtype=dt)
+    ipiv = np.asarray(lu.perm_to_ipiv(np.asarray(perm)[:n]))[:n]
+    buf = (ctypes.c_int32 * n).from_address(pipiv)
+    np.frombuffer(buf, dtype=np.int32)[:] = ipiv.astype(np.int32) + 1
+    info = _diag_info(np.diagonal(a)[:n])
+    if info == 0:
+        b[:] = np.asarray(X.to_dense(), dtype=dt)
+    return info
+
+
+def _h_potri(uplo, prec, n, pa, ia, ja, desca):
+    from dplasma_tpu.ops import potrf as potrf_mod
+    dt = _NP_DTYPE[_c(prec)]
+    u = _c(uplo).upper()
+    a = _sub(_view(pa, desca, dt), ia, ja, n, n)
+    info = _diag_info(np.diagonal(a)[:n])
+    if info:
+        return info
+    # LAPACK pdpotri consumes the Cholesky factor already in A
+    out = potrf_mod.potri(_to_tm(a, _tile_nb(desca, n, n)), u)
+    od = np.asarray(out.to_dense(), dtype=dt)
+    mask = _np_tri_mask(n, u)
+    a[mask] = od[mask]
+    return 0
+
+
+def _h_trtri(uplo, diag, prec, n, pa, ia, ja, desca):
+    from dplasma_tpu.ops import potrf as potrf_mod
+    dt = _NP_DTYPE[_c(prec)]
+    u, d = _c(uplo).upper(), _c(diag).upper()
+    a = _sub(_view(pa, desca, dt), ia, ja, n, n)
+    if d != "U":
+        info = _diag_info(np.diagonal(a)[:n])
+        if info:
+            return info
+    out = potrf_mod.trtri(_to_tm(a, _tile_nb(desca, n, n)), u, d)
+    od = np.asarray(out.to_dense(), dtype=dt)
+    a[_np_tri_mask(n, u, unit=(d == "U"))] = \
+        od[_np_tri_mask(n, u, unit=(d == "U"))]
+    return 0
+
+
+def _h_syev(jobz, uplo, prec, n, pa, ia, ja, desca, pw, pwork, lwork):
+    from dplasma_tpu.ops import eig
+    dt = _NP_DTYPE[_c(prec)]
+    if _c(jobz).upper() != "N":
+        return -1  # eigenvectors not provided by this shim
+    if lwork == -1:
+        buf = (ctypes.c_byte * np.dtype(dt).itemsize).from_address(pwork)
+        np.frombuffer(buf, dtype=dt)[0] = 1
+        return 0
+    u = _c(uplo).upper()
+    a = _sub(_view(pa, desca, dt), ia, ja, n, n)
+    w = np.sort(np.asarray(
+        eig.heev(_to_tm(a, _tile_nb(desca, n, n)), u), dtype=dt))
+    buf = (ctypes.c_byte * (n * np.dtype(dt).itemsize)).from_address(pw)
+    np.frombuffer(buf, dtype=dt)[:] = w
+    return 0
+
+
 _HANDLERS = {
     "gemm": _h_gemm,
     "potrf": _h_potrf,
@@ -224,4 +338,10 @@ _HANDLERS = {
     "trmm": _h_trmm,
     "getrf": _h_getrf,
     "geqrf": _h_geqrf,
+    "potrs": _h_potrs,
+    "posv": _h_posv,
+    "gesv": _h_gesv,
+    "potri": _h_potri,
+    "trtri": _h_trtri,
+    "syev": _h_syev,
 }

@@ -1,5 +1,7 @@
-// ScaLAPACK ABI shim: drop-in p[sd]{gemm,potrf,trsm,trmm,getrf,geqrf}_
-// symbols over the TPU framework.
+// ScaLAPACK ABI shim: drop-in p[sd]{gemm,potrf,trsm,trmm,getrf,geqrf,
+// potrs,posv,gesv,potri,trtri,syev}_ symbols over the TPU framework —
+// the reference's own wrapper/twin set (src/scalapack_wrappers/ +
+// tools/cscalapack drivers).
 //
 // The reference ships the same facility as src/scalapack_wrappers/
 // (3.7k LoC of C): F77 PBLAS/ScaLAPACK entry points that marshal BLACS
@@ -173,6 +175,102 @@ DEF_PGETRF(ps, float)
 
 DEF_PGEQRF(pd, double)
 DEF_PGEQRF(ps, float)
+
+// --------------------------------------------------- POTRS/POSV (solve)
+#define DEF_PSOLVE(pfx, T, op)                                             \
+  void pfx##op##_(const char* uplo, const int* n, const int* nrhs, T* a,   \
+                  const int* ia, const int* ja, const int* desca, T* b,    \
+                  const int* ib, const int* jb, const int* descb,          \
+                  int* info) {                                             \
+    ensure_python();                                                       \
+    PyGILState_STATE st = PyGILState_Ensure();                             \
+    PyObject* args = Py_BuildValue(                                        \
+        "(cciiKiiNKiiN)", *uplo, #T[0], *n, *nrhs,                         \
+        (unsigned long long)(uintptr_t)a, *ia, *ja, desc_tuple(desca),     \
+        (unsigned long long)(uintptr_t)b, *ib, *jb, desc_tuple(descb));    \
+    PyGILState_Release(st);                                                \
+    *info = dispatch(#op, args);                                           \
+  }
+
+DEF_PSOLVE(pd, double, potrs)
+DEF_PSOLVE(ps, float, potrs)
+DEF_PSOLVE(pd, double, posv)
+DEF_PSOLVE(ps, float, posv)
+
+// ---------------------------------------------------------------- GESV
+#define DEF_PGESV(pfx, T)                                                  \
+  void pfx##gesv_(const int* n, const int* nrhs, T* a, const int* ia,      \
+                  const int* ja, const int* desca, int* ipiv, T* b,        \
+                  const int* ib, const int* jb, const int* descb,          \
+                  int* info) {                                             \
+    ensure_python();                                                       \
+    PyGILState_STATE st = PyGILState_Ensure();                             \
+    PyObject* args = Py_BuildValue(                                        \
+        "(ciiKiiNKKiiN)", #T[0], *n, *nrhs,                                \
+        (unsigned long long)(uintptr_t)a, *ia, *ja, desc_tuple(desca),     \
+        (unsigned long long)(uintptr_t)ipiv,                               \
+        (unsigned long long)(uintptr_t)b, *ib, *jb, desc_tuple(descb));    \
+    PyGILState_Release(st);                                                \
+    *info = dispatch("gesv", args);                                        \
+  }
+
+DEF_PGESV(pd, double)
+DEF_PGESV(ps, float)
+
+// ------------------------------------------------------ POTRI / TRTRI
+#define DEF_PPOTRI(pfx, T)                                                 \
+  void pfx##potri_(const char* uplo, const int* n, T* a, const int* ia,    \
+                   const int* ja, const int* desca, int* info) {           \
+    ensure_python();                                                       \
+    PyGILState_STATE st = PyGILState_Ensure();                             \
+    PyObject* args = Py_BuildValue(                                        \
+        "(cciKiiN)", *uplo, #T[0], *n,                                     \
+        (unsigned long long)(uintptr_t)a, *ia, *ja, desc_tuple(desca));    \
+    PyGILState_Release(st);                                                \
+    *info = dispatch("potri", args);                                       \
+  }
+
+DEF_PPOTRI(pd, double)
+DEF_PPOTRI(ps, float)
+
+#define DEF_PTRTRI(pfx, T)                                                 \
+  void pfx##trtri_(const char* uplo, const char* diag, const int* n,       \
+                   T* a, const int* ia, const int* ja, const int* desca,   \
+                   int* info) {                                            \
+    ensure_python();                                                       \
+    PyGILState_STATE st = PyGILState_Ensure();                             \
+    PyObject* args = Py_BuildValue(                                        \
+        "(ccciKiiN)", *uplo, *diag, #T[0], *n,                             \
+        (unsigned long long)(uintptr_t)a, *ia, *ja, desc_tuple(desca));    \
+    PyGILState_Release(st);                                                \
+    *info = dispatch("trtri", args);                                       \
+  }
+
+DEF_PTRTRI(pd, double)
+DEF_PTRTRI(ps, float)
+
+// ---------------------------------------------------------------- SYEV
+// Eigenvalues (jobz='N'); the reference's pdsyev twin
+// (tools/cscalapack). jobz='V' reports INFO=-1 (unimplemented here).
+#define DEF_PSYEV(pfx, T)                                                  \
+  void pfx##syev_(const char* jobz, const char* uplo, const int* n, T* a,  \
+                  const int* ia, const int* ja, const int* desca, T* w,    \
+                  T* z, const int* iz, const int* jz, const int* descz,    \
+                  T* work, const int* lwork, int* info) {                  \
+    (void)z; (void)iz; (void)jz; (void)descz;                              \
+    ensure_python();                                                       \
+    PyGILState_STATE st = PyGILState_Ensure();                             \
+    PyObject* args = Py_BuildValue(                                        \
+        "(ccciKiiNKKi)", *jobz, *uplo, #T[0], *n,                          \
+        (unsigned long long)(uintptr_t)a, *ia, *ja, desc_tuple(desca),     \
+        (unsigned long long)(uintptr_t)w,                                  \
+        (unsigned long long)(uintptr_t)work, *lwork);                      \
+    PyGILState_Release(st);                                                \
+    *info = dispatch("syev", args);                                        \
+  }
+
+DEF_PSYEV(pd, double)
+DEF_PSYEV(ps, float)
 
 int dplasma_tpu_shim_version() { return 1; }
 

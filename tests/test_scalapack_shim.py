@@ -163,3 +163,93 @@ def test_psgemm_f32(shim, rng):
 def test_call_counters(shim):
     from dplasma_tpu import scalapack
     assert scalapack.call_counts.get("gemm", 0) >= 1
+
+
+def test_pdposv_and_potrs(shim, rng):
+    N, nrhs = 80, 4
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    a = np.asfortranarray(spd)
+    B = np.asfortranarray(rng.standard_normal((N, nrhs)))
+    B0 = B.copy()
+    info = ctypes.c_int(99)
+    u, ni, ri = ctypes.c_char(b"L"), ctypes.c_int(N), ctypes.c_int(nrhs)
+    shim.pdposv_(ctypes.byref(u), ctypes.byref(ni), ctypes.byref(ri),
+                 _pd(a), ctypes.byref(_one), ctypes.byref(_one),
+                 _desc(N, N, 32, 32, N), _pd(B), ctypes.byref(_one),
+                 ctypes.byref(_one), _desc(N, nrhs, 32, 32, N),
+                 ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(B - np.linalg.solve(spd, B0)).max() < 1e-8
+    # potrs reuses the factor now stored in a
+    B2 = np.asfortranarray(B0.copy())
+    shim.pdpotrs_(ctypes.byref(u), ctypes.byref(ni), ctypes.byref(ri),
+                  _pd(a), ctypes.byref(_one), ctypes.byref(_one),
+                  _desc(N, N, 32, 32, N), _pd(B2), ctypes.byref(_one),
+                  ctypes.byref(_one), _desc(N, nrhs, 32, 32, N),
+                  ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(B2 - np.linalg.solve(spd, B0)).max() < 1e-8
+
+
+def test_pdgesv(shim, rng):
+    N, nrhs = 64, 3
+    A = np.asfortranarray(rng.standard_normal((N, N)) + N * np.eye(N))
+    A0 = A.copy()
+    B = np.asfortranarray(rng.standard_normal((N, nrhs)))
+    B0 = B.copy()
+    ipiv = np.zeros(N, dtype=np.int32)
+    info = ctypes.c_int(99)
+    ni, ri = ctypes.c_int(N), ctypes.c_int(nrhs)
+    shim.pdgesv_(ctypes.byref(ni), ctypes.byref(ri), _pd(A),
+                 ctypes.byref(_one), ctypes.byref(_one),
+                 _desc(N, N, 32, 32, N), _pd(ipiv), _pd(B),
+                 ctypes.byref(_one), ctypes.byref(_one),
+                 _desc(N, nrhs, 32, 32, N), ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(B - np.linalg.solve(A0, B0)).max() < 1e-8
+
+
+def test_pdpotri_and_trtri(shim, rng):
+    N = 64
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    a = np.asfortranarray(np.linalg.cholesky(spd))  # factor input
+    info = ctypes.c_int(99)
+    u, ni = ctypes.c_char(b"L"), ctypes.c_int(N)
+    shim.pdpotri_(ctypes.byref(u), ctypes.byref(ni), _pd(a),
+                  ctypes.byref(_one), ctypes.byref(_one),
+                  _desc(N, N, 32, 32, N), ctypes.byref(info))
+    assert info.value == 0
+    inv = np.linalg.inv(spd)
+    assert np.abs(np.tril(a) - np.tril(inv)).max() < 1e-9
+    # trtri of a well-conditioned triangle
+    t = np.asfortranarray(np.tril(rng.standard_normal((N, N))) +
+                          N * np.eye(N))
+    t0 = t.copy()
+    d = ctypes.c_char(b"N")
+    shim.pdtrtri_(ctypes.byref(u), ctypes.byref(d), ctypes.byref(ni),
+                  _pd(t), ctypes.byref(_one), ctypes.byref(_one),
+                  _desc(N, N, 32, 32, N), ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(np.tril(t) @ np.tril(t0) - np.eye(N)).max() < 1e-9
+
+
+def test_pdsyev_values(shim, rng):
+    N = 64
+    a0 = rng.standard_normal((N, N))
+    h = (a0 + a0.T) / 2
+    a = np.asfortranarray(h)
+    w = np.zeros(N)
+    work = np.zeros(2)
+    info = ctypes.c_int(99)
+    jz, u, ni = ctypes.c_char(b"N"), ctypes.c_char(b"L"), ctypes.c_int(N)
+    lw = ctypes.c_int(8)
+    shim.pdsyev_(ctypes.byref(jz), ctypes.byref(u), ctypes.byref(ni),
+                 _pd(a), ctypes.byref(_one), ctypes.byref(_one),
+                 _desc(N, N, 32, 32, N), _pd(w), _pd(a),
+                 ctypes.byref(_one), ctypes.byref(_one),
+                 _desc(N, N, 32, 32, N), _pd(work), ctypes.byref(lw),
+                 ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(w - np.linalg.eigvalsh(h)).max() < 1e-8
