@@ -185,10 +185,12 @@ def main():
     # compute path — Ozaki limb GEMM + IR tile kernels (kernels/dd).
     # The bf16 peak read is sanity-gated against 6x the f32-HIGHEST
     # peak (HIGHEST = six bf16 passes): the raw bf16 microbench has
-    # produced physically impossible readings on the tunneled transport
-    bf16_est = 6.0 * peak32
-    if not (0.5 * bf16_est <= bf16_peak <= 2.0 * bf16_est):
-        bf16_peak = bf16_est
+    # produced physically impossible readings on the tunneled
+    # transport. TPU path only — the CPU smoke path reuses peak32.
+    if on_tpu:
+        bf16_est = 6.0 * peak32
+        if not (0.5 * bf16_est <= bf16_peak <= 2.0 * bf16_est):
+            bf16_peak = bf16_est
     dd_bound = bf16_peak / _dd_bound_products(dd_gemm_ns[0])
     for n in dd_gemm_ns:
         try:

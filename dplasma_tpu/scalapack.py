@@ -162,10 +162,7 @@ def _h_getrf(prec, m, n, pa, ia, ja, desca, pipiv):
     a[:] = ld
     buf = (ctypes.c_int32 * mn).from_address(pipiv)
     np.frombuffer(buf, dtype=np.int32)[:] = ipiv.astype(np.int32) + 1
-    # singularity: exact zero on the U diagonal
-    udiag = np.diagonal(ld)[:mn]
-    zeros = np.nonzero((udiag == 0) | ~np.isfinite(udiag))[0]
-    return int(zeros[0]) + 1 if zeros.size else 0
+    return _diag_info(np.diagonal(ld)[:mn])
 
 
 def _h_geqrf(prec, m, n, pa, ia, ja, desca, ptau, pwork, lwork):
@@ -255,10 +252,10 @@ def _h_posv(uplo, prec, n, nrhs, pa, ia, ja, desca, pb, ib, jb, descb):
     nb = _tile_nb(desca, n, n)
     L, X = potrf_mod.posv(_to_tm(a, nb), _to_tm(b, nb), u)
     info = int(info_mod.factor_info(L, u))
-    ld = np.asarray(L.to_dense(), dtype=dt)
-    mask = _np_tri_mask(n, u)
-    a[mask] = ld[mask]
-    if info == 0:  # LAPACK contract: B untouched when INFO > 0
+    if info == 0:  # LAPACK contract: A/B untouched when INFO > 0
+        ld = np.asarray(L.to_dense(), dtype=dt)
+        mask = _np_tri_mask(n, u)
+        a[mask] = ld[mask]
         b[:] = np.asarray(X.to_dense(), dtype=dt)
     return info
 
@@ -308,8 +305,8 @@ def _h_trtri(uplo, diag, prec, n, pa, ia, ja, desca):
             return info
     out = potrf_mod.trtri(_to_tm(a, _tile_nb(desca, n, n)), u, d)
     od = np.asarray(out.to_dense(), dtype=dt)
-    a[_np_tri_mask(n, u, unit=(d == "U"))] = \
-        od[_np_tri_mask(n, u, unit=(d == "U"))]
+    mask = _np_tri_mask(n, u, unit=(d == "U"))
+    a[mask] = od[mask]
     return 0
 
 

@@ -160,7 +160,12 @@ mca_register("gemm.lookahead", "2",
 mca_register("runtime.scheduler", "wavefront",
              "Trace-time tile ordering policy (analog of the 8 PaRSEC "
              "scheduler modules, tests/common.c:35-45).")
-mca_register("lu.panel_chunk", "4096",
+mca_register("lu.panel_ib", "0",
+             "Sub-panel width for a nested in-panel LU sweep "
+             "(0 = disabled; the LU custom call's cost is ~linear in "
+             "rows x cols, so column-splitting buys nothing on "
+             "current hardware — kept for chips where it is not).")
+mca_register("lu.panel_chunk", "8192",
              "Row-chunk height for the CALU tournament-pivoting LU "
              "panel; panels taller than this elect pivot candidates "
              "per chunk (XLA's LU custom call overflows scoped VMEM "

@@ -66,12 +66,13 @@ def test_getrf_1d_calu_tournament(dtype):
     by 1), so only the residual and a mild growth bound are asserted."""
     from dplasma_tpu.utils import config as cfg
     N, nb = 96, 16
+    old = cfg.mca_get("lu.panel_chunk")
     cfg.mca_set("lu.panel_chunk", "32")
     try:
         A0 = generators.plrnt(N, N, nb, nb, seed=51, dtype=dtype)
         LU, perm = jax.jit(lu.getrf_1d)(A0)
     finally:
-        cfg.mca_set("lu.panel_chunk", "4096")
+        cfg.mca_set("lu.panel_chunk", old)
     ap = np.asarray(TileMatrix(A0.pad_diag().data, A0.desc).data)[
         np.asarray(perm)]
     r = np.abs(ap - np.asarray(
