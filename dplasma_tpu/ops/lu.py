@@ -149,13 +149,24 @@ def _base_lu(panel, chunk: int | None = None):
     call), a second-level LU of the stacked candidates picks the
     winners, and the remaining rows are solved against the winners' U.
     Returns (packed m x ib L\\U with unit L, perm) with
-    ``panel[perm] = L U``."""
+    ``panel[perm] = L U``.
+
+    Singular/near-singular panels are undefined behavior (as with
+    getrf_nopiv): when a pivot column is zero across every real row,
+    zero pad rows from the last chunk can be elected and silently
+    dropped, so the factorization degrades to a singular U / NaNs
+    rather than a diagnostic (ADVICE r2; the reference's nopiv path
+    has the same contract)."""
     m, ib = panel.shape
     if chunk is None:
         from dplasma_tpu.utils import config as _cfg
         chunk = _cfg.mca_get_int("lu.panel_chunk", _LU_CHUNK)
-    chunk = max(chunk, ib)  # a chunk narrower than the panel cannot
-    if m <= chunk:          # elect ib candidates — clamp, don't crash
+    # A chunk narrower than the panel cannot elect ib candidates, and a
+    # chunk in [ib, 2*ib) leaves C*ib >= m so the candidate recursion
+    # never shrinks (ADVICE r2): clamp to 2*ib so every level at least
+    # halves the row count.
+    chunk = max(chunk, 2 * ib)
+    if m <= chunk:
         lu, _, perm = lax.linalg.lu(panel)
         return lu, perm
     C = -(-m // chunk)

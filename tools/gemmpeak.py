@@ -49,14 +49,29 @@ def measure_peak(n: int = 4096, iters: int = 100, dtype="float32",
 
     dt = jnp.dtype(dtype)
     rng = np.random.default_rng(0)
-    a = jnp.asarray(rng.standard_normal((n, n)), dt)
-    b = jnp.asarray(rng.standard_normal((n, n)), dt)
+    if dt == jnp.int8:
+        # the MXU's integer systolic path (what the FP64-equivalent
+        # limb engine rides): int8 x int8 -> native int32 accumulate
+        a = jnp.asarray(rng.integers(-63, 64, (n, n)), jnp.int8)
+        b = jnp.asarray(rng.integers(-63, 64, (n, n)), jnp.int8)
+    else:
+        a = jnp.asarray(rng.standard_normal((n, n)), dt)
+        b = jnp.asarray(rng.standard_normal((n, n)), dt)
 
     def make_loop(k):
         @jax.jit
         def loop(a, b):
             def body(i, carry):
                 acc, bb = carry
+                if dt == jnp.int8:
+                    y = lax.dot_general(
+                        a, bb, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+                    # requantize so the chain stays live and nonzero
+                    bb = lax.clamp(
+                        jnp.int32(-63), y // jnp.int32(n * 16),
+                        jnp.int32(63)).astype(jnp.int8) | jnp.int8(1)
+                    return (acc + y[0, 0].astype(jnp.float32), bb)
                 y = jnp.matmul(a, bb, precision=precision,
                                preferred_element_type=None
                                if dt == jnp.float64 else jnp.float32)
@@ -88,6 +103,7 @@ def measure_peak(n: int = 4096, iters: int = 100, dtype="float32",
 _MODES = {
     "float32": [("default", None), ("highest", "highest")],
     "bfloat16": [("default", None)],
+    "int8": [("default", None)],
     "float64": [("default", None)],
 }
 
