@@ -188,6 +188,8 @@ def main():
         dd_gemm_cfgs = [dict(N=4096), dict(N=2048)]
         dd_potrf_cfgs = [dict(N=8192, nb=512), dict(N=4096, nb=512),
                          dict(N=4096, nb=1024), dict(N=2048, nb=512)]
+        dd_geqrf_cfgs = [dict(N=4096, nb=512), dict(N=2048, nb=512)]
+        dd_getrf_cfgs = [dict(N=4096, nb=512), dict(N=2048, nb=512)]
     else:  # CI / smoke path: tiny shapes, same code
         peak32 = measure_peak(n=1024, iters=20, dtype="float32",
                               precision=jax.lax.Precision.HIGHEST)
@@ -201,6 +203,8 @@ def main():
         ]
         dd_gemm_cfgs = [dict(N=1024)]
         dd_potrf_cfgs = [dict(N=1024, nb=256)]
+        dd_geqrf_cfgs = [dict(N=512, nb=128)]
+        dd_getrf_cfgs = [dict(N=512, nb=128)]
 
     for name, fn, cfg_list in cfgs32:
         run_entry(name, fn, cfg_list, peak32, dtype=jnp.float32)
@@ -224,6 +228,10 @@ def main():
               dtype=jnp.float64)
     head = run_entry("dpotrf_f64equiv", bench_potrf, dd_potrf_cfgs,
                      dd_bound, dtype=jnp.float64, hi=4)
+    run_entry("dgeqrf_f64equiv", bench_geqrf, dd_geqrf_cfgs, dd_bound,
+              dtype=jnp.float64, hi=3)
+    run_entry("dgetrf_f64equiv", bench_getrf, dd_getrf_cfgs, dd_bound,
+              dtype=jnp.float64, hi=3)
 
     if head is None:  # fall back to the strongest measured entry
         head = next((x for x in ladder if "value" in x),

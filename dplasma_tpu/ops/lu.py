@@ -243,12 +243,44 @@ def _lu_sweep(X, bw: int, panel_fn):
     return full, final_ids
 
 
+def _panel_lu_dd(panel, ib: int | None = None):
+    """d-precision panel LU: seed with the f32 pivoted panel machinery
+    (including its CALU/VMEM fallbacks), then refine L and U to
+    f64-equivalent accuracy for the FIXED permutation with limb-exact
+    residuals (kernels.dd.lu_ir) — the TPU replacement for the
+    reference's d-precision CORE_zgetrf_rectil."""
+    from dplasma_tpu.kernels import dd as _dd
+    nb = panel.shape[1]
+    # Power-of-two COLUMN prescale before the f32 cast: f64 magnitudes
+    # outside f32 range would otherwise overflow/flush and poison the
+    # seed (review r3). Column scaling leaves the partial-pivot choice
+    # and L itself invariant (each column's entry ratios are unchanged,
+    # |L| <= 1 as with unscaled pivoting); only U unscales, exactly:
+    # panel*D = L*(U*D)  =>  U = U_scaled / d.
+    m_ = jnp.max(jnp.abs(panel), axis=0, keepdims=True)
+    d = 1.0 / _dd._pow2_scale(m_)
+    pan32, perm = _panel_lu((panel * d).astype(jnp.float32), ib)
+    # refine in the scaled coordinates (everything O(growth) there, so
+    # the IR's own f32 seeds stay in range), unscale U exactly after
+    L = k.tri(pan32.astype(panel.dtype), lower=True, unit=True)
+    Us = jnp.triu(pan32[:nb]).astype(panel.dtype)
+    L, Us = _dd.lu_ir(panel[perm] * d, L, Us)
+    U = Us / d
+    packed = jnp.concatenate(
+        [jnp.triu(U) + jnp.tril(L[:nb], -1)] +
+        ([L[nb:]] if L.shape[0] > nb else []), axis=0)
+    return packed, perm
+
+
 def _panel_lu(panel, ib: int | None = None):
     """Pivoted LU of one nb-wide tall panel: a nested ib-wide
     shrinking-window sweep (full-height pivot search per sub-panel —
     LAPACK-blocked-getrf pivot quality) whose base case is
     :func:`_base_lu`. Keeps the slow LU custom call to O(M*ib*nb) flops
-    and turns the rest of the panel into matmuls."""
+    and turns the rest of the panel into matmuls. f64 panels on the
+    dd route get an f32 seed + limb-IR (:func:`_panel_lu_dd`)."""
+    if panel.dtype == jnp.float64 and k._dd_active(panel.dtype):
+        return _panel_lu_dd(panel, ib)
     m, nb = panel.shape
     if ib is None:
         from dplasma_tpu.utils import config as _cfg

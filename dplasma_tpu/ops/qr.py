@@ -74,8 +74,24 @@ def geqrf(A: TileMatrix) -> tuple[TileMatrix, TileMatrix]:
     packs = []    # packed panel columns (R diag + V below)
     rrows = []    # finished nb-row R slabs right of each panel
 
+    # d-precision route: CholQR2+reconstruction panels with every heavy
+    # product an exact limb GEMM (kernels.dd.geqrt_f64). Envelope: the
+    # Gram matrix squares the panel condition, so panels must be
+    # numerically full rank with cond below ~1e7 — MCA qr_panel=lapack
+    # keeps the (slow, emulated-f64, rank-safe) vendor panel instead.
+    # The trailing applies need no dd twin: hh.apply_q's products ride
+    # k.dot, which already routes f64 through the limb GEMM.
+    from dplasma_tpu.utils import config as _cfg
+    use_dd = (A.dtype == jnp.float64 and k._dd_active(A.dtype)
+              and (_cfg.mca_get("qr_panel") or "auto").lower() != "lapack")
+    if use_dd:
+        from dplasma_tpu.kernels import dd as _dd
+
     for kk in range(KT):
-        packed, v, T = hh.geqrt(rest[:, :nb], rankfull=True)
+        if use_dd:
+            packed, v, T = _dd.geqrt_f64(rest[:, :nb])
+        else:
+            packed, v, T = hh.geqrt(rest[:, :nb], rankfull=True)
         panels.append((v, T))
         packs.append(packed)
         trail = rest[:, nb:]

@@ -177,6 +177,37 @@ def test_getrf_f64_under_dd(rng):
         cfg._MCA_OVERRIDES.pop("dd_gemm", None)
 
 
+def test_geqrf_f64_under_dd(rng):
+    """Blocked f64 QR on the dd route (CholQR2+reconstruction panels,
+    limb compact-WY applies): residual and orthogonality at reference
+    thresholds."""
+    from dplasma_tpu.descriptors import TileMatrix
+    from dplasma_tpu.ops import qr as qr_mod
+    from dplasma_tpu.ops.qr import unmqr
+    from dplasma_tpu.utils import config as cfg
+
+    cfg.mca_set("dd_gemm", "always")
+    try:
+        N, nb = 192, 64
+        a = rng.standard_normal((N, N))
+        A = TileMatrix.from_dense(jnp.asarray(a), nb, nb)
+        Af, Tf = qr_mod.geqrf(A)
+        R = np.triu(np.asarray(Af.to_dense()))
+        QR = np.asarray(unmqr(
+            "L", "N", Af, Tf,
+            TileMatrix.from_dense(jnp.asarray(R), nb, nb)).to_dense())
+        resid = np.abs(QR - a).max() / (np.abs(a).max() * N * EPS)
+        assert resid < 60.0, resid
+        eye = np.eye(N)
+        Q = np.asarray(unmqr(
+            "L", "N", Af, Tf,
+            TileMatrix.from_dense(jnp.asarray(eye), nb, nb)).to_dense())
+        orth = np.abs(Q.T @ Q - eye).max() / (N * EPS)
+        assert orth < 60.0, orth
+    finally:
+        cfg._MCA_OVERRIDES.pop("dd_gemm", None)
+
+
 def test_gemm_f64_chunked_deep_k(rng):
     # K > KC exercises the batched chunk path (exactness must not
     # degrade with reduction depth — the round-1 clamp bug)
