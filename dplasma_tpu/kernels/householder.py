@@ -90,7 +90,7 @@ def cholqr2(a):
     return q, k.dot(r2, r1)
 
 
-def householder_reconstruct(q, r, s=None):
+def householder_reconstruct(q, r, s=None, return_u=False):
     """Recover the compact-WY form from a thin QR factor
     (Ballard/Demmel/Grigori et al., "Reconstructing Householder vectors
     from TSQR"): find unit-lower-trapezoidal V and triangular T with
@@ -125,6 +125,8 @@ def householder_reconstruct(q, r, s=None):
     packed = jnp.concatenate(
         [jnp.triu(rh) + jnp.tril(v1, -1)] +
         ([v[n:]] if m > n else []), axis=0)
+    if return_u:  # distributed callers apply U^{-1} to their own rows
+        return packed, v, t, u
     return packed, v, t
 
 

@@ -152,6 +152,28 @@ def _grow(lslots: int, nb: int, rank, P: int, kp: int, ip: int):
     return ((l // kp) * P + (rank - ip) % P) * kp + l % kp
 
 
+def _slab_coords(desc: CyclicDesc, p, q):
+    """Per-element global coordinates of a rank's local slab:
+    (grow, gcol) tile ids and (gid, gcid) element ids."""
+    d = desc.dist
+    grow = _grow(desc.MTL, desc.mb, p, d.P, d.kp, d.ip)
+    gcol = _grow(desc.NTL, desc.nb, q, d.Q, d.kq, d.jq)
+    gid = grow * desc.mb + jnp.arange(desc.MTL * desc.mb) % desc.mb
+    gcid = gcol * desc.nb + jnp.arange(desc.NTL * desc.nb) % desc.nb
+    return grow, gcol, gid, gcid
+
+
+def _seed_pad_diag(A, desc: CyclicDesc, gid, gcid):
+    """Well-posed padding for factorizations: put 1.0 on the pad
+    diagonal locally (conversions force-zero the pad region, so callers
+    cannot pre-set it) — factor blkdiag(A, I)."""
+    K = min(desc.M, desc.N)
+    KT = min(desc.MT, desc.NT)
+    padrow = (gid >= K) & (gid < KT * desc.mb)
+    eq = (gid[:, None] == gcid[None, :]) & padrow[:, None]
+    return jnp.where(eq, jnp.ones((), A.dtype), A)
+
+
 @partial(jax.jit, static_argnums=(1, 2))
 def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh):
     # ``mesh`` (hashable) is part of the jit key: two same-shaped meshes
@@ -260,17 +282,8 @@ def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh):
         A = local.reshape(mloc, nloc)
         p = jax.lax.axis_index(pmesh.ROW_AXIS)
         q = jax.lax.axis_index(pmesh.COL_AXIS)
-        grow = _grow(desc.MTL, mb, p, P, d.kp, d.ip)       # (mloc,) tiles
-        gcol = _grow(desc.NTL, mb, q, Q, d.kq, d.jq)       # (nloc,) tiles
-        gid = grow * mb + jnp.arange(mloc) % mb            # element rows
-        gcid = gcol * mb + jnp.arange(nloc) % mb           # element cols
-        # well-posed padding: factor blkdiag(A, I) — put 1.0 on the pad
-        # diagonal locally (conversions force-zero the pad region, so
-        # callers cannot pre-set it)
-        K = min(desc.M, desc.N)
-        padrow = (gid >= K) & (gid < KT * mb)
-        eq = (gid[:, None] == gcid[None, :]) & padrow[:, None]
-        A = jnp.where(eq, jnp.ones((), A.dtype), A)
+        grow, gcol, gid, gcid = _slab_coords(desc, p, q)
+        A = _seed_pad_diag(A, desc, gid, gcid)
         active = jnp.ones((mloc,), bool)
         wins = []
         for k in range(KT):
@@ -383,6 +396,151 @@ def getrf_cyclic(A: CyclicMatrix):
     else:
         perm = win_flat
     return CyclicMatrix(out, desc), perm[:Mp]
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _geqrf_cyclic_jit(data, desc: CyclicDesc, mesh):
+    """Distributed blocked Householder QR over cyclic local slabs —
+    BASELINE config #3's hierarchical QR (ref src/zgeqrf_param.jdf +
+    dplasma_hqr.c high-level trees) re-designed for the mesh: each
+    panel is factored by distributed CholeskyQR2 (the Gram psum along
+    'p' IS the high-level reduction tree — ranks are the TS domains,
+    and ICI's all-reduce replaces the reference's explicit
+    FLAT/GREEDY combining trees) followed by TSQR-HR Householder
+    reconstruction, so the factor comes out in the standard compact-WY
+    packed layout (V below the diagonal, R on/above, T per panel —
+    interchangeable with ops.qr.geqrf output). Trailing updates are
+    V^H C psum along 'p' + one local MXU matmul per rank.
+
+    Panels must be numerically full rank (pad columns are identity-
+    seeded; the Gram squares the condition — same envelope as the
+    cholqr panel path everywhere else in the package).
+
+    Returns (local factor slabs, Ts (KT, mb, mb) replicated).
+    """
+    from dplasma_tpu.kernels import blas as kb
+    from dplasma_tpu.kernels import householder as hh
+
+    d = desc.dist
+    P, Q = d.P, d.Q
+    mb = desc.mb
+    assert desc.mb == desc.nb, "geqrf_cyclic needs square tiles"
+    KT = min(desc.MT, desc.NT)
+    mloc = desc.MTL * mb
+    nloc = desc.NTL * mb
+    cplx = jnp.iscomplexobj(data)
+
+    def ct(x):
+        return x.conj().T if cplx else x.T
+
+    eps = float(jnp.finfo(
+        jnp.zeros((), data.dtype).real.dtype).eps)
+
+    def body(local):
+        A = local.reshape(mloc, nloc)
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        grow, gcol, gid, gcid = _slab_coords(desc, p, q)
+        # identity-seed pad columns (zero pad panels break the Gram)
+        A = _seed_pad_diag(A, desc, gid, gcid)
+        eye = jnp.eye(mb, dtype=A.dtype)
+        Ts = []
+        for k in range(KT):
+            pk = layout.owner(k, P, d.kp, d.ip)
+            qk = layout.owner(k, Q, d.kq, d.jq)
+            lrk = layout.local_index(k, P, d.kp)
+            lck = layout.local_index(k, Q, d.kq)
+            cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
+            pan = jax.lax.psum(
+                jnp.where(q == qk, cs, jnp.zeros_like(cs)),
+                pmesh.COL_AXIS)
+            act = (gid >= k * mb)[:, None]
+            x = jnp.where(act, pan, 0)
+
+            def cqr(xx, shift):
+                g = jax.lax.psum(kb.dot(xx, xx, ta=True, conj_a=True),
+                                 pmesh.ROW_AXIS)
+                if shift:
+                    sft = 11.0 * (desc.M * mb + mb * (mb + 1)) * eps
+                    g = g + (sft * jnp.trace(g).real.astype(
+                        g.real.dtype)) * eye
+                ell = kb.potrf(g, lower=True)
+                return kb.trsm(ell, xx, side="R", lower=True,
+                               trans="C"), ell
+            q1, l1 = cqr(x, True)
+            q2, l2 = cqr(q1, False)
+            R = ct(kb.dot(l1, l2))        # R2 R1, replicated
+            topq = jax.lax.psum(
+                jnp.where(p == pk,
+                          jax.lax.dynamic_slice_in_dim(
+                              q2, lrk * mb, mb, axis=0),
+                          jnp.zeros((mb, mb), A.dtype)),
+                pmesh.ROW_AXIS)
+            # replicated TSQR-HR reconstruction of the top block (the
+            # shared kernels.householder construction), U exposed for
+            # the distributed rows' V2 = q2 U^{-1}
+            packedtop, V1, T, Ub = hh.householder_reconstruct(
+                topq, R, return_u=True)
+            Ts.append(T)
+            # local V: V1 rows on the diag owner, q2 Ub^{-1} below
+            below = (gid >= (k + 1) * mb)[:, None]
+            V2 = kb.trsm(Ub, q2, side="R", lower=False)
+            v1slab = jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros_like(q2), V1, lrk * mb, axis=0)
+            diagrow = ((grow == k) & (p == pk))[:, None]
+            Vloc = jnp.where(below, V2, jnp.where(diagrow, v1slab, 0))
+            # trailing + R12 update: C <- C - V (T^H (V^H C))
+            W = jax.lax.psum(kb.dot(Vloc, A, ta=True, conj_a=True),
+                             pmesh.ROW_AXIS)
+            upd = kb.dot(Vloc, kb.dot(T, W, ta=True, conj_a=True))
+            trail = (gcid >= (k + 1) * mb)[None, :]
+            A = A - jnp.where(trail, upd, 0)
+            # owners write the packed panel column
+            at_k = jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros_like(cs), packedtop, lrk * mb, axis=0)
+            newcs = jnp.where(below, V2,
+                              jnp.where(diagrow, at_k, cs))
+            A = jnp.where(q == qk,
+                          jax.lax.dynamic_update_slice_in_dim(
+                              A, newcs, lck * mb, axis=1), A)
+        TsA = jnp.stack(Ts)                       # (KT, mb, mb)
+        return A.reshape(1, 1, mloc, nloc), TsA[None, None]
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                               None),
+        out_specs=(PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                 None),
+                   PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                 None, None)))
+    return f(data)
+
+
+def qr_t_factor(Ts, A: TileMatrix) -> TileMatrix:
+    """Convert a geqrf_cyclic T-factor stack (KT, mb, mb) into the
+    ops.qr T TileMatrix (unmqr/ormqr-ready), padded to the T
+    descriptor of ``A``."""
+    from dplasma_tpu.ops import qr as qr_mod
+    Td = jnp.concatenate([Ts[i] for i in range(Ts.shape[0])], axis=1)
+    Tm = qr_mod.t_desc(A)
+    if Td.shape[1] < Tm.desc.Np:
+        Td = jnp.pad(Td, ((0, 0), (0, Tm.desc.Np - Td.shape[1])))
+    return TileMatrix(Td, Tm.desc)
+
+
+def geqrf_cyclic(A: CyclicMatrix):
+    """Distributed blocked QR on block-cyclic local storage (the
+    pdgeqrf / zgeqrf_param shape). Returns (factor CyclicMatrix in the
+    ops.qr packed layout, Ts (KT, mb, mb) T-factor stack —
+    :func:`qr_t_factor` converts it to the ops.qr T TileMatrix)."""
+    m = pmesh.active()
+    assert m is not None, "geqrf_cyclic needs an active mesh (use_grid)"
+    ms = (m.shape[pmesh.ROW_AXIS], m.shape[pmesh.COL_AXIS])
+    assert ms == (A.desc.dist.P, A.desc.dist.Q), (
+        f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
+    out, Ts = _geqrf_cyclic_jit(A.data, A.desc, m)
+    return CyclicMatrix(out, A.desc), Ts[0, 0]
 
 
 def potrf_cyclic(A: CyclicMatrix, uplo: str = "L") -> CyclicMatrix:

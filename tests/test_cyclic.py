@@ -158,3 +158,36 @@ def test_getrf_ptgpanel_routes_distributed(devices8):
     X = lu_mod.getrs("N", LU, perm, B)
     r, ok = checks.check_axmb(A, B, X)
     assert ok, r
+
+
+def test_geqrf_cyclic_residual(devices8):
+    """Distributed blocked QR on cyclic storage: residual and
+    orthogonality through the standard compact-WY apply (BASELINE
+    config #3 — the zgeqrf_param role; the Gram psum along 'p' is the
+    HQR high-level combining tree)."""
+    from dplasma_tpu.ops import qr as qr_mod
+
+    P, Q = 2, 4
+    m = mesh.make_mesh(P, Q, devices8)
+    N, nb = 48, 4
+    dist = Dist(P=P, Q=Q, kp=2, kq=2)
+    with mesh.use_grid(m):
+        A0 = generators.plrnt(N, N, nb, nb, seed=5, dtype=jnp.float32)
+        C = cyclic.CyclicMatrix.from_tile(A0, dist)
+        F, Ts = cyclic.geqrf_cyclic(C)
+        packed = F.to_tile()
+        Tf = cyclic.qr_t_factor(Ts, A0)
+        R = jnp.triu(packed.to_dense())
+        Rm = TileMatrix.from_dense(R, nb, nb)
+        QR = np.asarray(qr_mod.unmqr("L", "N", packed, Tf, Rm)
+                        .to_dense())
+        a = np.asarray(A0.to_dense())
+        eps = np.finfo(np.float32).eps
+        resid = np.abs(QR - a).max() / (np.abs(a).max() * N * eps)
+        assert resid < 100, resid
+        eye = jnp.eye(N, dtype=jnp.float32)
+        Qm = np.asarray(qr_mod.unmqr(
+            "L", "N", packed, Tf,
+            TileMatrix.from_dense(eye, nb, nb)).to_dense())
+        orth = np.abs(Qm.T @ Qm - np.eye(N)).max() / (N * eps)
+        assert orth < 100, orth
