@@ -188,8 +188,11 @@ def main():
         dd_gemm_cfgs = [dict(N=4096), dict(N=2048)]
         dd_potrf_cfgs = [dict(N=8192, nb=512), dict(N=4096, nb=512),
                          dict(N=4096, nb=1024), dict(N=2048, nb=512)]
-        dd_geqrf_cfgs = [dict(N=4096, nb=512), dict(N=2048, nb=512)]
-        dd_getrf_cfgs = [dict(N=4096, nb=512), dict(N=2048, nb=512)]
+        # compile cost bounds the dd LU/QR sizes: the AOT helper takes
+        # ~90s per panel's limb graph (measured r3; 4096/512 exceeded
+        # the driver's patience and 8192 OOM-killed the helper)
+        dd_geqrf_cfgs = [dict(N=2048, nb=512), dict(N=1024, nb=256)]
+        dd_getrf_cfgs = [dict(N=2048, nb=512), dict(N=1024, nb=256)]
     else:  # CI / smoke path: tiny shapes, same code
         peak32 = measure_peak(n=1024, iters=20, dtype="float32",
                               precision=jax.lax.Precision.HIGHEST)

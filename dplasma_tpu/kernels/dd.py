@@ -57,18 +57,6 @@ def _plan(K: int, bits: int):
 KC = _plan(2 ** 20, 53)[2]
 
 
-def _pow2_scale(m, mode: str = "floor", bias: float = 0.0):
-    """Power-of-two scale 2^(mode(log2 m) + bias) from positive
-    magnitudes ``m`` (nonpositive entries -> scale 1).  The exponent is
-    clamped to f64's normal range: a subnormal column max would
-    otherwise send exp2 to inf and NaN-poison the caller (review r3).
-    Shared by every prescale in the module so the edge-case decisions
-    live in one place."""
-    f = {"floor": jnp.floor, "round": jnp.round, "ceil": jnp.ceil}[mode]
-    e = f(jnp.log2(jnp.where(m > 0, m, 1.0))) + bias
-    return jnp.exp2(jnp.clip(e, -1022.0, 1022.0))
-
-
 def _split_int(x, w: int, nl: int, axis: int):
     """Exact row/col-scaled integer limb decomposition.
 
@@ -80,7 +68,7 @@ def _split_int(x, w: int, nl: int, axis: int):
     m = jnp.max(jnp.abs(x), axis=ax, keepdims=True)
     # strictly-greater power-of-two scale: |u| < 1 keeps every digit
     # <= 2^w - 1 = 127 (u = +-1 would emit +-128, wrapping int8)
-    scale = _pow2_scale(m, "floor", 1.0)
+    scale = _pow2_scale_bits(m)
     return _split_fixed(x, scale, w, nl), scale, m
 
 
@@ -249,7 +237,7 @@ def trtri_f64(T, lower: bool = True, unit: bool = False, iters: int = 2):
         # would overflow/flush in the seed solve (review r3);
         # inv(S T') = inv(T') S^{-1} unscales exactly
         m_ = jnp.max(jnp.abs(T), axis=1, keepdims=True)
-        s = _pow2_scale(m_)
+        s = 0.25 * _pow2_scale_bits(m_)   # 2^floor(log2 m)
         T = T / s
     eye32 = jnp.eye(n, dtype=jnp.complex64 if jnp.iscomplexobj(T)
                     else jnp.float32)
@@ -306,21 +294,116 @@ def _row_norm_scales(diag):
     error bound is ~K*eps64*||a_i||*||b_j|| either way, Cauchy-Schwarz).
     """
     v = jnp.sqrt(jnp.maximum(diag, jnp.finfo(jnp.float64).tiny))
-    return _pow2_scale(v, "ceil", 1.0)
+    return _pow2_scale_bits(v)
+
+
+def _ff_backend() -> bool:
+    """Is f64 emulated as an f32 pair (the TPU x64 rewriter), limiting
+    its range to f32's and forbidding f64 bitcasts?"""
+    return jax.default_backend() == "tpu"
+
+
+def _pow2_scale_bits(m):
+    """floor(log2 m) + 2 power-of-two scale read from the exponent
+    field (so |x| <= scale/2 for |x| <= m — the headroom both split
+    implementations need; exponent clamped inside the normal range).
+    The transcendental route (log2+exp2) costs ~1s of AOT compile per
+    call site in f64 emulation (measured r3); this is a handful of
+    bitcast integer ops.  True-f64 backends read the f64 exponent
+    (full range); float-float backends read the f32 exponent — which
+    IS their f64's range."""
+    if not _ff_backend():
+        p = jax.lax.bitcast_convert_type(
+            jnp.asarray(m, jnp.float64), jnp.uint32)
+        e = jnp.clip((p[..., 1] >> 20) & 0x7FF, 1, 0x7FC) + 2
+        pair = jnp.stack([jnp.zeros_like(e), e << 20],
+                         axis=-1).astype(jnp.uint32)
+        return jax.lax.bitcast_convert_type(pair, jnp.float64)
+    m32 = jnp.asarray(m).astype(jnp.float32)
+    b = jax.lax.bitcast_convert_type(m32, jnp.uint32)
+    # f32(m) may round up across a power-of-two boundary: that only
+    # grows the scale by one more factor of 2 (safe, budgeted)
+    e = jnp.clip((b >> 23) & 0xFF, 1, 0xFC) + 2
+    s32 = jax.lax.bitcast_convert_type(
+        (e << 23).astype(jnp.uint32), jnp.float32)
+    return s32.astype(jnp.float64)
 
 
 def _split_fixed(x, scale, w: int, nl: int):
     """Exact limb split with a caller-supplied per-row power-of-two
-    scale (requires |x| < scale elementwise): x == scale *
-    sum_l limbs[l] * 2^{-w(l+1)} up to the dropped tail < 2^{-w*nl}."""
-    u = x / scale
+    scale (requires |x| <= scale/2 elementwise): x == scale *
+    sum_l limbs[l] * 2^{-w(l+1)} up to the dropped tail
+    < 2^{-w*nl+1}.
+
+    Two implementations, both integer/f32-shaped — the f64-arithmetic
+    trunc recurrence costs ~0.07s of AOT compile per emulated op and
+    dominated the dd graphs' compile time (measured r3):
+
+    * true-f64 backends: digits read straight from the f64 bit
+      pattern (shifted mantissa windows);
+    * MXU backends, where the x64 rewriter emulates f64 as an f32
+      pair and cannot bitcast it: two exact f32 trunc chains on the
+      hi/lo parts + one integer carry normalization
+      (:func:`_split_fixed_ff`).
+    """
+    if _ff_backend():
+        return _split_fixed_ff(x, scale, w, nl)
+    p = jax.lax.bitcast_convert_type(x, jnp.uint32)   # [..., lo, hi]
+    lo = p[..., 0].astype(jnp.int64)
+    hi = p[..., 1].astype(jnp.int64)
+    e_x = (hi >> 20) & 0x7FF
+    mant = jnp.where(e_x > 0,
+                     ((hi & 0xFFFFF) << 32) | lo | (1 << 52),
+                     0)
+    sgn = 1 - 2 * (hi >> 31)
+    ps = jax.lax.bitcast_convert_type(jnp.asarray(scale, jnp.float64),
+                                      jnp.uint32)
+    e_s = (ps[..., 1].astype(jnp.int64) >> 20) & 0x7FF
+    sh = e_x - e_s                    # <= -1 given |x| < scale; the
+    # scale's broadcast shape rides the integer arithmetic
+    mask = jnp.int64(2 ** w - 1)
     limbs = []
-    for _ in range(nl):
-        u = u * (2.0 ** w)
-        d = jnp.trunc(u)
-        u = u - d
-        limbs.append(d.astype(jnp.int8))
+    for l in range(nl):
+        t = 52 - sh - w * (l + 1)     # bit offset of the window LSB
+        tpos = jnp.clip(t, 0, 63)
+        tneg = jnp.clip(-t, 0, 63)
+        d = ((mant >> tpos) << tneg) & mask
+        limbs.append((sgn * d).astype(jnp.int8))
     return limbs
+
+
+def _split_fixed_ff(x, scale, w: int, nl: int):
+    """Digit split for float-float f64 backends: u = x/scale splits
+    exactly into its native f32 hi/lo parts; each part runs the exact
+    f32 trunc recurrence (every step's product, trunc and remainder
+    are exact in f32 for |v| < 1), and the two digit streams add with
+    one integer carry pass into [-64, 63] (level 0 keeps its <= 66
+    headroom — carrying out of it would drop value).  On a true-f64
+    backend the lo part rounds to 24 bits, so this path is only
+    selected where f64 IS an f32 pair (precision there equals the
+    platform's own f64)."""
+    u = x / scale                    # exact: power-of-two divide
+    uh = u.astype(jnp.float32)
+    ul = (u - uh.astype(jnp.float64)).astype(jnp.float32)
+
+    def chain(v):
+        ds = []
+        for _ in range(nl):
+            v = v * jnp.float32(2.0 ** w)
+            d = jnp.trunc(v)
+            v = v - d
+            ds.append(d.astype(jnp.int32))
+        return ds
+
+    d = [a + b for a, b in zip(chain(uh), chain(ul))]
+    half = 1 << (w - 1)
+    out = [None] * nl
+    for l in range(nl - 1, 0, -1):
+        k = (d[l] + half) >> w
+        out[l] = d[l] - (k << w)
+        d[l - 1] = d[l - 1] + k
+    out[0] = d[0]
+    return [o.astype(jnp.int8) for o in out]
 
 
 def _pair_dot(al, bl, K: int, w: int, nl: int, kc: int):
@@ -349,7 +432,8 @@ def _potrf_tile_ir(Akk, refine: int = 3, newton: int = 2,
     # range for diagonals outside f32's span (review r3); A = D A' D
     # with D = 2^round(log2 sqrt(a_ii)), so L = D L', X = X' D^{-1}
     dg = jnp.diagonal(Af)
-    d = _pow2_scale(jnp.sqrt(jnp.where(dg > 0, dg, 1.0)), "round")
+    d = 0.25 * _pow2_scale_bits(
+        jnp.sqrt(jnp.where(dg > 0, dg, 1.0)))
     Af = Af / (d[:, None] * d[None, :])
     L = jax.lax.linalg.cholesky(
         Af.astype(jnp.float32), symmetrize_input=False)

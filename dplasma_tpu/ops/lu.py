@@ -258,7 +258,7 @@ def _panel_lu_dd(panel, ib: int | None = None):
     # |L| <= 1 as with unscaled pivoting); only U unscales, exactly:
     # panel*D = L*(U*D)  =>  U = U_scaled / d.
     m_ = jnp.max(jnp.abs(panel), axis=0, keepdims=True)
-    d = 1.0 / _dd._pow2_scale(m_)
+    d = 4.0 / _dd._pow2_scale_bits(m_)   # 2^-floor(log2 colmax)
     pan32, perm = _panel_lu((panel * d).astype(jnp.float32), ib)
     # refine in the scaled coordinates (everything O(growth) there, so
     # the IR's own f32 seeds stay in range), unscale U exactly after

@@ -30,6 +30,7 @@ reduction (the MPI_Allreduce(MAX) analog).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from dplasma_tpu.descriptors import TileMatrix
@@ -191,6 +192,87 @@ def dag(A: TileMatrix, uplo: str = "L", recorder=None):
                 if k > 0:
                     rec.edge(gemm_t(m, n, k - 1), gm, "C")  # chain
     return rec
+
+
+def plan_potrf_lowmem(N: int, dtype, budget_bytes: int):
+    """Blocking for the out-of-HBM tier: panel width ``nb`` and
+    streamed-chunk width ``cw`` such that the device working set —
+    one (N, nb) panel + one (N, cw) finished-column chunk + update
+    temporaries (~one more panel) — fits the budget.  Mirrors the
+    reference's lowmem blocking inequality (zgemm_wrapper.c:261-305
+    against GPU memory)."""
+    item = jnp.dtype(dtype).itemsize
+    per_col = N * item
+    cols = max(int(budget_bytes // per_col), 4)
+    nb = max(min(512, cols // 4), 1)
+    cw = max(cols - 3 * nb, nb)
+    return nb, cw
+
+
+def potrf_lowmem(A, nb: int | None = None,
+                 budget_bytes: int | None = None):
+    """Out-of-HBM Cholesky (the reference's lowmem tier: deliberately
+    memory-starved runs relying on paced streaming + eviction, ref
+    tests/Testings.cmake:147, src/zgemm_NN_gpu.jdf:243-330).
+
+    The matrix lives HOST-side (numpy); a left-looking panel sweep
+    streams block columns through a device working set sized to the
+    HBM budget: per panel k, finished columns are brought on-device in
+    width-``cw`` chunks and applied as MXU matmuls, then the panel is
+    factored on-device and written back.  Device-live bytes stay
+    O(N*(nb+cw)) regardless of N — matrices bigger than HBM factor in
+    as many passes as the budget dictates (the explicit-streaming
+    re-design of the reference's LRU tile eviction).
+
+    ``A``: host numpy array (lower triangle read); returns the host
+    factor (lower).  Budget defaults to MCA ``device.hbm_fraction`` of
+    the device memory (the lowmem tests pin it artificially small).
+    """
+    import numpy as np
+    from dplasma_tpu.ops import gemm as gemm_mod
+    from dplasma_tpu.utils import config as _cfg
+
+    Ah = np.array(A, copy=True)
+    N = Ah.shape[0]
+    if budget_bytes is None:
+        try:
+            frac = float(_cfg.mca_get("device.hbm_fraction", "0.95"))
+        except ValueError:
+            frac = 0.95
+        budget_bytes = int(frac * gemm_mod.device_memory_bytes())
+    nb_p, cw = plan_potrf_lowmem(N, Ah.dtype, budget_bytes)
+    if nb is None:
+        nb = nb_p
+    cw = max(cw // nb * nb, nb)
+
+    for s in range(0, N, nb):
+        w = min(nb, N - s)
+        col = jnp.asarray(Ah[s:, s:s + w])
+        for j0 in range(0, s, cw):
+            j1 = min(j0 + cw, s)
+            W = jnp.asarray(Ah[s:, j0:j1])
+            col = _lowmem_upd(col, W)
+        col = _lowmem_panel(col)
+        Ah[s:, s:s + w] = np.asarray(col)
+    return np.tril(Ah)
+
+
+@jax.jit
+def _lowmem_upd(col, W):
+    """col -= W @ W[:width]^H (W rows align with col rows).  Module
+    level so the per-shape compile cache survives across
+    potrf_lowmem calls."""
+    return col - k.dot(W, W[:col.shape[1]], tb=True, conj_b=True)
+
+
+@jax.jit
+def _lowmem_panel(col):
+    Lkk = k.potrf(col[:col.shape[1]], lower=True)
+    if col.shape[0] > col.shape[1]:
+        pan = k.trsm(Lkk, col[col.shape[1]:], side="R", lower=True,
+                     trans="C")
+        return jnp.concatenate([jnp.tril(Lkk), pan], axis=0)
+    return jnp.tril(Lkk)
 
 
 def potrs(A: TileMatrix, B: TileMatrix, uplo: str = "L") -> TileMatrix:

@@ -57,6 +57,144 @@ def _to_tm(a: np.ndarray, nb: int):
                                  nb, nb)
 
 
+# -- multi-rank BLACS grids (in-process SPMD emulation) -----------------
+#
+# The reference's wrappers accept arbitrary BLACS grids and
+# parsec_redistribute the caller's block-cyclic pieces on entry
+# (scalapack_wrappers/common.c:26-90).  Here a P×Q grid registers via
+# dplasma_blacs_gridinit_; the host process then plays every rank in
+# turn (the reference CI's own strategy of oversubscribed local ranks,
+# .github/workflows/build_cmake.yml:36): each virtual rank declares
+# itself with dplasma_blacs_set_rank_ and makes the SPMD call with its
+# LOCAL cyclic piece.  Calls are collected; when the last rank enters
+# (the in-process stand-in for the MPI collective barrier), the global
+# matrix is assembled from the pieces, the op runs once, and results
+# scatter back into every rank's buffer.  Non-final calls return 0;
+# the collective INFO is the final call's return and
+# dplasma_blacs_last_info_.
+
+_GRIDS: dict = {}        # ctxt -> (P, Q)
+_CUR_RANK: dict = {}     # ctxt -> (p, q)
+_PENDING: dict = {}      # (ctxt, name) -> {rank: args}
+_LAST_INFO: dict = {}
+
+# (addr_idx, desc_idx, writeback) of every distributed buffer per op
+# (the ia/ja follow the address; writeback=False for pure inputs, which
+# skip the scatter phase).  Ops with rank-local auxiliary outputs
+# (ipiv, tau, w) stay single-process only.
+_BUF_SPEC = {
+    "gemm": [(8, 11, False), (12, 15, False), (16, 19, True)],
+    "potrf": [(3, 6, True)],
+    "trsm": [(8, 11, False), (12, 15, True)],
+    "trmm": [(8, 11, False), (12, 15, True)],
+    "potrs": [(4, 7, False), (8, 11, True)],
+    "posv": [(4, 7, True), (8, 11, True)],
+    "potri": [(3, 6, True)],
+    "trtri": [(4, 7, True)],
+}
+
+
+def _h_blacs_gridinit(ctxt, P, Q):
+    _GRIDS[int(ctxt)] = (int(P), int(Q))
+    return 0
+
+
+def _h_blacs_set_rank(ctxt, p, q):
+    _CUR_RANK[int(ctxt)] = (int(p), int(q))
+    return 0
+
+
+def _h_blacs_last_info(ctxt):
+    return int(_LAST_INFO.get(int(ctxt), 0))
+
+
+def _h_blacs_gridexit(ctxt):
+    """Tear the grid down: an aborted collective would otherwise leave
+    _PENDING holding raw buffer addresses that a retry could complete
+    against after the caller freed them (review r3)."""
+    c = int(ctxt)
+    _GRIDS.pop(c, None)
+    _CUR_RANK.pop(c, None)
+    _LAST_INFO.pop(c, None)
+    for key in [k for k in _PENDING if k[0] == c]:
+        del _PENDING[key]
+    return 0
+
+
+def _each_block(M, N, MB, NB, rsrc, csrc, P, Q):
+    """Yield (global rows slice, cols slice, owner (p,q), local slices)
+    for every block of an M×N cyclic layout."""
+    for bi in range(-(-M // MB)):
+        pr = (bi + rsrc) % P
+        li = bi // P
+        r0, r1 = bi * MB, min((bi + 1) * MB, M)
+        for bj in range(-(-N // NB)):
+            qc = (bj + csrc) % Q
+            lj = bj // Q
+            c0, c1 = bj * NB, min((bj + 1) * NB, N)
+            yield (slice(r0, r1), slice(c0, c1), (pr, qc),
+                   slice(li * MB, li * MB + (r1 - r0)),
+                   slice(lj * NB, lj * NB + (c1 - c0)))
+
+
+def _assemble_scatter(pend, ai, di, P, Q, dt, g=None):
+    """g=None: assemble the global array from every rank's local cyclic
+    piece; else scatter g back into the ranks' buffers."""
+    d0 = pend[(0, 0)][di]
+    M, N = int(d0[_M]), int(d0[_N])
+    MB, NB = int(d0[_MB]), int(d0[_NB])
+    rsrc, csrc = int(d0[_RSRC]), int(d0[_CSRC])
+    views = {r: _view(pend[r][ai], pend[r][di], dt) for r in pend}
+    out = np.zeros((M, N), dt, order="F") if g is None else None
+    for rs, cs, owner, lrs, lcs in _each_block(M, N, MB, NB,
+                                               rsrc, csrc, P, Q):
+        if g is None:
+            out[rs, cs] = views[owner][lrs, lcs]
+        else:
+            views[owner][lrs, lcs] = g[rs, cs]
+    return out
+
+
+def _multirank(name: str, args):
+    """Collect SPMD calls on a registered multi-rank grid; run the op
+    on the assembled global matrix when the last rank enters. Returns
+    None when the call is single-process."""
+    spec = _BUF_SPEC.get(name)
+    if not spec:
+        return None
+    ctxt = int(args[spec[0][1]][_CTXT])
+    P, Q = _GRIDS.get(ctxt, (1, 1))
+    if (P, Q) == (1, 1):
+        return None
+    rank = _CUR_RANK.get(ctxt, (0, 0))
+    pend = _PENDING.setdefault((ctxt, name), {})
+    pend[rank] = args
+    if len(pend) < P * Q:
+        return 0           # deferred until the collective is complete
+    del _PENDING[(ctxt, name)]
+    dt = _NP_DTYPE[_prec_of(args)]
+    newargs = list(pend[(0, 0)])
+    keep = []
+    for ai, di, wb in spec:
+        g = _assemble_scatter(pend, ai, di, P, Q, dt)
+        keep.append((g, ai, di, wb))
+        gd = list(newargs[di])
+        gd[_CTXT] = -ctxt - 1    # single-process view of the assembly
+        gd[_LLD] = g.shape[0]
+        newargs[ai] = g.ctypes.data
+        newargs[di] = tuple(gd)
+    try:
+        info = int(_HANDLERS[name](*newargs))
+    except Exception:
+        _LAST_INFO[ctxt] = -1    # the collective INFO must not keep
+        raise                    # reporting a stale success
+    for g, ai, di, wb in keep:
+        if wb:
+            _assemble_scatter(pend, ai, di, P, Q, dt, g=g)
+    _LAST_INFO[ctxt] = info
+    return info
+
+
 def dispatch(name: str, args) -> int:
     """Entry point called from the native shim. Returns INFO."""
     call_counts[name] = call_counts.get(name, 0) + 1
@@ -75,6 +213,9 @@ def dispatch(name: str, args) -> int:
             ctx = jax.default_device(cpus[0])
     try:
         with ctx:
+            mr = _multirank(name, args)
+            if mr is not None:
+                return mr
             return int(_HANDLERS[name](*args))
     except Exception as exc:  # surface as INFO<0, like xerbla
         import traceback
@@ -329,6 +470,10 @@ def _h_syev(jobz, uplo, prec, n, pa, ia, ja, desca, pw, pwork, lwork):
 
 
 _HANDLERS = {
+    "blacs_gridinit": _h_blacs_gridinit,
+    "blacs_set_rank": _h_blacs_set_rank,
+    "blacs_last_info": _h_blacs_last_info,
+    "blacs_gridexit": _h_blacs_gridexit,
     "gemm": _h_gemm,
     "potrf": _h_potrf,
     "trsm": _h_trsm,

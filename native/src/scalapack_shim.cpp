@@ -272,6 +272,48 @@ DEF_PTRTRI(ps, float)
 DEF_PSYEV(pd, double)
 DEF_PSYEV(ps, float)
 
+// ------------------------------------------- multi-rank BLACS grids
+// The reference's wrappers accept arbitrary BLACS grids and
+// redistribute on entry (scalapack_wrappers/common.c:26-90).  This
+// shim hosts every rank of a P×Q grid in one process (the reference
+// CI's oversubscribed-local-ranks strategy): register the grid, then
+// play each rank — declare it with set_rank and make the SPMD call
+// with that rank's local cyclic piece.  The op executes when the last
+// rank enters; its INFO is also readable via last_info.
+void dplasma_blacs_gridinit_(const int* ctxt, const int* p,
+                             const int* q) {
+  ensure_python();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(iii)", *ctxt, *p, *q);
+  PyGILState_Release(st);
+  dispatch("blacs_gridinit", args);
+}
+
+void dplasma_blacs_set_rank_(const int* ctxt, const int* myrow,
+                             const int* mycol) {
+  ensure_python();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(iii)", *ctxt, *myrow, *mycol);
+  PyGILState_Release(st);
+  dispatch("blacs_set_rank", args);
+}
+
+void dplasma_blacs_gridexit_(const int* ctxt) {
+  ensure_python();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(i)", *ctxt);
+  PyGILState_Release(st);
+  dispatch("blacs_gridexit", args);
+}
+
+int dplasma_blacs_last_info_(const int* ctxt) {
+  ensure_python();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(i)", *ctxt);
+  PyGILState_Release(st);
+  return dispatch("blacs_last_info", args);
+}
+
 int dplasma_tpu_shim_version() { return 1; }
 
 }  // extern "C"

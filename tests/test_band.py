@@ -72,6 +72,7 @@ def test_schedule_sizes_scale_linearly_in_compile():
     assert (s1[:, 0] < 64).all() and (s1[:, 1] >= 0).all()
 
 
+@pytest.mark.slow
 def test_halving_sweep_plus_chase_handoff():
     """Exercise the blocked band-halving regime and its 2w-1 bandwidth
     handoff to the chase (otherwise only reachable with nb > 32)."""
@@ -91,6 +92,7 @@ def test_halving_sweep_plus_chase_handoff():
                        atol=1e-11 * N)
 
 
+@pytest.mark.slow
 def test_gebrd_halving_regime():
     import jax.numpy as jnp
     from dplasma_tpu.ops import eig, generators

@@ -62,7 +62,8 @@ def test_dd_wired_into_tile_kernels(rng, monkeypatch):
     (192, 64, 11, "L"),
     (192, 64, 51, "L"),     # the seed that caught refine=2 (review r3)
     (192, 64, 51, "U"),
-    (378, 93, 3872, "L"),   # odd sizes: edge tiles + identity padding
+    pytest.param(378, 93, 3872, "L", marks=pytest.mark.slow),
+    # ^ odd sizes: edge tiles + identity padding (compile-heavy)
 ])
 def test_dd_potrf_end_to_end(rng, N, nb, seed, uplo):
     """d-precision blocked POTRF runs entirely through the limb GEMM
@@ -258,3 +259,28 @@ def test_bits32_mode(rng):
     out = np.asarray(dd.gemm_f64(jnp.asarray(a), jnp.asarray(b), bits=32))
     ref = a @ b
     assert np.max(np.abs(out - ref) / np.max(np.abs(ref))) < 1e-8
+
+
+def test_split_fixed_ff_matches_bits(rng):
+    """The float-float digit split (MXU backends, where the x64
+    rewriter cannot bitcast f64) must reproduce the bit-pattern split's
+    reconstruction within its tail bound, with int8-safe digits."""
+    x = rng.standard_normal((64, 32)) * np.exp(
+        rng.uniform(-8, 8, (64, 1)))
+    x[3] = 0.0
+    x[4, :] = 1.0
+    m = np.abs(x).max(1, keepdims=True)
+    sc = np.asarray(dd._pow2_scale_bits(jnp.asarray(m)))
+    assert (sc >= 2 * m).all()
+    w, nl = dd.W8, 8
+    for split in (dd._split_fixed, dd._split_fixed_ff):
+        limbs = [np.asarray(l, np.int64)
+                 for l in split(jnp.asarray(x), jnp.asarray(sc), w, nl)]
+        assert max(np.abs(l).max() for l in limbs) <= 127
+        rec = sum(l * 2.0 ** (-w * (i + 1))
+                  for i, l in enumerate(limbs)) * sc
+        # ff runs on true-f64 here, so its lo part rounds to 24 bits:
+        # grant it the corresponding tail (2^-48); bits split gets the
+        # full 2^-55 contract
+        tol = 2.0 ** -48 if split is dd._split_fixed_ff else 2.0 ** -55
+        assert (np.abs(rec - x) <= sc * tol).all(), split

@@ -126,6 +126,7 @@ def test_gesvd_direct():
     assert np.allclose(np.asarray(s), ref, atol=1e-10 * max(M, N))
 
 
+@pytest.mark.slow
 def test_hbrdt_band_matrix_wide():
     """BandMatrix input with bw above the chase cut: exercises the
     densify-for-halving branch (lower_band_to_dense + Hermitian
@@ -143,6 +144,7 @@ def test_hbrdt_band_matrix_wide():
     assert np.allclose(got, np.linalg.eigvalsh(h), atol=1e-10 * N)
 
 
+@pytest.mark.slow
 def test_hbrdt_band_matrix_input():
     """hbrdt accepts the O(N·band) BandMatrix object (the reference's
     band descriptor, zheev_wrapper.c:97) end to end — band within the
@@ -161,6 +163,7 @@ def test_hbrdt_band_matrix_input():
     assert np.allclose(got, ref, atol=1e-10 * N)
 
 
+@pytest.mark.slow
 def test_heev_2stage_wide_band_matches_direct():
     """2stage at a size whose stage-1 band (2*nb-1 = 255... clipped by
     _EIG_NB) exceeds the chase cut: SBR + banded chase against the

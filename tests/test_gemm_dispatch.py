@@ -66,6 +66,7 @@ def test_summa_fallback_without_mesh():
     run_case(gemm_mod.gemm_summa, "N", "N")
 
 
+@pytest.mark.slow
 def test_summa_multi_step_pipeline(devices8):
     m = pmesh.make_mesh(2, 4, devices=devices8)
     with pmesh.use_grid(m):
@@ -75,6 +76,7 @@ def test_summa_multi_step_pipeline(devices8):
         run_case(fn, "N", "N", M=48, N=40, K=64, nb=8)
 
 
+@pytest.mark.slow
 def test_gemm_ex_dispatch_modes(devices8):
     A = mk(32, 32, 8, 8, 1)
     B = mk(32, 32, 8, 8, 2)

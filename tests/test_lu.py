@@ -174,6 +174,7 @@ def test_getrf_qrf_falls_back_to_qr():
     assert ok, f"residual {r}"
 
 
+@pytest.mark.slow
 def test_getrf_1d_on_mesh(devices8):
     N, nb = 128, 16
     m = mesh.make_mesh(2, 4, devices8)

@@ -197,6 +197,7 @@ def test_latms_singular_values():
     np.testing.assert_allclose(s, np.asarray(sv), rtol=1e-10)
 
 
+@pytest.mark.slow
 def test_rect_tiles_mb_ne_nb():
     # mb != nb pads rows/cols differently — every generator must cope
     for name in matgen.TYPES:

@@ -116,3 +116,27 @@ def test_potrf_rec_matches_flat():
         L2 = potrf_mod.potrf(A0, uplo)
         assert np.allclose(np.asarray(L.to_dense()),
                            np.asarray(L2.to_dense()), atol=1e-10)
+
+
+def test_potrf_lowmem_budget(rng):
+    """Out-of-HBM tier (ref Testings.cmake:147 lowmem): an artificially
+    tiny budget must still factor a matrix larger than the budget, with
+    a device working set provably under it."""
+    import numpy as np
+    from dplasma_tpu.ops.potrf import plan_potrf_lowmem, potrf_lowmem
+
+    N = 192
+    g = rng.standard_normal((N, N))
+    A = (g @ g.T / N + 4.0 * np.eye(N)).astype(np.float32)
+    budget = A.nbytes // 4           # matrix is 4x the "HBM"
+    nb, cw = plan_potrf_lowmem(N, A.dtype, budget)
+    item = np.dtype(A.dtype).itemsize
+    # working set: one (N, nb) panel + one (N, cw) chunk + a panel of
+    # temporaries — must fit the budget
+    assert (nb + cw + 2 * nb) * N * item <= budget, (nb, cw)
+    L = potrf_lowmem(A, budget_bytes=budget)
+    Lref = np.linalg.cholesky(A.astype(np.float64))
+    resid = np.abs(A - L @ L.T).max() / (
+        np.abs(A).max() * N * np.finfo(np.float32).eps)
+    assert resid < 60.0, resid
+    assert np.allclose(L, Lref, atol=5e-3 * np.abs(Lref).max())

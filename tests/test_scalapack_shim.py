@@ -253,3 +253,63 @@ def test_pdsyev_values(shim, rng):
                  ctypes.byref(info))
     assert info.value == 0
     assert np.abs(w - np.linalg.eigvalsh(h)).max() < 1e-8
+
+
+def test_multirank_blacs_grid(shim, rng):
+    """2x2 BLACS grid interop (ref scalapack_wrappers/common.c:26-90
+    redistribution-on-entry): every virtual rank passes its LOCAL
+    block-cyclic piece; the collective executes when the last rank
+    enters and results scatter back into each rank's buffer."""
+    P, Q, ctxt = 2, 2, 7
+    N, MB = 64, 8
+    shim.dplasma_blacs_gridinit_(ctypes.byref(ctypes.c_int(ctxt)),
+                                 ctypes.byref(ctypes.c_int(P)),
+                                 ctypes.byref(ctypes.c_int(Q)))
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    # carve the global matrix into 2x2 cyclic local pieces
+    nblk = N // MB
+    locs = {}
+    for p in range(P):
+        for q in range(Q):
+            rows = [bi for bi in range(nblk) if bi % P == p]
+            cols = [bj for bj in range(nblk) if bj % Q == q]
+            loc = np.zeros((len(rows) * MB, len(cols) * MB), order="F")
+            for li, bi in enumerate(rows):
+                for lj, bj in enumerate(cols):
+                    loc[li*MB:(li+1)*MB, lj*MB:(lj+1)*MB] = \
+                        spd[bi*MB:(bi+1)*MB, bj*MB:(bj+1)*MB]
+            locs[(p, q)] = np.asfortranarray(loc)
+
+    uplo, n_ = ctypes.c_char(b"L"), ctypes.c_int(N)
+    for p in range(P):
+        for q in range(Q):
+            shim.dplasma_blacs_set_rank_(
+                ctypes.byref(ctypes.c_int(ctxt)),
+                ctypes.byref(ctypes.c_int(p)),
+                ctypes.byref(ctypes.c_int(q)))
+            loc = locs[(p, q)]
+            desc = (ctypes.c_int * 9)(1, ctxt, N, N, MB, MB, 0, 0,
+                                      loc.shape[0])
+            info = ctypes.c_int(99)
+            shim.pdpotrf_(ctypes.byref(uplo), ctypes.byref(n_),
+                          _pd(loc), ctypes.byref(_one),
+                          ctypes.byref(_one), desc,
+                          ctypes.byref(info))
+    assert shim.dplasma_blacs_last_info_(
+        ctypes.byref(ctypes.c_int(ctxt))) == 0
+    # reassemble the factor from the ranks' pieces and verify
+    L = np.zeros((N, N))
+    for p in range(P):
+        for q in range(Q):
+            rows = [bi for bi in range(nblk) if bi % P == p]
+            cols = [bj for bj in range(nblk) if bj % Q == q]
+            loc = locs[(p, q)]
+            for li, bi in enumerate(rows):
+                for lj, bj in enumerate(cols):
+                    L[bi*MB:(bi+1)*MB, bj*MB:(bj+1)*MB] = \
+                        loc[li*MB:(li+1)*MB, lj*MB:(lj+1)*MB]
+    L = np.tril(L)
+    resid = np.abs(spd - L @ L.T).max() / (
+        np.abs(spd).max() * N * np.finfo(np.float64).eps)
+    assert resid < 100.0, resid
