@@ -167,7 +167,7 @@ def potrf(drv: Driver):
     ip = drv.ip
     A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
     A = _put(drv, A0)
-    hnb = max(ip.HMB, 0)  # -z/--HNB: recursive diagonal-tile variant
+    hnb = max(ip.HNB, 0)  # -z/--HNB: recursive diagonal-tile variant
     L, _ = drv.progress(lambda a: potrf_mod.potrf_rec(a, "L", hnb), (A,),
                         lawn41.potrf(ip.N, _is_complex(ip.prec_dtype)),
                         dag_fn=lambda rec: potrf_mod.dag(A, "L", rec))
@@ -258,7 +258,9 @@ def lauum(drv: Driver):
 def geqrf(drv: Driver):
     ip = drv.ip
     A0 = _gen(drv, ip.M, ip.N)
-    out, _ = drv.progress(qr.geqrf, (_put(drv, A0),),
+    hnb = max(ip.HNB, 0)  # -z/--HNB: recursive-panel variant
+    out, _ = drv.progress(lambda a: qr.geqrf_rec(a, hnb),
+                          (_put(drv, A0),),
                           lawn41.geqrf(ip.M, ip.N,
                                        _is_complex(ip.prec_dtype)))
     if ip.check:
@@ -442,7 +444,9 @@ def getrf_nopiv(drv: Driver):
 def getrf_1d(drv: Driver):
     ip = drv.ip
     A0 = _gen(drv, ip.N, ip.N)
-    out, _ = drv.progress(lu.getrf_1d, (_put(drv, A0),), _lu_flops(ip))
+    hnb = max(ip.HNB, 0)  # -z/--HNB: recursive-panel variant
+    out, _ = drv.progress(lambda a: lu.getrf_rec(a, hnb),
+                          (_put(drv, A0),), _lu_flops(ip))
     if ip.check:
         LU, perm = out
         B = _gen(drv, ip.N, ip.K, 1)

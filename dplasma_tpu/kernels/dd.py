@@ -60,9 +60,11 @@ KC = _plan(2 ** 20, 53)[2]
 def _split_int(x, w: int, nl: int, axis: int):
     """Exact row/col-scaled integer limb decomposition.
 
-    Returns (limbs, scale): x == scale * sum_l limbs[l] * 2^{-w(l+1)}
-    exactly up to the dropped tail < 2^{-w*nl}; each limbs[l] is an
-    int8 digit array with |d| < 2^w.
+    Returns (limbs, scale, m): x == scale * sum_l limbs[l] *
+    2^{-w(l+1)} exactly up to the dropped tail; each limbs[l] is an
+    int8 digit array with |d| < 2^w, and ``m`` is the row/col max the
+    scale derives from (callers use it for NaN/Inf detection without
+    an extra pass).
     """
     ax = 1 - axis  # reduce over the opposite axis
     m = jnp.max(jnp.abs(x), axis=ax, keepdims=True)
@@ -601,12 +603,14 @@ def geqrt_f64(panel):
     q, r1 = cholqr_pass(panel, True)
     q, r2 = cholqr_pass(q, False)
     r = gemm_f64(r2, r1)
-    # Householder reconstruction: S = -sign(diag Q1); Q - [S;0] = V Ub
-    s = jnp.where(jnp.diagonal(q[:nb]) >= 0, -1.0, 1.0)
-    b = q.at[jnp.arange(nb), jnp.arange(nb)].add(-s)
+    # TSQR-HR reconstruction: the sign/shift convention and packed
+    # layout are SHARED with the f32 path (kernels.householder) so the
+    # two implementations cannot drift; only the product/LU/inverse
+    # kernels differ (limb-exact here).
     from dplasma_tpu.kernels import blas as _kb
-    b1_32 = b[:nb].astype(jnp.float32)
-    p32 = _kb.getrf_nopiv_blocked(b1_32)
+    from dplasma_tpu.kernels import householder as _hh
+    s, b = _hh.reconstruct_sign_shift(q)
+    p32 = _kb.getrf_nopiv_blocked(b[:nb].astype(jnp.float32))
     V1 = jnp.tril(p32.astype(jnp.float64), -1) + jnp.eye(nb)
     Ub = jnp.triu(p32).astype(jnp.float64)
     V1, Ub = lu_ir(b[:nb], V1, Ub)
@@ -619,10 +623,7 @@ def geqrt_f64(panel):
     # T = -(Ub S^{-1}) V1^{-T};  S^{-1} = S (unimodular real)
     Zt = trtri_f64(V1, lower=True, unit=True)   # V1^{-1}
     t = gemm_f64(-(Ub * s[None, :]), Zt.T)
-    rh = s[:, None] * r     # Householder-convention R = S r
-    packed = jnp.concatenate(
-        [jnp.triu(rh) + jnp.tril(V1, -1)] +
-        ([v[nb:]] if m > nb else []), axis=0)
+    packed = _hh.reconstruct_pack(s, r, v, nb)
     return packed, v, t
 
 

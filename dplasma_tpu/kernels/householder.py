@@ -90,6 +90,27 @@ def cholqr2(a):
     return q, k.dot(r2, r1)
 
 
+def reconstruct_sign_shift(q):
+    """The TSQR-HR sign choice and diagonal shift shared by every
+    reconstruction implementation (this module's f32 path and the
+    dd limb path must never diverge on the tie-break or shift):
+    S = -sign(diag Q1), B = Q - [S; 0]."""
+    n = q.shape[1]
+    s = -_unimodular_sign(jnp.diagonal(q[:n, :]))
+    b = q.at[jnp.arange(n), jnp.arange(n)].add(-s)
+    return s, b
+
+
+def reconstruct_pack(s, r, v, n):
+    """The shared packed layout: Householder-convention R = S r
+    on/above the diagonal, V strictly below."""
+    rh = s[:, None] * r
+    m = v.shape[0]
+    return jnp.concatenate(
+        [jnp.triu(rh) + jnp.tril(v[:n], -1)] +
+        ([v[n:]] if m > n else []), axis=0)
+
+
 def householder_reconstruct(q, r, s=None, return_u=False):
     """Recover the compact-WY form from a thin QR factor
     (Ballard/Demmel/Grigori et al., "Reconstructing Householder vectors
@@ -106,8 +127,9 @@ def householder_reconstruct(q, r, s=None, return_u=False):
     """
     m, n = q.shape
     if s is None:
-        s = -_unimodular_sign(jnp.diagonal(q[:n, :]))
-    b = q.at[jnp.arange(n), jnp.arange(n)].add(-s)
+        s, b = reconstruct_sign_shift(q)
+    else:
+        b = q.at[jnp.arange(n), jnp.arange(n)].add(-s)
     p1 = k.getrf_nopiv_blocked(b[:n])
     v1 = k.tri(p1, lower=True, unit=True)
     u = jnp.triu(p1)
@@ -121,10 +143,7 @@ def householder_reconstruct(q, r, s=None, return_u=False):
     t = lax.linalg.triangular_solve(
         v1, rhs, left_side=False, lower=True, transpose_a=True,
         conjugate_a=True, unit_diagonal=True)
-    rh = s[:, None] * r  # the Householder-convention R
-    packed = jnp.concatenate(
-        [jnp.triu(rh) + jnp.tril(v1, -1)] +
-        ([v[n:]] if m > n else []), axis=0)
+    packed = reconstruct_pack(s, r, v, n)
     if return_u:  # distributed callers apply U^{-1} to their own rows
         return packed, v, t, u
     return packed, v, t

@@ -305,6 +305,22 @@ def getrf_1d(A: TileMatrix):
     return TileMatrix(pmesh.constrain2d(full), A.desc), final_ids
 
 
+def getrf_rec(A: TileMatrix, hnb: int = 0):
+    """Recursive-panel LU (the -z/--HNB variant; ref the reference's
+    recursive CORE_zgetrf_rectil panels + -z drivers): each nb-wide
+    panel factors as an hnb-wide nested shrinking-window sweep —
+    the machinery :func:`_panel_lu` already owns via its ``ib``
+    parameter, here surfaced with the same driver semantics as
+    ops.potrf.potrf_rec / ops.qr.geqrf_rec."""
+    if hnb <= 0 or hnb >= A.desc.nb:
+        return getrf_1d(A)
+    assert A.desc.mb == A.desc.nb, "getrf needs square tiles"
+    full, final_ids = _lu_sweep(
+        A.pad_diag().data, A.desc.nb,
+        lambda panel: _panel_lu(panel, ib=hnb))
+    return TileMatrix(pmesh.constrain2d(full), A.desc), final_ids
+
+
 def getrf_ptgpanel(A: TileMatrix):
     """Distributed-parallel-panel LU (dplasma_zgetrf_ptgpanel,
     src/zgetrf_ptgpanel.jdf). Under an active mesh with a nontrivial

@@ -45,7 +45,56 @@ def t_desc(A: TileMatrix) -> TileMatrix:
 
 # -- QR ----------------------------------------------------------------
 
-def geqrf(A: TileMatrix) -> tuple[TileMatrix, TileMatrix]:
+def geqrt_rec(a, hnb: int):
+    """Panel QR as an hnb-wide nested sweep (the recursive-QR panel
+    kernels, ref src/zgeqrfr_geqrt.jdf / zgeqrfr_tsqrt.jdf exposed to
+    drivers as -z/--HNB): sub-panels factor and apply within the
+    panel; T triangles merge into the full compact-WY factor by the
+    standard block formula T12 = -T1 (V1^H V2) T2.  Same (packed, V,
+    T) contract as hh.geqrt."""
+    m, nb = a.shape
+    if hnb <= 0 or hnb >= nb:
+        return hh.geqrt(a, rankfull=True)
+    V = T = None
+    packs, rrows, offs = [], [], []
+    rest = a
+    for j in range(0, nb, hnb):
+        wj = min(hnb, nb - j)
+        pk, vj, tj = hh.geqrt(rest[:, :wj], rankfull=True)
+        trail = rest[:, wj:]
+        if trail.shape[1]:
+            trail = hh.apply_q(vj, tj, trail, trans="C")
+        rrows.append(trail[:wj])      # R12 rows for later columns
+        packs.append(pk)
+        offs.append(j)
+        vfull = jnp.concatenate(
+            [jnp.zeros((j, wj), a.dtype), vj], axis=0) if j else vj
+        if V is None:
+            V, T = vfull, tj
+        else:
+            t12 = k.dot(-k.dot(T, V, tb=True, conj_b=True), vfull)
+            t12 = k.dot(t12, tj)
+            T = jnp.concatenate([
+                jnp.concatenate([T, t12], axis=1),
+                jnp.concatenate([jnp.zeros((wj, T.shape[0]), a.dtype),
+                                 tj], axis=1)], axis=0)
+            V = jnp.concatenate([V, vfull], axis=1)
+        rest = trail[wj:]
+    # stitch the packed panel: column block i carries the R12 slices of
+    # every earlier sub-step above its own (R diag + V below) pack
+    cols = []
+    for i, (pk, j) in enumerate(zip(packs, offs)):
+        wi = pk.shape[1]
+        tops = [rrows[t][:, j - offs[t] - rrows[t].shape[0]:
+                         j - offs[t] - rrows[t].shape[0] + wi]
+                for t in range(i)]
+        cols.append(jnp.concatenate(tops + [pk], axis=0))
+    packed = jnp.concatenate(cols, axis=1)
+    return packed, V, T
+
+
+def geqrf(A: TileMatrix, *, panel_kernel=None) -> tuple[TileMatrix,
+                                                        TileMatrix]:
     """A = Q R (dplasma_zgeqrf). Returns (packed factor, T factors).
 
     Right-looking sweep on a *shrinking* trailing window: panel k's
@@ -88,7 +137,9 @@ def geqrf(A: TileMatrix) -> tuple[TileMatrix, TileMatrix]:
         from dplasma_tpu.kernels import dd as _dd
 
     for kk in range(KT):
-        if use_dd:
+        if panel_kernel is not None:
+            packed, v, T = panel_kernel(rest[:, :nb])
+        elif use_dd:
             packed, v, T = _dd.geqrt_f64(rest[:, :nb])
         else:
             packed, v, T = hh.geqrt(rest[:, :nb], rankfull=True)
@@ -107,6 +158,16 @@ def geqrf(A: TileMatrix) -> tuple[TileMatrix, TileMatrix]:
         Td = jnp.pad(Td, ((0, 0), (0, Tm.desc.Np - Td.shape[1])))
     return (TileMatrix(pmesh.constrain2d(full), A.desc),
             TileMatrix(Td, Tm.desc))
+
+
+def geqrf_rec(A: TileMatrix, hnb: int = 0):
+    """Recursive-panel QR (dplasma_zgeqrf_rec, the -z/--HNB variant,
+    ref src/zgeqrfr_*.jdf nested taskpools): each nb-wide panel is
+    itself an hnb-wide nested sweep (:func:`geqrt_rec`), mirroring
+    ops.potrf.potrf_rec's diagonal-kernel pattern."""
+    if hnb <= 0 or hnb >= A.desc.nb:
+        return geqrf(A)
+    return geqrf(A, panel_kernel=lambda a: geqrt_rec(a, hnb))
 
 
 def _qr_panels(Af: TileMatrix, Tf: TileMatrix):

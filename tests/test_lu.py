@@ -203,3 +203,21 @@ def test_gerfs_refinement():
     r1 = np.abs(np.asarray(A0.to_dense() @ Xref.to_dense()
                            - B.to_dense())).max()
     assert r1 < 1e-6 * r0, (r0, r1)
+
+
+def test_getrf_rec_matches_1d(rng):
+    """Recursive-panel LU (-z/--HNB): nested hnb-wide panel sweeps
+    must keep the getrf_1d factorization contract."""
+    import numpy as np
+
+    N, nb, hnb = 128, 32, 8
+    a = rng.standard_normal((N, N))
+    A = TileMatrix.from_dense(jnp.asarray(a), nb, nb)
+    LU, perm = lu.getrf_rec(A, hnb)
+    x = np.asarray(LU.to_dense())
+    p = np.asarray(perm)
+    L = np.tril(x, -1) + np.eye(N)
+    U = np.triu(x)
+    resid = np.abs(a[p] - L @ U).max() / (
+        np.abs(a).max() * N * np.finfo(np.float32).eps)
+    assert resid < 60.0, resid

@@ -160,3 +160,20 @@ def test_stacked_qr_ts_tt_kernels():
     ref = hh.apply_q(v, t, jnp.concatenate([c1, c2], axis=0), trans="C")
     assert np.allclose(np.asarray(jnp.concatenate([o1, o2], axis=0)),
                        np.asarray(ref), atol=1e-12)
+
+
+def test_geqrf_rec_matches_flat(rng):
+    """Recursive-panel QR (-z/--HNB, ref zgeqrfr_*.jdf): same
+    factorization contract as the flat sweep — Q R reproduces A and
+    the packed/T layout drives unmqr identically."""
+    from dplasma_tpu.ops import checks
+
+    M, N, nb, hnb = 96, 96, 32, 8
+    A0 = generators.plrnt(M, N, nb, nb, seed=9, dtype=jnp.float32)
+    Af, Tf = qr.geqrf_rec(A0, hnb)
+    Q = qr.ungqr(Af, Tf).to_dense()
+    R = jnp.triu(Af.to_dense()[:N, :])
+    r, ok = checks.check_qr(A0, Q, R)
+    assert ok, r
+    ro, oko = checks.check_orthogonality(Q)
+    assert oko, ro
