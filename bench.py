@@ -220,11 +220,15 @@ def main():
     # produced physically impossible readings on the tunneled
     # transport. TPU path only — the CPU smoke path reuses peak32.
     if on_tpu:
+        # tight gates: a half-true bf16 reading slipped the old
+        # [0.5, 2.0] window in an r3 run and flattered the f64-equiv
+        # vs_baseline through the bound — the denominators must be at
+        # least as reliable as the numerators
         bf16_est = 6.0 * peak32
-        if not (0.5 * bf16_est <= bf16_peak <= 2.0 * bf16_est):
+        if not (0.75 * bf16_est <= bf16_peak <= 1.5 * bf16_est):
             bf16_peak = bf16_est
         i8_est = 2.0 * bf16_peak
-        if not (0.4 * i8_est <= i8_peak <= 1.5 * i8_est):
+        if not (0.6 * i8_est <= i8_peak <= 1.5 * i8_est):
             i8_peak = i8_est
     dd_bound = i8_peak / _dd_bound_products(dd_gemm_cfgs[0]["N"])
     run_entry("dgemm_f64equiv", bench_gemm, dd_gemm_cfgs, dd_bound,
