@@ -79,18 +79,23 @@ def _per_run_seconds(loop, lo: int, hi: int, reps: int = 3) -> float:
 
 
 def _op_loop(data, step, *extras):
-    """fori_loop harness: per-iteration diagonal perturbation (same DAG,
-    unhoistable), full-result consumption (no dead-code elimination).
-    ``extras`` are threaded through as jit ARGUMENTS — captured as
-    closure constants they get embedded in the compile payload (256 MB
-    at N=8192 f32: the tunneled compile service rejects the request)."""
-    diag = jnp.arange(min(data.shape))
+    """fori_loop harness: per-iteration FIRST-ROW scale perturbation —
+    unhoistable (a one-row change is not expressible as scalar*matrix,
+    so no algebraic rewrite can factor it out of the op; a whole-array
+    scalar scale WOULD commute out of the linear entries), SPD- and
+    conditioning-preserving, and one tiny row update (the earlier f64
+    diagonal scatter cost ~12 ms per iteration at N=8192 in X64-pair
+    splits, profiled r4).  Full-result consumption prevents dead-code
+    elimination.  ``extras`` are threaded through as jit ARGUMENTS —
+    captured as closure constants they get embedded in the compile
+    payload (256 MB at N=8192 f32: the tunneled compile service
+    rejects the request)."""
 
     @jax.jit
     def loop(k, d, *ex):
         def body(i, acc):
-            shift = (i.astype(jnp.float32) + 1.0) * 1e-6
-            a = d.at[diag, diag].add(shift.astype(d.dtype))
+            shift = 1.0 + (i.astype(jnp.float32) + 1.0) * 1e-7
+            a = d.at[:1].multiply(shift.astype(d.dtype))
             outs = step(a, *ex)
             return acc + sum(jnp.sum(jnp.real(o)).astype(jnp.float32)
                              for o in jax.tree_util.tree_leaves(outs))

@@ -121,10 +121,14 @@ def _limb_levels(al, bl, K: int, w: int, nl: int, kc: int,
     # limb it pairs with (j < nl - i): same flops as the 36 pair
     # products, ~4.5x fewer matmul HLOs — the unrolled blocked sweeps
     # were OOM-killing the AOT compile helper at 16 block columns.
+    # The concatenation is built ONCE; per-i operands are prefix
+    # slices of it (per-i concats cost ~28 dynamic-update-slice ops
+    # per product — profiled r4 as a top op-count line).
+    bfull = bl[0] if nl == 1 else jnp.concatenate(bl, axis=cat_ax)
     levels = [None] * nl
     for i in range(nl):
         nj = nl - i
-        bcat = bl[0] if nj == 1 else jnp.concatenate(bl[:nj], axis=cat_ax)
+        bcat = jax.lax.slice_in_dim(bfull, 0, nj * P, axis=cat_ax)
         p = jax.lax.dot_general(al[i], bcat, dn,
                                 preferred_element_type=jnp.int32)
         for j in range(nj):
@@ -139,12 +143,20 @@ def _limb_levels(al, bl, K: int, w: int, nl: int, kc: int,
     return levels
 
 
-def gemm_f64(a, b, bits: int = 53):
+def gemm_f64(a, b, bits: int = 53, _nonfinite_mask: bool = True):
     """C = A @ B with f64-equivalent accuracy from int8 MXU matmuls.
 
     ``a``, ``b`` are f64 (M, K) and (K, N). ``bits`` selects target
     mantissa (53 = full f64; 32 ~ f32x2 double-single at ~2.4x speed).
     Requires x64 mode: without it the f64 contract is silently broken.
+
+    Non-finite semantics: any NaN OR Inf operand entry poisons its
+    whole result row/column with NaN. This is coarser than native f64
+    GEMM (which would propagate signed Inf where no cancellation
+    occurs): the digit cast cannot represent Inf, and the row/col max
+    the mask derives from cannot distinguish which products overflow.
+    Callers that test for Inf specifically must pre-screen inputs
+    (ADVICE r3).
     """
     if not jax.config.jax_enable_x64:
         raise RuntimeError(
@@ -163,6 +175,11 @@ def gemm_f64(a, b, bits: int = 53):
     # entry must poison its result row/column as a real matmul would
     # (downstream INFO detection relies on NaNs surviving products).
     # The masks reuse the split's own row/col maxes — no extra pass.
+    # Internal IR callers (blocked potrf) skip the mask: their f32
+    # seeds/residuals already propagate NaNs, and the two where-passes
+    # per product are measurable on (N, nb) panels (profiled r4).
+    if not _nonfinite_mask:
+        return out
     return jnp.where(~jnp.isfinite(ma) | ~jnp.isfinite(mb),
                      jnp.nan, out)
 
@@ -376,28 +393,41 @@ def _split_fixed(x, scale, w: int, nl: int):
 
 def _split_fixed_ff(x, scale, w: int, nl: int):
     """Digit split for float-float f64 backends: u = x/scale splits
-    exactly into its native f32 hi/lo parts; each part runs the exact
-    f32 trunc recurrence (every step's product, trunc and remainder
-    are exact in f32 for |v| < 1), and the two digit streams add with
-    one integer carry pass into [-64, 63] (level 0 keeps its <= 66
-    headroom — carrying out of it would drop value).  On a true-f64
-    backend the lo part rounds to 24 bits, so this path is only
-    selected where f64 IS an f32 pair (precision there equals the
-    platform's own f64)."""
+    exactly into its native f32 hi/lo parts; each part is captured
+    EXACTLY in two int32 fixed-point words (i1 = trunc(v*2^28),
+    i2 = trunc((v*2^28 - i1)*2^28) — the pow2 products and the Dekker
+    remainder are exact f32 operations for |v| < 1, and a 24-bit f32
+    mantissa fits entirely in the 56 captured bits), then digits read
+    off by integer shifts.  The previous f32 trunc recurrence compiled
+    to ~2*nl unfusable select chains per split and dominated the
+    blocked-dd op budget (profiled r4); this form is a handful of
+    integer ops.  The two digit streams add with one integer carry
+    pass into [-64, 63] (level 0 keeps its <= 66 headroom — carrying
+    out of it would drop value).  On a true-f64 backend the lo part
+    rounds to 24 bits, so this path is only selected where f64 IS an
+    f32 pair (precision there equals the platform's own f64)."""
+    assert 56 % w == 0 and (28 // w) * w == 28, w
     u = x / scale                    # exact: power-of-two divide
     uh = u.astype(jnp.float32)
     ul = (u - uh.astype(jnp.float64)).astype(jnp.float32)
+    two28 = jnp.float32(2.0 ** 28)
 
-    def chain(v):
+    def digits(v):
+        # sign-magnitude: window shifts on the magnitude words match
+        # the trunc recurrence's toward-zero semantics (an arithmetic
+        # shift on a negative word would floor, breaking exactness)
+        i1f = jnp.trunc(v * two28)
+        i2 = jnp.abs(((v * two28 - i1f) * two28)).astype(jnp.int32)
+        i1 = jnp.abs(i1f).astype(jnp.int32)
+        sgn = jnp.where(v < 0, jnp.int32(-1), jnp.int32(1))
         ds = []
-        for _ in range(nl):
-            v = v * jnp.float32(2.0 ** w)
-            d = jnp.trunc(v)
-            v = v - d
-            ds.append(d.astype(jnp.int32))
+        for l in range(nl):
+            word, off = (i1, 28) if l < 28 // w else (i2, 56)
+            sh = off - w * (l + 1)
+            ds.append(sgn * ((word >> sh) & ((1 << w) - 1)))
         return ds
 
-    d = [a + b for a, b in zip(chain(uh), chain(ul))]
+    d = [a + b for a, b in zip(digits(uh), digits(ul))]
     half = 1 << (w - 1)
     out = [None] * nl
     for l in range(nl - 1, 0, -1):
@@ -417,16 +447,22 @@ def _pair_dot(al, bl, K: int, w: int, nl: int, kc: int):
 
 
 def _potrf_tile_ir(Akk, refine: int = 3, newton: int = 2,
-                   need_inverse: bool = True):
+                   need_inverse: bool = True,
+                   refine_bits=(32, 53, 53)):
     """Diagonal-tile Cholesky + inverse at f64 accuracy, limb-lean.
 
     f32 Cholesky seeds; each refinement step's only exact product is
     the residual E = A - L L^T (corrections ride f32 triangular solves
-    and matmuls — their error is second order).  The Newton inverse
-    keeps BOTH its residual and its apply exact, so the eps32*kappa
-    seed error squares per iteration ((eps32*kappa)^4 < eps64 for tile
-    condition up to ~2e3; library callers needing more headroom use
-    trtri_f64).  Returns (L, X ~= L^{-1}), lower, real f64.
+    and matmuls — their error is second order).  IR contracts the
+    factor error by ~eps32*kappa per step, so the FIRST residual may
+    ride the cheap bits=32 product (its 2^-32 noise floor is below the
+    seed error it corrects); later steps must be bits=53 or the
+    refinement stalls at kappa*2^-32 (``refine_bits`` ladder).  The
+    Newton inverse keeps BOTH its residual and its apply exact, so the
+    eps32*kappa seed error squares per iteration ((eps32*kappa)^4 <
+    eps64 for tile condition up to ~2e3; library callers needing more
+    headroom use trtri_f64).  Returns (L, X ~= L^{-1}), lower, real
+    f64.
     """
     n = Akk.shape[0]
     Af = jnp.tril(Akk) + jnp.tril(Akk, -1).T
@@ -440,8 +476,9 @@ def _potrf_tile_ir(Akk, refine: int = 3, newton: int = 2,
     L = jax.lax.linalg.cholesky(
         Af.astype(jnp.float32), symmetrize_input=False)
     L = jnp.tril(L).astype(jnp.float64)
-    for _ in range(refine):
-        E = Af - gemm_f64(L, L.T)
+    for r in range(refine):
+        bits = refine_bits[min(r, len(refine_bits) - 1)]
+        E = Af - gemm_f64(L, L.T, bits=bits, _nonfinite_mask=False)
         L32 = jnp.tril(L).astype(jnp.float32)
         Y = jax.lax.linalg.triangular_solve(
             L32, E.astype(jnp.float32), left_side=True, lower=True)
@@ -450,8 +487,8 @@ def _potrf_tile_ir(Akk, refine: int = 3, newton: int = 2,
         phi = jnp.tril(M, -1) + 0.5 * jnp.diag(jnp.diag(M))
         corr = jnp.matmul(L32, phi, preferred_element_type=jnp.float32)
         L = jnp.tril(L + corr.astype(jnp.float64))
-    if not need_inverse:   # last block column / single tile: the
-        return L * d[:, None], None   # panel solve never happens
+    if not need_inverse:   # panel rides the trsm-IR path instead
+        return L * d[:, None], None
     eye = jnp.eye(n, dtype=jnp.float64)
     X = jax.lax.linalg.triangular_solve(
         L.astype(jnp.float32), jnp.eye(n, dtype=jnp.float32),
@@ -460,6 +497,31 @@ def _potrf_tile_ir(Akk, refine: int = 3, newton: int = 2,
         R = eye - gemm_f64(L, X)
         X = jnp.tril(X + gemm_f64(X, R))
     return L * d[:, None], X / d[None, :]
+
+
+def _panel_trsm_ir(Lkk, slab, iters: int = 2):
+    """Panel solve pan @ Lkk^T = slab at f64-equivalent accuracy via
+    f32 right-trsm + exact-residual iterative refinement.
+
+    Replaces the Newton-inverse panel path (X build = ~4 exact nb^3
+    products + masks per column; profiled r4: the op-count, not the
+    flops, dominated the blocked dd POTRF).  Here each IR step costs
+    ONE exact (m, nb, nb) limb product and one f32 trsm; the factor
+    error contracts by ~eps32*kappa(Lkk) per step, so 2 steps from the
+    f32 seed reach the kappa*eps64 floor for tile condition to ~1e7.
+    """
+    f32 = jnp.float32
+    L32 = jnp.tril(Lkk).astype(f32)
+
+    def rtrsm(b):
+        return jax.lax.linalg.triangular_solve(
+            L32, b, left_side=False, lower=True, transpose_a=True)
+
+    pan = rtrsm(slab.astype(f32)).astype(jnp.float64)
+    for _ in range(iters):
+        E = slab - gemm_f64(pan, Lkk.T, _nonfinite_mask=False)
+        pan = pan + rtrsm(E.astype(f32)).astype(jnp.float64)
+    return pan
 
 
 def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
@@ -494,31 +556,34 @@ def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
         return _potrf_tile_ir(A, refine=refine, need_inverse=False)[0]
     w, nl, kc = _plan(N, 53)
     scale = _row_norm_scales(jnp.diag(A))[:, None]
-    W = None        # cached limbs of the finished factor, each (N, s)
+    # preallocated stacked limb cache (nl, N, N-nb): column blocks are
+    # written in place by dynamic_update_slice — a growing concat
+    # re-copies the whole cache every step (~4 GB of traffic at
+    # N=8192, profiled r4)
+    W = jnp.zeros((nl, N, N - nb), jnp.int8)
     cols = []
     for k in range(nt):
         s = k * nb
         slab = A[s:, s:s + nb]
         if k:
-            U = _pair_dot([x[s:] for x in W], [x[s:s + nb] for x in W],
+            U = _pair_dot([W[i, s:, :s] for i in range(nl)],
+                          [W[i, s:s + nb, :s] for i in range(nl)],
                           K=s, w=w, nl=nl, kc=kc)
             slab = slab - U * (scale[s:] * scale[s:s + nb].T)
-        Lkk, X = _potrf_tile_ir(slab[:nb], refine=refine,
-                                need_inverse=(s + nb < N))
+        Lkk, _ = _potrf_tile_ir(slab[:nb], refine=refine,
+                                need_inverse=False)
         if s + nb < N:
-            pan = gemm_f64(slab[nb:], X.T)
+            # trsm + exact-residual IR replaces the Newton-inverse
+            # panel (3x fewer exact nb^3 products per column; the op
+            # count, not the flops, bounded the r3 sweep)
+            pan = _panel_trsm_ir(Lkk, slab[nb:])
             colL = jnp.concatenate([Lkk, pan], axis=0)
         else:
             colL = Lkk
         cols.append(colL)
         if k + 1 < nt:
-            limbs = _split_fixed(colL, scale[s:], w, nl)
-            limbs = [jnp.concatenate(
-                [jnp.zeros((s, nb), jnp.int8), x], axis=0)
-                for x in limbs]
-            W = limbs if W is None else [
-                jnp.concatenate([wl, x], axis=1)
-                for wl, x in zip(W, limbs)]
+            limbs = jnp.stack(_split_fixed(colL, scale[s:], w, nl))
+            W = jax.lax.dynamic_update_slice(W, limbs, (0, s, s))
     out = [jnp.concatenate(
         [jnp.zeros((j * nb, nb), jnp.float64), c], axis=0)
         for j, c in enumerate(cols)]

@@ -29,10 +29,32 @@ _NP_DTYPE = {"d": np.float64, "f": np.float32}
 call_counts: dict = {}
 
 
-def _view(addr: int, desc, dtype) -> np.ndarray:
-    """Zero-copy column-major view of the caller's local array."""
+def _numroc(n: int, nb: int, iproc: int, isrc: int, nprocs: int) -> int:
+    """ScaLAPACK NUMROC: local row/col count of a cyclic distribution."""
+    mydist = (nprocs + iproc - isrc) % nprocs
+    nblocks = n // nb
+    out = (nblocks // nprocs) * nb
+    extra = nblocks % nprocs
+    if mydist < extra:
+        out += nb
+    elif mydist == extra:
+        out += n % nb
+    return out
+
+
+def _view(addr: int, desc, dtype, grid=None, rank=None) -> np.ndarray:
+    """Zero-copy column-major view of the caller's local array.
+
+    Single-process grids own every column, so the descriptor's global N
+    is the local width. On a multirank grid the local buffer only holds
+    ~N/Q columns — the view must be numroc-sized or it spans past the
+    caller's allocation (ADVICE r3)."""
     lld = max(int(desc[_LLD]), 1)
-    ncols = max(int(desc[_N]), 1)
+    if grid is None:
+        ncols = max(int(desc[_N]), 1)
+    else:
+        ncols = max(_numroc(int(desc[_N]), int(desc[_NB]), rank[1],
+                            int(desc[_CSRC]), grid[1]), 1)
     n_items = lld * ncols
     buf = (ctypes.c_byte * (n_items * np.dtype(dtype).itemsize)) \
         .from_address(addr)
@@ -140,11 +162,12 @@ def _each_block(M, N, MB, NB, rsrc, csrc, P, Q):
 def _assemble_scatter(pend, ai, di, P, Q, dt, g=None):
     """g=None: assemble the global array from every rank's local cyclic
     piece; else scatter g back into the ranks' buffers."""
-    d0 = pend[(0, 0)][di]
+    d0 = next(iter(pend.values()))[di]
     M, N = int(d0[_M]), int(d0[_N])
     MB, NB = int(d0[_MB]), int(d0[_NB])
     rsrc, csrc = int(d0[_RSRC]), int(d0[_CSRC])
-    views = {r: _view(pend[r][ai], pend[r][di], dt) for r in pend}
+    views = {r: _view(pend[r][ai], pend[r][di], dt, grid=(P, Q), rank=r)
+             for r in pend}
     out = np.zeros((M, N), dt, order="F") if g is None else None
     for rs, cs, owner, lrs, lcs in _each_block(M, N, MB, NB,
                                                rsrc, csrc, P, Q):
@@ -155,25 +178,56 @@ def _assemble_scatter(pend, ai, di, P, Q, dt, g=None):
     return out
 
 
+def _find_ctxt(args):
+    """Context of the first BLACS descriptor among the args (descriptors
+    arrive as 9+ element tuples)."""
+    for a in args:
+        if isinstance(a, (tuple, list)) and len(a) >= 9:
+            return int(a[_CTXT])
+    return None
+
+
 def _multirank(name: str, args):
     """Collect SPMD calls on a registered multi-rank grid; run the op
     on the assembled global matrix when the last rank enters. Returns
     None when the call is single-process."""
     spec = _BUF_SPEC.get(name)
     if not spec:
+        # an op this shim cannot run collectively, issued on a live
+        # multi-rank grid, must fail loudly (xerbla-style): the
+        # single-process handler would factor one rank's LOCAL piece
+        # as if it were the global matrix and report success (ADVICE
+        # r3 medium)
+        ctxt = _find_ctxt(args)
+        if ctxt is not None and ctxt in _GRIDS:
+            P, Q = _GRIDS[ctxt]
+            if P * Q > 1:
+                _LAST_INFO[ctxt] = -9996
+                return -9996
         return None
     ctxt = int(args[spec[0][1]][_CTXT])
     P, Q = _GRIDS.get(ctxt, (1, 1))
     if (P, Q) == (1, 1):
         return None
     rank = _CUR_RANK.get(ctxt, (0, 0))
-    pend = _PENDING.setdefault((ctxt, name), {})
-    pend[rank] = args
-    if len(pend) < P * Q:
+    # per-rank FIFO queues: a rank may legitimately run ahead and issue
+    # its NEXT same-op collective before slower ranks enter the current
+    # one (deferred calls return 0) — plain per-rank slots would either
+    # drop the first call's args or mis-pair the rounds (ADVICE r3
+    # medium); queues pair round n with round n across all ranks
+    queues = _PENDING.setdefault((ctxt, name), {})
+    queues.setdefault(rank, []).append(args)
+    if len(queues) < P * Q:
         return 0           # deferred until the collective is complete
-    del _PENDING[(ctxt, name)]
+    pend = {r: q[0] for r, q in queues.items()}
+    for r in list(queues):
+        queues[r].pop(0)
+        if not queues[r]:
+            del queues[r]
+    if not queues:
+        del _PENDING[(ctxt, name)]
     dt = _NP_DTYPE[_prec_of(args)]
-    newargs = list(pend[(0, 0)])
+    newargs = list(next(iter(pend.values())))
     keep = []
     for ai, di, wb in spec:
         g = _assemble_scatter(pend, ai, di, P, Q, dt)
