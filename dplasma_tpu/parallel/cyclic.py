@@ -893,12 +893,14 @@ def gemm_cyclic(A: CyclicMatrix, B: CyclicMatrix) -> CyclicMatrix:
                                         B.desc.nb, A.desc.dist))
 
 
-@partial(jax.jit, static_argnums=(1, 2))
-def _herk_cyclic_jit(adata, desc, mesh):
-    """Distributed C = A A^H (lower triangle) over cyclic slabs — the
-    POTRF trailing-update collectives (panel bcast along 'q',
-    all_gather row formation along 'p') as a standalone rank-k sweep
-    (ref src/zherk_LN.jdf)."""
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def _herk_cyclic_jit(adata, desc, cdesc, mesh):
+    """Distributed C = A A^H (lower triangle, C M x M) over cyclic
+    slabs — the POTRF trailing-update collectives (panel bcast along
+    'q', all_gather row formation along 'p') as a standalone rank-k
+    sweep (ref src/zherk_LN.jdf). ``A`` may be rectangular: C's
+    columns follow the M x M descriptor ``cdesc``, not A's column
+    tiling (review r4)."""
     from dplasma_tpu.kernels import blas as kb
 
     d = desc.dist
@@ -906,6 +908,7 @@ def _herk_cyclic_jit(adata, desc, mesh):
     mb = desc.mb
     mloc = desc.MTL * mb
     nloc = desc.NTL * desc.nb
+    ncloc = cdesc.NTL * cdesc.nb
     cplx = jnp.iscomplexobj(adata)
 
     def ct(x):
@@ -915,8 +918,12 @@ def _herk_cyclic_jit(adata, desc, mesh):
         A = aloc.reshape(mloc, nloc)
         p = jax.lax.axis_index(pmesh.ROW_AXIS)
         q = jax.lax.axis_index(pmesh.COL_AXIS)
-        grow, gcol, gid, gcid = _slab_coords(desc, p, q)
-        C = jnp.zeros((mloc, nloc), A.dtype)
+        grow, _, gid, _ = _slab_coords(desc, p, q)
+        # C's column coordinates ride the M x M descriptor
+        gcol_c = _grow(cdesc.NTL, cdesc.nb, q, Q, d.kq, d.jq)
+        gcid_c = (gcol_c * cdesc.nb
+                  + jnp.arange(ncloc) % cdesc.nb)
+        C = jnp.zeros((mloc, ncloc), A.dtype)
         for k in range(desc.NT):
             qk = layout.owner(k, Q, d.kq, d.jq)
             lck = layout.local_index(k, Q, d.kq)
@@ -925,18 +932,20 @@ def _herk_cyclic_jit(adata, desc, mesh):
             acol = jax.lax.psum(
                 jnp.where(q == qk, acol, jnp.zeros_like(acol)),
                 pmesh.COL_AXIS)
-            # row formation: A(j, k)^H for my local columns j — the
+            # row formation: A(j, k)^H for my local C columns j — the
             # all_gather + cyclic pick of the POTRF trailing update
             allg = jax.lax.all_gather(acol, pmesh.ROW_AXIS)
             allg = allg.reshape(P * mloc, desc.nb)
-            jt = gcol
+            jt = gcol_c
             pj = (jt // d.kp + d.ip) % P
             lj = (jt // (d.kp * P)) * d.kp + jt % d.kp
-            idx = pj * mloc + lj * mb + jnp.arange(nloc) % mb
-            W = allg[idx]                              # (nloc, nb)
+            idx = pj * mloc + lj * mb + jnp.arange(ncloc) % cdesc.nb
+            valid = (jt < desc.MT)[:, None]
+            W = jnp.where(valid, allg[jnp.clip(idx, 0, P * mloc - 1)],
+                          0)                           # (ncloc, nb)
             C = C + kb.dot(acol, ct(W))
-        lower = (gid[:, None] >= gcid[None, :])
-        return jnp.where(lower, C, 0).reshape(1, 1, mloc, nloc)
+        lower = (gid[:, None] >= gcid_c[None, :])
+        return jnp.where(lower, C, 0).reshape(1, 1, mloc, ncloc)
 
     f = shard_map(
         body, mesh=mesh,
@@ -948,13 +957,14 @@ def _herk_cyclic_jit(adata, desc, mesh):
 
 
 def herk_cyclic(A: CyclicMatrix) -> CyclicMatrix:
-    """Distributed C = A A^H (lower stored) on block-cyclic local
-    storage. Square tiles."""
+    """Distributed C = A A^H (lower stored, M x M) on block-cyclic
+    local storage. Square tiles; A may be rectangular."""
     m = _mesh_of(A)
     assert A.desc.mb == A.desc.nb, "herk_cyclic needs square tiles"
-    out = _herk_cyclic_jit(A.data, A.desc, m)
-    return CyclicMatrix(out, CyclicDesc(A.desc.M, A.desc.M, A.desc.mb,
-                                        A.desc.mb, A.desc.dist))
+    cdesc = CyclicDesc(A.desc.M, A.desc.M, A.desc.mb, A.desc.mb,
+                       A.desc.dist)
+    out = _herk_cyclic_jit(A.data, A.desc, cdesc, m)
+    return CyclicMatrix(out, cdesc)
 
 
 @partial(jax.jit, static_argnums=(2, 3))

@@ -82,8 +82,9 @@ def test_halving_sweep_plus_chase_handoff():
     A0 = generators.plghe(0.0, N, nb, seed=9, dtype=jnp.float64)
     Bm, _, _ = eig.herbt(A0, "L")
     bw = 2 * nb - 1
-    d1, e1 = eig.hbrdt(Bm, bw)                 # chase-only (cut=64)
-    d2, e2 = eig.hbrdt(Bm, bw, chase_cut=8)    # halving sweeps + chase
+    d1, e1 = eig.hbrdt(Bm, bw, method="chase")   # chase-only (cut=64)
+    # SBR sweeps down to the chase window, then the Givens chase
+    d2, e2 = eig.hbrdt(Bm, bw, chase_cut=8, method="chase")
     t1 = np.diag(np.asarray(d1)) + np.diag(np.asarray(e1), 1) + \
         np.diag(np.asarray(e1), -1)
     t2 = np.diag(np.asarray(d2)) + np.diag(np.asarray(e2), 1) + \
@@ -98,8 +99,9 @@ def test_gebrd_halving_regime():
     from dplasma_tpu.ops import eig, generators
     M, N, nb = 32, 28, 8
     A0 = generators.plrnt(M, N, nb, nb, seed=4, dtype=jnp.float64)
-    d1, e1 = eig.gebrd(A0)                 # chase-only
-    d2, e2 = eig.gebrd(A0, chase_cut=4)    # TWO halving sweeps + chase
+    d1, e1 = eig.gebrd(A0, method="chase")   # chase-only
+    # halving sweeps + chase (the legacy stage-2 pipeline)
+    d2, e2 = eig.gebrd(A0, chase_cut=4, method="chase")
     ref = np.linalg.svd(np.asarray(A0.to_dense()), compute_uv=False)
     for d, e in ((d1, e1), (d2, e2)):
         K = min(M, N)
@@ -117,3 +119,60 @@ def test_lartg_zero_cases():
     c, s = band._lartg(zero, zero)  # identity
     assert np.isclose(float(jnp.real(c)), 1.0)
     assert np.isclose(abs(complex(s)), 0.0)
+
+
+def _rand_herm_band(N, b, seed=1, cplx=False):
+    rng = np.random.default_rng(seed)
+    X = np.zeros((N, N), np.complex128 if cplx else np.float64)
+    for k in range(min(b, N - 1) + 1):
+        v = rng.standard_normal(N - k)
+        if cplx and k:
+            v = v + 1j * rng.standard_normal(N - k)
+        X += np.diag(v, -k)
+    return np.tril(X, -1) + np.tril(X, -1).conj().T + \
+        np.diag(np.real(np.diagonal(X)))
+
+
+@pytest.mark.parametrize("N,b", [(96, 32), (130, 17), (64, 63)])
+def test_herm_sbr_scan_exact(N, b):
+    """Pipelined SBR band->tridiag preserves eigenvalues exactly
+    (f64): the multi-bulge stage-2 replacement (ref zhbrdt.jdf role)."""
+    X = _rand_herm_band(N, b)
+    w_ref = np.linalg.eigvalsh(X)
+    d, e = band.herm_band_to_tridiag_scan(jnp.asarray(X), N, b)
+    t = np.diag(np.asarray(d)) + np.diag(np.asarray(e), -1) + \
+        np.diag(np.asarray(e), 1)
+    assert np.allclose(np.linalg.eigvalsh(t), w_ref, atol=1e-11 * N)
+
+
+def test_herm_sbr_scan_complex():
+    N, b = 80, 24
+    X = _rand_herm_band(N, b, seed=2, cplx=True)
+    w_ref = np.linalg.eigvalsh(X)
+    d, e = band.herm_band_to_tridiag_scan(jnp.asarray(X), N, b)
+    t = np.diag(np.asarray(d)) + np.diag(np.asarray(e), -1) + \
+        np.diag(np.asarray(e), 1)
+    assert np.allclose(np.linalg.eigvalsh(t), w_ref, atol=1e-11 * N)
+
+
+@pytest.mark.parametrize("M,N,b", [
+    (96, 96, 24), (128, 96, 17),
+    (48, 64, 31),   # wide with b > N-M: tail panels (r4 regression)
+    (32, 96, 31),
+])
+def test_bidiag_sbr_scan_exact(M, N, b):
+    rng = np.random.default_rng(3)
+    X = np.zeros((M, N))
+    for k in range(b + 1):
+        for r in range(M):
+            if r + k < N:
+                X[r, r + k] = rng.standard_normal()
+    s_ref = np.linalg.svd(X, compute_uv=False)
+    d, e = band.bidiag_band_to_bidiag_scan(jnp.asarray(X), M, N, b)
+    K = min(M, N)
+    d, e = np.asarray(d), np.asarray(e)
+    B = np.zeros((K, K + (1 if M < N else 0)))
+    B[np.arange(K), np.arange(K)] = d
+    B[np.arange(len(e)), np.arange(len(e)) + 1] = e
+    sv = np.sort(np.linalg.svd(B, compute_uv=False))[::-1][:K]
+    assert np.allclose(sv, s_ref[:K], atol=1e-10 * max(M, N))

@@ -363,3 +363,22 @@ def test_getrs_cyclic_solves_in_slabs(devices8):
     r, ok = checks.check_axmb(A, B, TileMatrix(
         X.data[:, :B.data.shape[1]], B.desc))
     assert ok, r
+
+
+def test_herk_cyclic_rectangular(devices8):
+    """C = A A^H for rectangular A: C follows the M x M descriptor,
+    not A's column tiling (review r4)."""
+    dist = Dist(P=2, Q=4, kp=1, kq=2)
+    mb = 8
+    M, K = 48, 16
+    rng = np.random.default_rng(11)
+    a = rng.standard_normal((M, K))
+    At = TileMatrix.from_dense(jnp.asarray(a), mb, mb, dist)
+    m = mesh.make_mesh(dist.P, dist.Q)
+    with mesh.use_grid(m):
+        Ac = cyclic.CyclicMatrix.from_tile(At, dist)
+        Hc = cyclic.herk_cyclic(Ac)
+        assert Hc.desc.M == Hc.desc.N == M
+        goth = np.asarray(Hc.to_tile().data)[:M, :M]
+        np.testing.assert_allclose(np.tril(goth), np.tril(a @ a.T),
+                                   rtol=1e-10, atol=1e-8)
