@@ -202,16 +202,24 @@ def herm_sbr_sweep(X, N: int, b: int, w: int):
         return lax.dynamic_update_slice(win, cols,
                                         (jnp.zeros_like(u), u))
 
-    rowsV = jnp.arange(V)
-
     def step(Xp, tc):
         c0, u = tc
-        wins = jax.vmap(
-            lambda c: lax.dynamic_slice(Xp, (c, c), (V, V)))(c0)
+
+        def gat(g, buf):
+            w_ = lax.dynamic_slice(Xp, (c0[g], c0[g]), (V, V))
+            return lax.dynamic_update_slice(buf, w_[None], (g, 0, 0))
+
+        wins = lax.fori_loop(
+            0, G, gat, jnp.zeros((G, V, V), Xp.dtype))
         wins = jax.vmap(one)(wins, u)
-        ridx = c0[:, None] + rowsV[None, :]              # (G, V)
-        return Xp.at[ridx[:, :, None], ridx[:, None, :]].set(
-            wins, mode="promise_in_bounds", unique_indices=True), None
+        # windows are pairwise disjoint: G sequential native
+        # dynamic_update_slices beat a general 2-D scatter by 4-40x on
+        # the tunneled chip (measured r4)
+        def sca(g, x):
+            return lax.dynamic_update_slice(x, wins[g],
+                                            (c0[g], c0[g]))
+
+        return lax.fori_loop(0, G, sca, Xp), None
 
     Xp, _ = lax.scan(step, Xp, (jnp.asarray(c0s), jnp.asarray(us)))
     return Xp
@@ -298,17 +306,23 @@ def bidiag_sbr_sweep(X, M: int, N: int, b: int, w: int):
         return lax.dynamic_update_slice(win, cols,
                                         (jnp.zeros_like(off), off))
 
-    rowsV = jnp.arange(V)
-
     def step(Xp, tc):
         c0, u, off, is_qr = tc
-        wins = jax.vmap(
-            lambda c: lax.dynamic_slice(Xp, (c, c), (V, V)))(c0)
+
+        def gat(g, buf):
+            w_ = lax.dynamic_slice(Xp, (c0[g], c0[g]), (V, V))
+            return lax.dynamic_update_slice(buf, w_[None], (g, 0, 0))
+
+        wins = lax.fori_loop(
+            0, G, gat, jnp.zeros((G, V, V), Xp.dtype))
         wins = lax.cond(is_qr, jax.vmap(qr_one), jax.vmap(lq_one),
                         wins, u, off)
-        ridx = c0[:, None] + rowsV[None, :]
-        return Xp.at[ridx[:, :, None], ridx[:, None, :]].set(
-            wins, mode="promise_in_bounds", unique_indices=True), None
+
+        def sca(g, x):
+            return lax.dynamic_update_slice(x, wins[g],
+                                            (c0[g], c0[g]))
+
+        return lax.fori_loop(0, G, sca, Xp), None
 
     kinds = jnp.asarray((np.arange(T) % 2) == 1)
     Xp, _ = lax.scan(step, Xp,

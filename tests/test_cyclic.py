@@ -382,3 +382,28 @@ def test_herk_cyclic_rectangular(devices8):
         goth = np.asarray(Hc.to_tile().data)[:M, :M]
         np.testing.assert_allclose(np.tril(goth), np.tril(a @ a.T),
                                    rtol=1e-10, atol=1e-8)
+
+
+def test_herbt_heev_cyclic(devices8):
+    """Distributed heev chain (BASELINE config #5): herbt on cyclic
+    slabs preserves eigenvalues and leaves the mb-band; heev_cyclic
+    matches the dense eigensolver (ref src/zheev_wrapper.c:96-103)."""
+    from dplasma_tpu.ops.norms import _sym_full
+    dist = Dist(P=2, Q=4, kp=2, kq=2)
+    N, mb = 96, 8
+    A0 = generators.plghe(float(N), N, mb, seed=17, dtype=jnp.float64,
+                          dist=dist)
+    full = _sym_full(A0, "L", conj=True)
+    At = TileMatrix.from_dense(full, mb, mb, dist)
+    m = mesh.make_mesh(dist.P, dist.Q)
+    with mesh.use_grid(m):
+        Ac = cyclic.CyclicMatrix.from_tile(At, dist)
+        Bc = cyclic.herbt_cyclic(Ac)
+        B = np.asarray(Bc.to_tile().to_dense())
+        w_ref = np.linalg.eigvalsh(np.asarray(full))
+        for dd_ in range(mb + 1, N):
+            assert np.abs(np.diagonal(B, -dd_)).max() < 1e-10
+        assert np.max(np.abs(np.linalg.eigvalsh(B) - w_ref)) < 1e-10 * N
+        w = np.asarray(cyclic.heev_cyclic(Ac))
+        assert np.max(np.abs(w - w_ref)) / np.max(np.abs(w_ref)) \
+            < 1e-12 * N
