@@ -637,7 +637,6 @@ def _geqrf_cyclic_jit(data, desc: CyclicDesc, mesh):
     Returns (local factor slabs, Ts (KT, mb, mb) replicated).
     """
     from dplasma_tpu.kernels import blas as kb
-    from dplasma_tpu.kernels import householder as hh
 
     d = desc.dist
     P, Q = d.P, d.Q
@@ -732,7 +731,6 @@ def _herbt_cyclic_jit(data, desc: CyclicDesc, mesh):
     stored (full Hermitian slabs); leaves the bandwidth-mb band, both
     triangles, V/T discarded (jobz=N — eigenvalues only)."""
     from dplasma_tpu.kernels import blas as kb
-    from dplasma_tpu.kernels import householder as hh
 
     d = desc.dist
     P, Q = d.P, d.Q
