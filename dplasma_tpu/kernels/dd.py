@@ -476,15 +476,22 @@ def _potrf_tile_ir(Akk, refine: int = 3, newton: int = 2,
     Af = Af / (d[:, None] * d[None, :])
     L = jax.lax.linalg.cholesky(
         Af.astype(jnp.float32), symmetrize_input=False)
+    # ONE f32 inverse up front; the IR rounds then run on MXU matmuls
+    # only (triangular_solve custom calls measured ~1.5 TF/s on wide
+    # rhs, a top line of the blocked-dd budget — profiled r4). X's
+    # eps32*kappa error perturbs the correction at second order only.
+    X32 = jax.lax.linalg.triangular_solve(
+        jnp.tril(L), jnp.eye(n, dtype=jnp.float32), left_side=True,
+        lower=True)
     L = jnp.tril(L).astype(jnp.float64)
+    f32 = jnp.float32
     for r in range(refine):
         bits = refine_bits[min(r, len(refine_bits) - 1)]
         E = Af - gemm_f64(L, L.T, bits=bits, _nonfinite_mask=False)
-        L32 = jnp.tril(L).astype(jnp.float32)
-        Y = jax.lax.linalg.triangular_solve(
-            L32, E.astype(jnp.float32), left_side=True, lower=True)
-        M = jax.lax.linalg.triangular_solve(
-            L32, Y.T, left_side=True, lower=True).T
+        L32 = jnp.tril(L).astype(f32)
+        Y = jnp.matmul(X32, E.astype(f32),
+                       preferred_element_type=f32)
+        M = jnp.matmul(Y, X32.T, preferred_element_type=f32)
         phi = jnp.tril(M, -1) + 0.5 * jnp.diag(jnp.diag(M))
         corr = jnp.matmul(L32, phi, preferred_element_type=jnp.float32)
         L = jnp.tril(L + corr.astype(jnp.float64))
@@ -502,26 +509,34 @@ def _potrf_tile_ir(Akk, refine: int = 3, newton: int = 2,
 
 def _panel_trsm_ir(Lkk, slab, iters: int = 2):
     """Panel solve pan @ Lkk^T = slab at f64-equivalent accuracy via
-    f32 right-trsm + exact-residual iterative refinement.
+    multiply-by-f32-inverse + exact-residual iterative refinement.
 
-    Replaces the Newton-inverse panel path (X build = ~4 exact nb^3
-    products + masks per column; profiled r4: the op-count, not the
-    flops, dominated the blocked dd POTRF).  Here each IR step costs
-    ONE exact (m, nb, nb) limb product and one f32 trsm; the factor
-    error contracts by ~eps32*kappa(Lkk) per step, so 2 steps from the
-    f32 seed reach the kappa*eps64 floor for tile condition to ~1e7.
+    Each IR step costs ONE exact (m, nb, nb) limb product and one f32
+    MXU matmul by the tile inverse (a wide-rhs triangular_solve custom
+    call measured ~1.5 TF/s vs ~25 TF/s for the matmul — profiled r4;
+    the inverse's own eps32*kappa error perturbs corrections at second
+    order only).  The factor error contracts by ~eps32*kappa(Lkk) per
+    step, so 2 steps from the f32 seed reach the kappa*eps64 floor for
+    tile condition to ~1e7.
     """
     f32 = jnp.float32
     L32 = jnp.tril(Lkk).astype(f32)
+    Xt = jax.lax.linalg.triangular_solve(
+        L32, jnp.eye(L32.shape[0], dtype=f32), left_side=True,
+        lower=True).T                     # L^{-T}, f32
 
-    def rtrsm(b):
-        return jax.lax.linalg.triangular_solve(
-            L32, b, left_side=False, lower=True, transpose_a=True)
+    def rsolve(b):
+        return jnp.matmul(b, Xt, preferred_element_type=f32)
 
-    pan = rtrsm(slab.astype(f32)).astype(jnp.float64)
-    for _ in range(iters):
-        E = slab - gemm_f64(pan, Lkk.T, _nonfinite_mask=False)
-        pan = pan + rtrsm(E.astype(f32)).astype(jnp.float64)
+    pan = rsolve(slab.astype(f32)).astype(jnp.float64)
+    for it in range(iters):
+        # first residual rides the cheap bits=32 product: its 2^-32
+        # noise floor sits below the eps32 seed error it corrects
+        # (the same ladder argument as _potrf_tile_ir's refine_bits)
+        bits = 32 if it == 0 and iters > 1 else 53
+        E = slab - gemm_f64(pan, Lkk.T, bits=bits,
+                            _nonfinite_mask=False)
+        pan = pan + rsolve(E.astype(f32)).astype(jnp.float64)
     return pan
 
 
@@ -622,7 +637,7 @@ def _potrf_f64_blocked_cached(A, nb: int, refine: int):
 
 
 def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
-                      refine: int = 3):
+                      refine: int = 2):
     """Blocked left-looking Cholesky at f64-equivalent accuracy.
 
     Step k updates block column k with ONE chunked limb product against
