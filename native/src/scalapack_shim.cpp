@@ -314,6 +314,153 @@ int dplasma_blacs_last_info_(const int* ctxt) {
   return dispatch("blacs_last_info", args);
 }
 
+// ------------------------------------------- dplasma_* F77 twin set
+// The reference generates F77 twins of the wrapper API
+// (src/dplasma_zf77.c:1-229: dplasma_zpotrf_f77 etc. on parsec
+// descriptors) so Fortran applications can call it directly. The
+// TPU-native twin takes plain column-major LAPACK arrays (the natural
+// F77 surface when no parsec handle type exists) and routes through
+// the same dispatch as the ScaLAPACK ABI with a fabricated
+// single-process descriptor: desc = {1, -1(ctxt), m, n, 512, 512,
+// 0, 0, lda}. Same handlers, same INFO contracts.
+namespace {
+inline void lapack_desc(int* d, int m, int n, int lda) {
+  d[0] = 1; d[1] = -1; d[2] = m; d[3] = n; d[4] = 512; d[5] = 512;
+  d[6] = 0; d[7] = 0; d[8] = lda;
+}
+}  // namespace
+
+#define DEF_F77_POTRF_LIKE(op)                                             \
+  void dplasma_d##op##_(const char* uplo, const int* n, double* a,         \
+                        const int* lda, int* info) {                       \
+    int d[9], one = 1;                                                     \
+    lapack_desc(d, *n, *n, *lda);                                          \
+    pd##op##_(uplo, n, a, &one, &one, d, info);                            \
+  }                                                                        \
+  void dplasma_s##op##_(const char* uplo, const int* n, float* a,          \
+                        const int* lda, int* info) {                       \
+    int d[9], one = 1;                                                     \
+    lapack_desc(d, *n, *n, *lda);                                          \
+    ps##op##_(uplo, n, a, &one, &one, d, info);                            \
+  }
+
+DEF_F77_POTRF_LIKE(potrf)
+DEF_F77_POTRF_LIKE(potri)
+
+void dplasma_dtrtri_(const char* uplo, const char* diag, const int* n,
+                     double* a, const int* lda, int* info) {
+  int d[9], one = 1;
+  lapack_desc(d, *n, *n, *lda);
+  pdtrtri_(uplo, diag, n, a, &one, &one, d, info);
+}
+void dplasma_strtri_(const char* uplo, const char* diag, const int* n,
+                     float* a, const int* lda, int* info) {
+  int d[9], one = 1;
+  lapack_desc(d, *n, *n, *lda);
+  pstrtri_(uplo, diag, n, a, &one, &one, d, info);
+}
+
+#define DEF_F77_GEMM(pfx, ppfx, T)                                         \
+  void pfx##gemm_(const char* transa, const char* transb, const int* m,    \
+                  const int* n, const int* k, const T* alpha, T* a,        \
+                  const int* lda, T* b, const int* ldb, const T* beta,     \
+                  T* c, const int* ldc) {                                  \
+    int da[9], db[9], dc[9], one = 1;                                      \
+    int am = (*transa == 'N' || *transa == 'n') ? *m : *k;                 \
+    int an = (*transa == 'N' || *transa == 'n') ? *k : *m;                 \
+    int bm = (*transb == 'N' || *transb == 'n') ? *k : *n;                 \
+    int bn = (*transb == 'N' || *transb == 'n') ? *n : *k;                 \
+    lapack_desc(da, am, an, *lda);                                         \
+    lapack_desc(db, bm, bn, *ldb);                                         \
+    lapack_desc(dc, *m, *n, *ldc);                                         \
+    ppfx##gemm_(transa, transb, m, n, k, alpha, a, &one, &one, da, b,      \
+                &one, &one, db, beta, c, &one, &one, dc);                  \
+  }
+
+DEF_F77_GEMM(dplasma_d, pd, double)
+DEF_F77_GEMM(dplasma_s, ps, float)
+
+#define DEF_F77_TR(pfx, ppfx, T, op)                                       \
+  void pfx##op##_(const char* side, const char* uplo,                      \
+                  const char* transa, const char* diag, const int* m,      \
+                  const int* n, const T* alpha, T* a, const int* lda,      \
+                  T* b, const int* ldb) {                                  \
+    int da[9], db[9], one = 1;                                             \
+    int ka = (*side == 'L' || *side == 'l') ? *m : *n;                     \
+    lapack_desc(da, ka, ka, *lda);                                         \
+    lapack_desc(db, *m, *n, *ldb);                                         \
+    ppfx##op##_(side, uplo, transa, diag, m, n, alpha, a, &one, &one,      \
+                da, b, &one, &one, db);                                    \
+  }
+
+DEF_F77_TR(dplasma_d, pd, double, trsm)
+DEF_F77_TR(dplasma_s, ps, float, trsm)
+DEF_F77_TR(dplasma_d, pd, double, trmm)
+DEF_F77_TR(dplasma_s, ps, float, trmm)
+
+#define DEF_F77_GETRF(pfx, ppfx, T)                                        \
+  void pfx##getrf_(const int* m, const int* n, T* a, const int* lda,       \
+                   int* ipiv, int* info) {                                 \
+    int d[9], one = 1;                                                     \
+    lapack_desc(d, *m, *n, *lda);                                          \
+    ppfx##getrf_(m, n, a, &one, &one, d, ipiv, info);                      \
+  }
+
+DEF_F77_GETRF(dplasma_d, pd, double)
+DEF_F77_GETRF(dplasma_s, ps, float)
+
+#define DEF_F77_GEQRF(pfx, ppfx, T)                                        \
+  void pfx##geqrf_(const int* m, const int* n, T* a, const int* lda,       \
+                   T* tau, T* work, const int* lwork, int* info) {         \
+    int d[9], one = 1;                                                     \
+    lapack_desc(d, *m, *n, *lda);                                          \
+    ppfx##geqrf_(m, n, a, &one, &one, d, tau, work, lwork, info);          \
+  }
+
+DEF_F77_GEQRF(dplasma_d, pd, double)
+DEF_F77_GEQRF(dplasma_s, ps, float)
+
+#define DEF_F77_SOLVE(pfx, ppfx, T, op)                                    \
+  void pfx##op##_(const char* uplo, const int* n, const int* nrhs, T* a,   \
+                  const int* lda, T* b, const int* ldb, int* info) {       \
+    int da[9], db[9], one = 1;                                             \
+    lapack_desc(da, *n, *n, *lda);                                         \
+    lapack_desc(db, *n, *nrhs, *ldb);                                      \
+    ppfx##op##_(uplo, n, nrhs, a, &one, &one, da, b, &one, &one, db,       \
+                info);                                                     \
+  }
+
+DEF_F77_SOLVE(dplasma_d, pd, double, potrs)
+DEF_F77_SOLVE(dplasma_s, ps, float, potrs)
+DEF_F77_SOLVE(dplasma_d, pd, double, posv)
+DEF_F77_SOLVE(dplasma_s, ps, float, posv)
+
+#define DEF_F77_GESV(pfx, ppfx, T)                                         \
+  void pfx##gesv_(const int* n, const int* nrhs, T* a, const int* lda,     \
+                  int* ipiv, T* b, const int* ldb, int* info) {            \
+    int da[9], db[9], one = 1;                                             \
+    lapack_desc(da, *n, *n, *lda);                                         \
+    lapack_desc(db, *n, *nrhs, *ldb);                                      \
+    ppfx##gesv_(n, nrhs, a, &one, &one, da, ipiv, b, &one, &one, db,       \
+                info);                                                     \
+  }
+
+DEF_F77_GESV(dplasma_d, pd, double)
+DEF_F77_GESV(dplasma_s, ps, float)
+
+#define DEF_F77_SYEV(pfx, ppfx, T)                                         \
+  void pfx##syev_(const char* jobz, const char* uplo, const int* n, T* a,  \
+                  const int* lda, T* w, T* work, const int* lwork,         \
+                  int* info) {                                             \
+    int da[9], one = 1;                                                    \
+    lapack_desc(da, *n, *n, *lda);                                         \
+    ppfx##syev_(jobz, uplo, n, a, &one, &one, da, w, (T*)0, &one, &one,    \
+                da, work, lwork, info);                                    \
+  }
+
+DEF_F77_SYEV(dplasma_d, pd, double)
+DEF_F77_SYEV(dplasma_s, ps, float)
+
 int dplasma_tpu_shim_version() { return 1; }
 
 }  // extern "C"

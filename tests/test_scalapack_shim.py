@@ -368,3 +368,74 @@ def test_multirank_memory_bounded(shim, rng, monkeypatch):
         ctypes.byref(ctypes.c_int(ctxt))) == 0
     # largest host staging buffer: one rank's local piece, not M*N
     assert peak["n"] <= (N * N) // (P * Q), peak["n"]
+
+
+def test_f77_twin_bindings(shim, rng):
+    """dplasma_* F77 twin set (ref src/dplasma_zf77.c role): plain
+    column-major LAPACK arrays routed through the same handlers."""
+    N = 96
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    # dplasma_dpotrf_ on a LAPACK array
+    a = np.asfortranarray(spd)
+    info = ctypes.c_int(99)
+    uplo, n_ = ctypes.c_char(b"L"), ctypes.c_int(N)
+    shim.dplasma_dpotrf_(ctypes.byref(uplo), ctypes.byref(n_), _pd(a),
+                         ctypes.byref(n_), ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(np.tril(a) - np.linalg.cholesky(spd)).max() < 1e-10
+    # dplasma_dpotrs_ using that factor
+    x = np.asfortranarray(rng.standard_normal((N, 3)))
+    b = np.asfortranarray(spd @ x)
+    nrhs = ctypes.c_int(3)
+    shim.dplasma_dpotrs_(ctypes.byref(uplo), ctypes.byref(n_),
+                         ctypes.byref(nrhs), _pd(a), ctypes.byref(n_),
+                         _pd(b), ctypes.byref(n_), ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(b - x).max() < 1e-7
+    # dplasma_dgemm_
+    m, kk, nn = 64, 48, 80
+    A = np.asfortranarray(rng.standard_normal((m, kk)))
+    B = np.asfortranarray(rng.standard_normal((kk, nn)))
+    C = np.asfortranarray(np.zeros((m, nn)))
+    ta = ctypes.c_char(b"N")
+    al, be = ctypes.c_double(1.0), ctypes.c_double(0.0)
+    mi, ki, ni = (ctypes.c_int(v) for v in (m, kk, nn))
+    shim.dplasma_dgemm_(ctypes.byref(ta), ctypes.byref(ta),
+                        ctypes.byref(mi), ctypes.byref(ni),
+                        ctypes.byref(ki), ctypes.byref(al), _pd(A),
+                        ctypes.byref(mi), _pd(B), ctypes.byref(ki),
+                        ctypes.byref(be), _pd(C), ctypes.byref(mi))
+    assert np.abs(C - A @ B).max() < 1e-10
+    # dplasma_dgetrf_ + dplasma_sgesv_ (both precisions exercised)
+    g = np.asfortranarray(rng.standard_normal((N, N)) + N * np.eye(N))
+    ipiv = np.zeros(N, np.int32)
+    shim.dplasma_dgetrf_(ctypes.byref(n_), ctypes.byref(n_), _pd(g),
+                         ctypes.byref(n_),
+                         ipiv.ctypes.data_as(ctypes.c_void_p),
+                         ctypes.byref(info))
+    assert info.value == 0
+    gs = np.asfortranarray(
+        (rng.standard_normal((N, N)) + N * np.eye(N)).astype(np.float32))
+    xs = rng.standard_normal((N, 2)).astype(np.float32)
+    bs = np.asfortranarray((gs @ xs).astype(np.float32))
+    ipiv2 = np.zeros(N, np.int32)
+    nrhs2 = ctypes.c_int(2)
+    shim.dplasma_sgesv_(ctypes.byref(n_), ctypes.byref(nrhs2), _pd(gs),
+                        ctypes.byref(n_),
+                        ipiv2.ctypes.data_as(ctypes.c_void_p),
+                        _pd(bs), ctypes.byref(n_), ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(bs - xs).max() < 2e-2
+    # dplasma_dsyev_ eigenvalues
+    h = np.asfortranarray((spd + spd.T) / 2)
+    w = np.zeros(N)
+    work = np.zeros(2)
+    jz = ctypes.c_char(b"N")
+    lw = ctypes.c_int(8)
+    shim.dplasma_dsyev_(ctypes.byref(jz), ctypes.byref(uplo),
+                        ctypes.byref(n_), _pd(h), ctypes.byref(n_),
+                        _pd(w), _pd(work), ctypes.byref(lw),
+                        ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(w - np.linalg.eigvalsh((spd + spd.T) / 2)).max() < 1e-8
