@@ -173,13 +173,14 @@ def heev(A: TileMatrix, uplo: str = "L", method: str = "auto"):
       MXU-friendly) on the mirrored matrix. The TPU analogue of the
       reference shipping the final eigenproblem to rank-0 LAPACK
       (testing_zheev.c): delegate to the vendor solver where it wins;
-    * ``"auto"`` — the vendor solver: the chase's O(N²/2) sequential
-      rotations are latency-bound poison on accelerators (measured
-      270x slower than eigvalsh at N=1024 on one chip; a multi-bulge
-      blocked chase is the known fix, and the banded-storage chase is
-      structured for it). The 2stage chain is the explicit
-      composed-pipeline path (the reference's parsec_compose shape),
-      correct at every size and O(N·band) in stage 2.
+    * ``"auto"`` — the vendor solver: stage 2 now rides the pipelined
+      blocked SBR (r4: 91x the vendor solver at N=1024, down from
+      270x with the per-rotation chase), but the per-step window
+      gather/scatter on the dense layout still prices the chain out
+      on one chip; a band-storage step-IO rewrite (strided slabs =
+      native slice+reshape) is the known remaining lever. The 2stage
+      chain is the explicit composed-pipeline path (the reference's
+      parsec_compose shape), correct at every size.
 
     Returns ascending eigenvalues (N,)."""
     if method == "auto":

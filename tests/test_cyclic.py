@@ -193,7 +193,13 @@ def test_geqrf_cyclic_residual(devices8):
         assert orth < 100, orth
 
 
-@pytest.mark.parametrize("dist", DISTS)
+@pytest.mark.parametrize(
+    "dist",
+    # one representative fast; the full supertile/offset sweep is a
+    # compile-heavy ~40-60s each and rides the slow tier (VERDICT r4
+    # item 8 — coverage of the component stays per-PR via dist0)
+    [DISTS[0]] + [pytest.param(d, marks=pytest.mark.slow)
+                  for d in DISTS[1:]])
 def test_a2a_conversion_matches_gather(devices8, dist):
     """Memory-bounded all_to_all conversions (VERDICT r2 weak #5 /
     the parsec_redistribute role): must reproduce the gather path

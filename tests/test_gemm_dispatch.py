@@ -51,8 +51,10 @@ def test_stream_complex_conj():
     run_case(fn, "N", "C", dtype=jnp.complex128)
 
 
-@pytest.mark.parametrize("transa", ["N", "T"])
-@pytest.mark.parametrize("transb", ["N", "C"])
+@pytest.mark.parametrize("transa,transb", [
+    ("N", "N"), ("T", "C"),
+    pytest.param("N", "C", marks=pytest.mark.slow),
+    pytest.param("T", "N", marks=pytest.mark.slow)])
 def test_summa_matches_dot(devices8, transa, transb):
     dt = jnp.complex128 if transb == "C" else jnp.float64
     m = pmesh.make_mesh(2, 4, devices=devices8)

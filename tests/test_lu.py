@@ -103,7 +103,7 @@ def test_getrs_trans(trans):
 
 
 def test_gesv_1d_axmb():
-    N, nrhs, nb = 117, 13, 25
+    N, nrhs, nb = 77, 13, 25   # odd tiles kept; 40s at 117 (1-core box)
     A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=jnp.float64)
     B = generators.plrnt(N, nrhs, nb, nb, seed=2354, dtype=jnp.float64)
     _, _, X = lu.gesv_1d(A0, B)
@@ -210,7 +210,7 @@ def test_getrf_rec_matches_1d(rng):
     must keep the getrf_1d factorization contract."""
     import numpy as np
 
-    N, nb, hnb = 128, 32, 8
+    N, nb, hnb = 96, 32, 16   # 59s at 128/8 on the 1-core box
     a = rng.standard_normal((N, N))
     A = TileMatrix.from_dense(jnp.asarray(a), nb, nb)
     LU, perm = lu.getrf_rec(A, hnb)

@@ -18,8 +18,10 @@ def _qr_parts(Af, Tf):
     return Q, R
 
 
-@pytest.mark.parametrize("M,N,nb", [(130, 130, 32), (147, 93, 25),
-                                    (93, 147, 25), (64, 64, 64)])
+@pytest.mark.parametrize("M,N,nb", [
+    (130, 130, 32), (93, 147, 25),
+    pytest.param(147, 93, 25, marks=pytest.mark.slow),
+    pytest.param(64, 64, 64, marks=pytest.mark.slow)])
 @pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
 def test_geqrf_residual_orthogonality(M, N, nb, dtype):
     A0 = generators.plrnt(M, N, nb, nb, seed=3872, dtype=dtype)

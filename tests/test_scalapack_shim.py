@@ -160,8 +160,22 @@ def test_psgemm_f32(shim, rng):
     assert np.abs(C - ref).max() < 1e-2
 
 
-def test_call_counters(shim):
+def test_call_counters(shim, rng):
     from dplasma_tpu import scalapack
+    # issue one call of our own: execution-order independence (xdist)
+    m = 16
+    A = np.asfortranarray(rng.standard_normal((m, m)))
+    C = np.asfortranarray(np.zeros((m, m)))
+    ta = ctypes.c_char(b"N")
+    al, be = ctypes.c_double(1.0), ctypes.c_double(0.0)
+    mi = ctypes.c_int(m)
+    shim.pdgemm_(ctypes.byref(ta), ctypes.byref(ta), ctypes.byref(mi),
+                 ctypes.byref(mi), ctypes.byref(mi), ctypes.byref(al),
+                 _pd(A), ctypes.byref(_one), ctypes.byref(_one),
+                 _desc(m, m, 16, 16, m), _pd(A), ctypes.byref(_one),
+                 ctypes.byref(_one), _desc(m, m, 16, 16, m),
+                 ctypes.byref(be), _pd(C), ctypes.byref(_one),
+                 ctypes.byref(_one), _desc(m, m, 16, 16, m))
     assert scalapack.call_counts.get("gemm", 0) >= 1
 
 
