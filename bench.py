@@ -257,12 +257,15 @@ def main():
         # compile cost (~6-10 min at 2048/512 in r3); larger sizes get
         # their own cost_s so the gate prices them honestly.
         dd_potrf_cfgs = [dict(N=8192, nb=512), dict(N=4096, nb=512)]
-        # dd QR/LU at N=8192 measured compile-infeasible (>60 min AOT
-        # was killed mid-compile, r4) — 4096 is the ladder top until
-        # those sweeps get the shape-cached-panel treatment
-        dd_geqrf_cfgs = [dict(N=4096, nb=512, cost_s=700),
-                         dict(N=2048, nb=512)]
-        dd_getrf_cfgs = [dict(N=4096, nb=512, cost_s=700),
+        # dd QR above N=2048 measured compile-infeasible this round
+        # (4096: tpu_compile_helper SIGKILL at ~316s; 8192: >60 min
+        # AOT, killed) — attempting it deterministically burns budget,
+        # so QR holds at 2048 until the sweep gets the shape-cached-
+        # panel treatment the blocked POTRF has. dd LU at 4096
+        # compiles (941s cold, persistent-cached on this box) and
+        # measured 525.7 GF/s (r4).
+        dd_geqrf_cfgs = [dict(N=2048, nb=512)]
+        dd_getrf_cfgs = [dict(N=4096, nb=512, cost_s=600),
                          dict(N=2048, nb=512)]
         dd_cost = 420.0
     else:  # CI / smoke path: tiny shapes, same code
