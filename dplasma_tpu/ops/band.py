@@ -347,20 +347,194 @@ def bidiag_band_to_bidiag_scan(X, M: int, N: int, b: int):
     return d, e
 
 
+# ---------------------------------------------------------------------
+# Band-storage pipelined SBR: the step-IO rewrite.
+#
+# On the dense layout each scan step paid a G-way window gather +
+# scatter (0.5-9 ms of general-scatter cost per step — measured r4).
+# On column-aligned band storage the active window anchors at time t
+# are EXACTLY arithmetic in the slot index (with panel stagger delta:
+# a(t, j) = t*b - j*(delta*b - w) + w - b; this schedule runs
+# delta = 4), so the G windows live at uniform stride
+# S = delta*b - w and batched IO is ONE dynamic_slice + reshape. Inside a window, matrix
+# rows/columns shear-align with pad+reshape (native ops), making the
+# QR block and both strips STATIC slices of the sheared view:
+#   Y[g, t', D + rr] = A[c0 + rr, c0 + t']   (rr = row - anchor)
+#   block  = Y[:, :b, D+b : D+2b]        (mask cols t' < b - u)
+#   rows   = Y[:, :V, D+b : D+2b]        (left compact-WY apply)
+#   cols   = Y[:, b:2b, D : D+V]         (right apply; final values)
+# Both panel (u = w) and chase (u = b) steps share this geometry when
+# the panel window anchors at s - (b - w); inactive slots carry u = 0
+# whose empty column mask makes the step an exact identity.
+# ---------------------------------------------------------------------
+
+def _shear_fwd(Wt, H: int):
+    """Y[g, t, k] = Wt[g, t, k - t] (zero where k - t outside [0, H));
+    Wt (G, S, H) -> (G, S, H + S - 1)."""
+    G, S, _ = Wt.shape
+    Wp = jnp.pad(Wt, ((0, 0), (0, 0), (0, S)))          # width H + S
+    flat = Wp.reshape(G, S * (H + S))
+    return flat[:, :S * (H + S - 1)].reshape(G, S, H + S - 1)
+
+
+def _shear_bwd(Y, H: int):
+    """Inverse of :func:`_shear_fwd`: Wt[g, t, h] = Y[g, t, h + t]."""
+    G, S, Wsh = Y.shape                                  # Wsh = H+S-1
+    flat = Y.reshape(G, S * Wsh)
+    flat = jnp.pad(flat, ((0, 0), (0, S)))
+    return flat.reshape(G, S, Wsh + 1)[:, :, :H]
+
+
+def _sbr_banded_schedule(N: int, b: int, w: int, delta: int = 4):
+    """base (T,), u (T, G) for the band-layout sweep; plus geometry.
+
+    ``delta``: panel-start stagger in steps. Slot windows are
+    structurally disjoint on band storage (contiguous S-strided
+    slabs), so delta is bounded only by the data dependency — panel
+    j+1's columns are restored to band b by panel j's FIRST chase
+    step, delta-1 steps earlier — and by S = delta*b - w >= V, i.e.
+    delta=4 needs w <= b/2 (the ladder uses b/4). The dense-layout
+    sweep needs delta=5 for its window-overlap proof."""
+    starts = list(range(0, max(N - w - 1, 0), w))
+    if not starts:
+        return None
+    assert delta * b - w >= 3 * b + w, (b, w, delta)
+    P = len(starts)
+    M = [1 + max(0, -(-(N - s - w) // b) - 1) for s in starts]
+    Mx = max(M)
+    S = delta * b - w
+    V = 3 * b + w
+    G = -(-Mx // delta) + 1
+    T = max(delta * j + M[j] for j in range(P))
+    base = np.zeros(T, np.int64)
+    uu = np.zeros((T, G), np.int32)
+    for t in range(T):
+        jmax = min(t // delta, P - 1)
+        base[t] = t * b - jmax * S + (w - b)
+        for g in range(G):
+            j = jmax - g
+            if j < 0:
+                continue
+            m = t - delta * j
+            if 0 <= m < M[j]:
+                uu[t, g] = w if m == 0 else b
+    L0 = int(max(0, -base.min()))
+    hi = int(base.max()) + G * S
+    return base, uu, T, G, S, V, L0, hi
+
+
+def _band_full(X, N: int, D: int, L0: int, Nc: int):
+    """Full-band col-aligned storage from dense: F[D + (r-c), L0 + c]
+    = X[r, c] for |r - c| <= D."""
+    c = jnp.arange(N)[None, :]
+    k = jnp.arange(-D, D + 1)[:, None]
+    r = c + k
+    valid = (r >= 0) & (r < N)
+    body = jnp.where(valid, X[r.clip(0, N - 1), c.clip(0, N - 1)], 0)
+    F = jnp.zeros((2 * D + 1, Nc), X.dtype)
+    return jax.lax.dynamic_update_slice(F, body, (0, L0))
+
+
+def herm_sbr_sweep_banded(F, N: int, b: int, w: int, D: int, L0: int,
+                          sched=None):
+    """One pipelined SBR sweep on full-band storage ``F``
+    ((2D+1, Nc), D >= 2b + w, logical col c at L0 + c). Band b -> w.
+    ``sched``: a precomputed :func:`_sbr_banded_schedule` (the ladder
+    passes its own — the O(T*G) Python build is tens of millions of
+    iterations for the narrow rungs at large N, not worth doubling).
+    Returns the swept F (same shape/geometry)."""
+    from dplasma_tpu.kernels import householder as hh
+    if sched is None:
+        sched = _sbr_banded_schedule(N, b, w)
+    if sched is None or N <= 2 or b <= 1:
+        return F
+    base, uu, T, G, S, V, L0_need, hi = sched
+    H = F.shape[0]
+    assert D >= 2 * b + w and H == 2 * D + 1
+    assert L0 >= L0_need and L0 + hi <= F.shape[1], (L0, hi, F.shape)
+    Dc = D                                  # center row of F
+    bcols = jnp.arange(b)
+
+    def one(Y, u):
+        """Process one sheared window Y (S, H + S - 1)."""
+        blk = Y[:b, Dc + b:Dc + 2 * b].T                 # (i, t')
+        # elimination columns (t' in [b-u, b)) must sit LEFTMOST for
+        # the QR's below-diagonal contract: roll them to [0, u) — the
+        # wrapped-in columns are the masked zeros. The reflectors act
+        # on ROWS, so everything downstream is column-order blind.
+        blk = jnp.where((bcols >= b - u)[None, :], blk, 0)
+        blk = jnp.roll(blk, u - b, axis=1)
+        _, v, tT = hh.geqrt(blk)
+        R = Y[:V, Dc + b:Dc + 2 * b].T                   # (b=i, V=t')
+        R1 = hh.apply_q(v, tT, R, trans="C")
+        # col strip: unchanged rows are the Hermitian mirror of the
+        # ORIGINAL strip; mixed rows carry the left-updated block
+        # UNTRANSPOSED — Q^H A is not Hermitian, C1[b+x, i] =
+        # A1[c0+b+x, c0+b+i] = R1[x, b+i] directly (r4 debug)
+        C1 = jnp.conj(R).T                               # (V, b)
+        C1 = C1.at[b:2 * b, :].set(R1[:, b:2 * b])
+        C2 = hh.apply_q_right(v, tT, C1, trans="N")
+        R2 = R1.at[:, b:2 * b].set(C2[b:2 * b, :])
+        Y = Y.at[:V, Dc + b:Dc + 2 * b].set(R2.T)
+        Y = Y.at[b:2 * b, Dc:Dc + V].set(C2.T)
+        return Y
+
+    def step(F, tc):
+        bs, u = tc
+        blk = jax.lax.dynamic_slice(
+            F, (jnp.zeros_like(bs), bs), (H, G * S))     # ONE slice
+        Wt = blk.reshape(H, G, S).transpose(1, 2, 0)     # (G, S, H)
+        Y = _shear_fwd(Wt, H)
+        Y = jax.vmap(one)(Y, u)
+        Wt = _shear_bwd(Y, H)
+        blk = Wt.transpose(2, 0, 1).reshape(H, G * S)
+        return jax.lax.dynamic_update_slice(
+            F, blk, (jnp.zeros_like(bs), bs)), None
+
+    bases = jnp.asarray(base + L0, jnp.int32)
+    F, _ = jax.lax.scan(step, F, (bases, jnp.asarray(uu)))
+    return F
+
+
 def herm_band_to_tridiag_scan(X, N: int, b: int):
-    """Band -> tridiagonal by successive :func:`herm_sbr_sweep`
-    quarter-width sweeps (b -> b//4 -> ... -> 1). Returns (d, e)
-    real."""
+    """Band -> tridiagonal by successive pipelined SBR sweeps
+    (b -> b//4 -> ... -> 1) on band storage (see the section comment:
+    all step IO is native slice+reshape). Returns (d, e) real."""
+    if N <= 2 or b <= 1:
+        body = X[:N, :N]
+        d = jnp.real(jnp.diagonal(body))
+        rdt = d.dtype
+        e = (jnp.abs(jnp.diagonal(body, offset=-1)).astype(rdt)
+             if N > 1 else jnp.zeros((0,), rdt))
+        return d, e
+    ws = []
     bb = b
     while bb > 1:
-        w = max(1, bb // 4)
-        X = herm_sbr_sweep(X, N, bb, w)
-        bb = w
-    body = X[:N, :N]
-    d = jnp.real(jnp.diagonal(body))
+        w_ = max(1, bb // 4)
+        ws.append((bb, w_))
+        bb = w_
+    F = None
+    D = L0 = 0
+    for (bs_, ws_) in ws:
+        sched = _sbr_banded_schedule(N, bs_, ws_)
+        if sched is None:
+            continue
+        _, _, _, G_, S_, _, L0n, hin = sched
+        Dn = 2 * bs_ + ws_
+        Ncn = L0n + max(hin, N) + S_
+        if F is None:
+            F = _band_full(X, N, Dn, L0n, Ncn)
+        else:
+            # re-center the band into the new (smaller) geometry
+            body = jax.lax.dynamic_slice(
+                F, (D - Dn, L0), (2 * Dn + 1, N))
+            F = jnp.zeros((2 * Dn + 1, Ncn), F.dtype)
+            F = jax.lax.dynamic_update_slice(F, body, (0, L0n))
+        D, L0 = Dn, L0n
+        F = herm_sbr_sweep_banded(F, N, bs_, ws_, D, L0, sched=sched)
+    d = jnp.real(F[D, L0:L0 + N])
     rdt = d.dtype
-    e = (jnp.abs(jnp.diagonal(body, offset=-1)).astype(rdt)
-         if N > 1 else jnp.zeros((0,), rdt))
+    e = jnp.abs(F[D + 1, L0:L0 + N - 1]).astype(rdt)
     return d, e
 
 
