@@ -173,14 +173,15 @@ def heev(A: TileMatrix, uplo: str = "L", method: str = "auto"):
       MXU-friendly) on the mirrored matrix. The TPU analogue of the
       reference shipping the final eigenproblem to rank-0 LAPACK
       (testing_zheev.c): delegate to the vendor solver where it wins;
-    * ``"auto"`` — the vendor solver: stage 2 now rides the pipelined
-      blocked SBR (r4: 91x the vendor solver at N=1024, down from
-      270x with the per-rotation chase), but the per-step window
-      gather/scatter on the dense layout still prices the chain out
-      on one chip; a band-storage step-IO rewrite (strided slabs =
-      native slice+reshape) is the known remaining lever. The 2stage
-      chain is the explicit composed-pipeline path (the reference's
-      parsec_compose shape), correct at every size.
+    * ``"auto"`` — the vendor solver: stage 2 rides the pipelined
+      blocked SBR on band storage (r4: ~10x the vendor solver at
+      N=4096, 26x at N=1024 — down from 270x with the per-rotation
+      chase), so the vendor QDWH path still wins on one chip; the
+      2stage chain is the explicit composed-pipeline path (the
+      reference's parsec_compose shape), correct at every size and
+      the stage-1 building block of the DISTRIBUTED chain
+      (parallel.cyclic.heev_cyclic), where the vendor solver has no
+      multi-chip analogue.
 
     Returns ascending eigenvalues (N,)."""
     if method == "auto":
