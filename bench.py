@@ -249,7 +249,8 @@ def main():
             ("sgetrf", bench_getrf,
              [dict(N=16384, nb=1024), dict(N=8192, nb=1024)], 150.0),
         ]
-        dd_gemm_cfgs = [dict(N=4096), dict(N=2048)]
+        dd_gemm_cfgs = [dict(N=8192, cost_s=300), dict(N=4096),
+                        dict(N=2048)]
         # known-good size first: the headline must land in the artifact
         # before anything speculative is attempted (r3 lesson). The
         # metric-of-record N=16384 upgrade runs at the END of the

@@ -424,21 +424,23 @@ def _sbr_banded_schedule(N: int, b: int, w: int, delta: int = 4):
 
 
 def _band_full(X, N: int, D: int, L0: int, Nc: int):
-    """Full-band col-aligned storage from dense: F[D + (r-c), L0 + c]
-    = X[r, c] for |r - c| <= D."""
-    c = jnp.arange(N)[None, :]
-    k = jnp.arange(-D, D + 1)[:, None]
+    """Full-band COLUMN-MAJOR band storage from dense:
+    F[L0 + c, D + (r-c)] = X[r, c] for |r - c| <= D (columns lead so
+    the sweep's strided slab slice needs no transposes)."""
+    c = jnp.arange(N)[:, None]
+    k = jnp.arange(-D, D + 1)[None, :]
     r = c + k
     valid = (r >= 0) & (r < N)
     body = jnp.where(valid, X[r.clip(0, N - 1), c.clip(0, N - 1)], 0)
-    F = jnp.zeros((2 * D + 1, Nc), X.dtype)
-    return jax.lax.dynamic_update_slice(F, body, (0, L0))
+    F = jnp.zeros((Nc, 2 * D + 1), X.dtype)
+    return jax.lax.dynamic_update_slice(F, body, (L0, 0))
 
 
 def herm_sbr_sweep_banded(F, N: int, b: int, w: int, D: int, L0: int,
                           sched=None):
     """One pipelined SBR sweep on full-band storage ``F``
-    ((2D+1, Nc), D >= 2b + w, logical col c at L0 + c). Band b -> w.
+    ((Nc, 2D+1) column-major, D >= 2b + w, logical col c at row
+    L0 + c). Band b -> w.
     ``sched``: a precomputed :func:`_sbr_banded_schedule` (the ladder
     passes its own — the O(T*G) Python build is tens of millions of
     iterations for the narrow rungs at large N, not worth doubling).
@@ -449,9 +451,9 @@ def herm_sbr_sweep_banded(F, N: int, b: int, w: int, D: int, L0: int,
     if sched is None or N <= 2 or b <= 1:
         return F
     base, uu, T, G, S, V, L0_need, hi = sched
-    H = F.shape[0]
+    H = F.shape[1]
     assert D >= 2 * b + w and H == 2 * D + 1
-    assert L0 >= L0_need and L0 + hi <= F.shape[1], (L0, hi, F.shape)
+    assert L0 >= L0_need and L0 + hi <= F.shape[0], (L0, hi, F.shape)
     Dc = D                                  # center row of F
     bcols = jnp.arange(b)
 
@@ -481,15 +483,16 @@ def herm_sbr_sweep_banded(F, N: int, b: int, w: int, D: int, L0: int,
 
     def step(F, tc):
         bs, u = tc
+        # column-major band storage: the G stride-S window slabs are
+        # one contiguous row range — slice + reshape, NO transposes
         blk = jax.lax.dynamic_slice(
-            F, (jnp.zeros_like(bs), bs), (H, G * S))     # ONE slice
-        Wt = blk.reshape(H, G, S).transpose(1, 2, 0)     # (G, S, H)
+            F, (bs, jnp.zeros_like(bs)), (G * S, H))     # ONE slice
+        Wt = blk.reshape(G, S, H)
         Y = _shear_fwd(Wt, H)
         Y = jax.vmap(one)(Y, u)
         Wt = _shear_bwd(Y, H)
-        blk = Wt.transpose(2, 0, 1).reshape(H, G * S)
         return jax.lax.dynamic_update_slice(
-            F, blk, (jnp.zeros_like(bs), bs)), None
+            F, Wt.reshape(G * S, H), (bs, jnp.zeros_like(bs))), None
 
     bases = jnp.asarray(base + L0, jnp.int32)
     F, _ = jax.lax.scan(step, F, (bases, jnp.asarray(uu)))
@@ -527,14 +530,14 @@ def herm_band_to_tridiag_scan(X, N: int, b: int):
         else:
             # re-center the band into the new (smaller) geometry
             body = jax.lax.dynamic_slice(
-                F, (D - Dn, L0), (2 * Dn + 1, N))
-            F = jnp.zeros((2 * Dn + 1, Ncn), F.dtype)
-            F = jax.lax.dynamic_update_slice(F, body, (0, L0n))
+                F, (L0, D - Dn), (N, 2 * Dn + 1))
+            F = jnp.zeros((Ncn, 2 * Dn + 1), F.dtype)
+            F = jax.lax.dynamic_update_slice(F, body, (L0n, 0))
         D, L0 = Dn, L0n
         F = herm_sbr_sweep_banded(F, N, bs_, ws_, D, L0, sched=sched)
-    d = jnp.real(F[D, L0:L0 + N])
+    d = jnp.real(F[L0:L0 + N, D])
     rdt = d.dtype
-    e = jnp.abs(F[D + 1, L0:L0 + N - 1]).astype(rdt)
+    e = jnp.abs(F[L0:L0 + N - 1, D + 1]).astype(rdt)
     return d, e
 
 
