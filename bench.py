@@ -133,6 +133,31 @@ def bench_gemm(N, dtype=jnp.float32, lo=1, hi=6):
 def bench_geqrf(N, nb, dtype=jnp.float32, lo=1, hi=4):
     A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=dtype)
 
+    if dtype == jnp.float64 and jax.default_backend() != "cpu":
+        # dd route: EAGER shape-cached executables (ops.qr dispatch) —
+        # the monolithic traced sweep OOM-kills the compile helper
+        # above N=2048, so the jit harness below cannot be used.
+        # Python-loop differenced timing; every iteration re-dispatches
+        # (nothing to hoist) with the usual one-row perturbation.
+        def run_k(kk):
+            out = None
+            for i in range(kk):
+                a = A0.data.at[:1].multiply(1.0 + (i + 1) * 1e-7)
+                out = qr_mod.geqrf(TileMatrix(a, A0.desc))
+            jax.block_until_ready(out[0].data)
+            _sync(out[0].data)
+        run_k(1)                       # compile + warm
+        times = {}
+        for kk in (lo, hi):
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                run_k(kk)
+                best = min(best, time.perf_counter() - t0)
+            times[kk] = best
+        t = max((times[hi] - times[lo]) / (hi - lo), 1e-12)
+        return lawn41.geqrf(N, N) / 1e9 / t
+
     def step(a):
         Af, Tf = qr_mod.geqrf(TileMatrix(a, A0.desc))
         return Af.data, Tf.data
@@ -258,14 +283,15 @@ def main():
         # compile cost (~6-10 min at 2048/512 in r3); larger sizes get
         # their own cost_s so the gate prices them honestly.
         dd_potrf_cfgs = [dict(N=8192, nb=512), dict(N=4096, nb=512)]
-        # dd QR above N=2048 measured compile-infeasible this round
-        # (4096: tpu_compile_helper SIGKILL at ~316s; 8192: >60 min
-        # AOT, killed) — attempting it deterministically burns budget,
-        # so QR holds at 2048 until the sweep gets the shape-cached-
-        # panel treatment the blocked POTRF has. dd LU at 4096
-        # compiles (941s cold, persistent-cached on this box) and
-        # measured 525.7 GF/s (r4).
-        dd_geqrf_cfgs = [dict(N=2048, nb=512)]
+        # dd QR rides EAGER shape-cached executables (bench_geqrf dd
+        # branch): the traced monolith OOM-killed the compile helper
+        # above 2048; eager lands 8192 at 830 GF/s in ~400s cold /
+        # cached thereafter (r4). dd LU at 4096 compiles traced (941s
+        # cold, persistent-cached); 8192 stays off the LU ladder
+        # pending the same eager treatment.
+        dd_geqrf_cfgs = [dict(N=8192, nb=512, cost_s=500),
+                         dict(N=4096, nb=512, cost_s=350),
+                         dict(N=2048, nb=512)]
         dd_getrf_cfgs = [dict(N=4096, nb=512, cost_s=600),
                          dict(N=2048, nb=512)]
         dd_cost = 420.0
