@@ -290,6 +290,81 @@ def _panel_lu(panel, ib: int | None = None):
     return _lu_sweep(panel, ib, _base_lu)
 
 
+# -- shape-cached dd LU sweep (eager) ----------------------------------
+# The QR treatment (ops.qr._dd_sweep_eager) applied to LU: eager
+# callers ride ONE fixed-(Npad, nb) panel executable + per-k trailing
+# executables. Zero-padded panel rows are PIVOT-SAFE: partial pivoting
+# never selects a zero row over a nonzero one, and an unselected zero
+# row stays zero and in place — so perm[:m] permutes only real rows.
+
+import functools as _functools
+
+import jax as _jax
+
+
+@_jax.jit
+def _jit_dd_lu_panel(pin):
+    return _panel_lu(pin)
+
+
+@_functools.partial(_jax.jit, static_argnums=(4,))
+def _jit_dd_lu_trail(rest, ids, panfull, permfull, bw: int):
+    m, n = rest.shape
+    perm = lax.slice(permfull, (0,), (m,))
+    pan = lax.slice(panfull, (0, 0), (m, bw))
+    idsp = ids[perm]
+    trail = lax.slice(rest, (0, bw), (m, n))
+    if n > bw:
+        trail = trail[perm]
+        u12 = k.trsm(pan[:bw], trail[:bw], side="L", lower=True,
+                     unit=True)
+        rest_next = trail[bw:]
+        if m > bw:
+            rest_next = rest_next - k.dot(pan[bw:], u12)
+    else:
+        u12 = trail[:bw]
+        rest_next = trail[bw:]
+    return pan, idsp, u12, rest_next
+
+
+def _lu_sweep_dd_eager(X, bw: int):
+    """Eager twin of :func:`_lu_sweep` over shape-cached executables
+    (same deferred-pivot bookkeeping and assembly)."""
+    Mp, Np = X.shape
+    KT = min(Mp, Np) // bw
+    NT = -(-Np // bw)
+    rest = X
+    ids = jnp.arange(Mp)
+    packs, urows, step_ids = [], [], []
+    for kk in range(KT):
+        pin = _jit_dd_panel_in_lu(rest, bw, Mp)
+        panf, permf = _jit_dd_lu_panel(pin)
+        pan, idsp, u12, rest = _jit_dd_lu_trail(rest, ids, panf,
+                                                permf, bw)
+        packs.append(pan)
+        urows.append(u12)
+        step_ids.append(idsp)
+        ids = idsp[bw:]
+
+    final_ids = jnp.concatenate([si[:bw] for si in step_ids] + [ids])
+
+    def reorder(kk):
+        sids = step_ids[kk]
+        wpos = jnp.zeros((Mp,), jnp.int32).at[sids].set(
+            jnp.arange(sids.shape[0], dtype=jnp.int32))
+        return wpos[final_ids[(kk + 1) * bw:]]
+
+    full = assemble_sweep(packs, urows, KT, NT, bw, reorder=reorder)
+    return full, final_ids
+
+
+@_functools.partial(_jax.jit, static_argnums=(1, 2))
+def _jit_dd_panel_in_lu(rest, bw: int, npad: int):
+    m = rest.shape[0]
+    pin = lax.slice(rest, (0, 0), (m, bw))
+    return jnp.pad(pin, ((0, npad - m), (0, 0)))
+
+
 def getrf_1d(A: TileMatrix):
     """Partial-pivoting blocked LU (dplasma_zgetrf_1d). Returns
     (packed L\\U, perm) with semantics ``A[perm] = L U``.
@@ -299,9 +374,21 @@ def getrf_1d(A: TileMatrix):
     deferred pivot bookkeeping — the reference instead chains zlaswp
     row swaps through finished tiles (zgetrf_1d_wrapper.c:55-97) and
     hand-distributes the panel (CORE_zgetrf_rectil / the ptgpanel JDF).
-    """
+    Eager f64 callers on the dd route ride shape-cached executables
+    (the traced monolith OOM-kills the tunnel compile helper at
+    N=8192)."""
     assert A.desc.mb == A.desc.nb, "getrf needs square tiles"
-    full, final_ids = _lu_sweep(A.pad_diag().data, A.desc.nb, _panel_lu)
+    X = A.pad_diag().data
+    use_dd = (A.dtype == jnp.float64 and k._dd_active(A.dtype))
+    # eager only where the traced monolith cannot compile (> 8 panels:
+    # N > 4096 at nb=512); below that the traced executable is ~3x
+    # faster than the per-step dispatch chain (427 vs 136 GF/s at
+    # 4096, measured r4)
+    if (use_dd and not isinstance(X, _jax.core.Tracer)
+            and min(X.shape) // A.desc.nb > 8):
+        full, final_ids = _lu_sweep_dd_eager(X, A.desc.nb)
+    else:
+        full, final_ids = _lu_sweep(X, A.desc.nb, _panel_lu)
     return TileMatrix(pmesh.constrain2d(full), A.desc), final_ids
 
 
