@@ -111,6 +111,21 @@ def _op_loop(data, step, *extras):
     return lambda kk: loop(kk, data, *extras)
 
 
+
+def _eager_diff_seconds(run_k, lo: int, hi: int) -> float:
+    """Differenced Python-loop timing for EAGER (non-traceable) ops:
+    same min-of-2 / slope methodology as _per_run_seconds."""
+    run_k(1)                       # compile + warm
+    times = {}
+    for kk in (lo, hi):
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            run_k(kk)
+            best = min(best, time.perf_counter() - t0)
+        times[kk] = best
+    return max((times[hi] - times[lo]) / (hi - lo), 1e-12)
+
 def bench_potrf(N, nb, dtype=jnp.float32, lo=1, hi=6):
     A0 = generators.plghe(float(N), N, nb, seed=3872, dtype=dtype)
 
@@ -133,12 +148,15 @@ def bench_gemm(N, dtype=jnp.float32, lo=1, hi=6):
 def bench_geqrf(N, nb, dtype=jnp.float32, lo=1, hi=4):
     A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=dtype)
 
-    if dtype == jnp.float64 and jax.default_backend() != "cpu":
+    from dplasma_tpu.kernels import blas as _kb
+    if dtype == jnp.float64 and _kb._dd_active(jnp.dtype(jnp.float64)):
         # dd route: EAGER shape-cached executables (ops.qr dispatch) —
         # the monolithic traced sweep OOM-kills the compile helper
         # above N=2048, so the jit harness below cannot be used.
-        # Python-loop differenced timing; every iteration re-dispatches
-        # (nothing to hoist) with the usual one-row perturbation.
+        # Guarded on the same _dd_active predicate as the ops dispatch
+        # (review r4: a backend mismatch would time un-jitted eager
+        # ops). Python-loop differenced timing; every iteration
+        # re-dispatches (nothing to hoist).
         def run_k(kk):
             out = None
             for i in range(kk):
@@ -146,17 +164,8 @@ def bench_geqrf(N, nb, dtype=jnp.float32, lo=1, hi=4):
                 out = qr_mod.geqrf(TileMatrix(a, A0.desc))
             jax.block_until_ready(out[0].data)
             _sync(out[0].data)
-        run_k(1)                       # compile + warm
-        times = {}
-        for kk in (lo, hi):
-            best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                run_k(kk)
-                best = min(best, time.perf_counter() - t0)
-            times[kk] = best
-        t = max((times[hi] - times[lo]) / (hi - lo), 1e-12)
-        return lawn41.geqrf(N, N) / 1e9 / t
+        return lawn41.geqrf(N, N) / 1e9 / _eager_diff_seconds(
+            run_k, lo, hi)
 
     def step(a):
         Af, Tf = qr_mod.geqrf(TileMatrix(a, A0.desc))
@@ -169,7 +178,8 @@ def bench_geqrf(N, nb, dtype=jnp.float32, lo=1, hi=4):
 def bench_getrf(N, nb, dtype=jnp.float32, lo=1, hi=4):
     A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=dtype)
 
-    if (dtype == jnp.float64 and jax.default_backend() != "cpu"
+    from dplasma_tpu.kernels import blas as _kb
+    if (dtype == jnp.float64 and _kb._dd_active(jnp.dtype(jnp.float64))
             and N // nb > 8):
         # dd route above the traced compile wall: EAGER shape-cached
         # executables (ops.lu dispatch) — see bench_geqrf. At or below
@@ -182,17 +192,8 @@ def bench_getrf(N, nb, dtype=jnp.float32, lo=1, hi=4):
                 out = lu_mod.getrf_1d(TileMatrix(a, A0.desc))
             jax.block_until_ready(out[0].data)
             _sync(out[0].data)
-        run_k(1)
-        times = {}
-        for kk in (lo, hi):
-            best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                run_k(kk)
-                best = min(best, time.perf_counter() - t0)
-            times[kk] = best
-        t = max((times[hi] - times[lo]) / (hi - lo), 1e-12)
-        return lawn41.getrf(N, N) / 1e9 / t
+        return lawn41.getrf(N, N) / 1e9 / _eager_diff_seconds(
+            run_k, lo, hi)
 
     def step(a):
         LU, perm = lu_mod.getrf_1d(TileMatrix(a, A0.desc))

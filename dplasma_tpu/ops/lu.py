@@ -198,6 +198,21 @@ def _base_lu(panel, chunk: int | None = None):
     return jnp.concatenate([top, l21], axis=0), perm
 
 
+def _lu_finish(packs, urows, step_ids, ids, Mp, KT, NT, bw):
+    """Deferred-pivot stitching shared by the traced and eager sweeps:
+    final row order, per-step reorder closure, assembly."""
+    final_ids = jnp.concatenate([si[:bw] for si in step_ids] + [ids])
+
+    def reorder(kk):
+        sids = step_ids[kk]
+        wpos = jnp.zeros((Mp,), jnp.int32).at[sids].set(
+            jnp.arange(sids.shape[0], dtype=jnp.int32))
+        return wpos[final_ids[(kk + 1) * bw:]]
+
+    full = assemble_sweep(packs, urows, KT, NT, bw, reorder=reorder)
+    return full, final_ids
+
+
 def _lu_sweep(X, bw: int, panel_fn):
     """Generic pivoted shrinking-window LU sweep at block width ``bw``:
     right-looking, with *deferred* pivot bookkeeping — each block's
@@ -231,16 +246,7 @@ def _lu_sweep(X, bw: int, panel_fn):
         rest = trail
         ids = idsp[bw:]
 
-    final_ids = jnp.concatenate([si[:bw] for si in step_ids] + [ids])
-
-    def reorder(kk):
-        sids = step_ids[kk]
-        wpos = jnp.zeros((Mp,), jnp.int32).at[sids].set(
-            jnp.arange(sids.shape[0], dtype=jnp.int32))
-        return wpos[final_ids[(kk + 1) * bw:]]
-
-    full = assemble_sweep(packs, urows, KT, NT, bw, reorder=reorder)
-    return full, final_ids
+    return _lu_finish(packs, urows, step_ids, ids, Mp, KT, NT, bw)
 
 
 def _panel_lu_dd(panel, ib: int | None = None):
@@ -336,8 +342,9 @@ def _lu_sweep_dd_eager(X, bw: int):
     rest = X
     ids = jnp.arange(Mp)
     packs, urows, step_ids = [], [], []
+    from dplasma_tpu.ops.qr import _jit_dd_panel_in
     for kk in range(KT):
-        pin = _jit_dd_panel_in_lu(rest, bw, Mp)
+        pin = _jit_dd_panel_in(rest, bw, Mp)
         panf, permf = _jit_dd_lu_panel(pin)
         pan, idsp, u12, rest = _jit_dd_lu_trail(rest, ids, panf,
                                                 permf, bw)
@@ -346,23 +353,7 @@ def _lu_sweep_dd_eager(X, bw: int):
         step_ids.append(idsp)
         ids = idsp[bw:]
 
-    final_ids = jnp.concatenate([si[:bw] for si in step_ids] + [ids])
-
-    def reorder(kk):
-        sids = step_ids[kk]
-        wpos = jnp.zeros((Mp,), jnp.int32).at[sids].set(
-            jnp.arange(sids.shape[0], dtype=jnp.int32))
-        return wpos[final_ids[(kk + 1) * bw:]]
-
-    full = assemble_sweep(packs, urows, KT, NT, bw, reorder=reorder)
-    return full, final_ids
-
-
-@_functools.partial(_jax.jit, static_argnums=(1, 2))
-def _jit_dd_panel_in_lu(rest, bw: int, npad: int):
-    m = rest.shape[0]
-    pin = lax.slice(rest, (0, 0), (m, bw))
-    return jnp.pad(pin, ((0, npad - m), (0, 0)))
+    return _lu_finish(packs, urows, step_ids, ids, Mp, KT, NT, bw)
 
 
 def getrf_1d(A: TileMatrix):

@@ -284,3 +284,37 @@ def test_split_fixed_ff_matches_bits(rng):
         # full 2^-55 contract
         tol = 2.0 ** -48 if split is dd._split_fixed_ff else 2.0 ** -55
         assert (np.abs(rec - x) <= sc * tol).all(), split
+
+
+def test_getrf_dd_eager_many_panels():
+    """The eager shape-cached dd LU route (>8 panels, non-traced):
+    padded-panel pivot bookkeeping must match the getrf_1d contract
+    (review r4: the route was only reachable on TPU bench runs)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dplasma_tpu.descriptors import TileMatrix
+    from dplasma_tpu.ops import generators, lu as lu_mod
+    from dplasma_tpu.utils import config as cfg
+
+    cfg.mca_set("dd_gemm", "always")
+    try:
+        N, nb = 144, 16                 # 9 panels -> eager route
+        A0 = generators.plrnt(N, N, nb, nb, seed=5, dtype=jnp.float64)
+        LU, perm = lu_mod.getrf_1d(A0)  # eager (non-Tracer input)
+        x = np.asarray(LU.to_dense())
+        p = np.asarray(perm)[:N]
+        a = np.asarray(A0.to_dense())[p]
+        L = np.tril(x, -1)[:N, :N] + np.eye(N)
+        U = np.triu(x)[:N, :N]
+        r = np.abs(a - L @ U).max() / (
+            np.abs(a).max() * N * np.finfo(np.float64).eps)
+        assert r < 60.0, r
+        # must agree with the traced sweep bit-for-bit
+        LUt, pt = jax.jit(
+            lambda d: lu_mod.getrf_1d(TileMatrix(d, A0.desc)))(A0.data)
+        assert np.array_equal(np.asarray(pt), np.asarray(perm))
+        assert np.allclose(np.asarray(LUt.data), np.asarray(LU.data),
+                           rtol=0, atol=0)
+    finally:
+        cfg.mca_set("dd_gemm", None)
