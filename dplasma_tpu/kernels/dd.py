@@ -86,37 +86,40 @@ def _level_recombine(levels, w: int):
 
 
 def _limb_levels(al, bl, K: int, w: int, nl: int, kc: int,
-                 cache_layout: bool = False):
+                 lhs_t: bool = False):
     """Exact level sums of the limb-pair products.
 
-    ``al``: nl int8 arrays (M, K); ``bl``: nl int8 arrays (K, N) —
-    or (N, K) when ``cache_layout`` (contraction on the LAST axis of
-    both, the natural layout for cached factor limbs). Returns the nl
-    level arrays: int32 when unchunked (K <= kc), f64 otherwise
-    (per-chunk int32 sums are exact by the _plan bound; cross-chunk
-    adds are exact integer-valued f64).
+    ``al``: nl int8 arrays (M, K) — or (K, M) when ``lhs_t`` (the
+    natural slice layout of the transposed factor-limb cache);
+    ``bl``: nl int8 arrays (K, N).  Contraction always runs on the
+    K-MAJOR layout of the rhs: the MXU pays 2.2x for an rhs contracted
+    on its minor axis at K=8192 and 9x at K=1024 (measured r5 — the
+    r4 cache_layout form, (N, K) rhs, was exactly that), while an
+    lhs-transposed operand is nearly free (387 vs 333 TOPS).
+    Returns the nl level arrays: int32 when unchunked (K <= kc), f64
+    otherwise (per-chunk int32 sums are exact by the _plan bound;
+    cross-chunk adds are exact integer-valued f64).
     """
     nchunks = math.ceil(K / kc)
     if nchunks > 1:
         pad = nchunks * kc - K
-        al = [jnp.pad(x, ((0, 0), (0, pad))) for x in al]
-        al = [x.reshape(x.shape[0], nchunks, kc).transpose(1, 0, 2)
-              for x in al]
-        if cache_layout:
-            bl = [jnp.pad(x, ((0, 0), (0, pad))) for x in bl]
-            bl = [x.reshape(x.shape[0], nchunks, kc).transpose(1, 0, 2)
-                  for x in bl]
-            dn = (((2,), (2,)), ((0,), (0,)))
-            cat_ax, P = 1, bl[0].shape[1]
+        if lhs_t:
+            al = [jnp.pad(x, ((0, pad), (0, 0))) for x in al]
+            al = [x.reshape(nchunks, kc, x.shape[1]) for x in al]
+            dn_l = (1,)
         else:
-            bl = [jnp.pad(x, ((0, pad), (0, 0))) for x in bl]
-            bl = [x.reshape(nchunks, kc, x.shape[1]) for x in bl]
-            dn = (((2,), (1,)), ((0,), (0,)))
-            cat_ax, P = 2, bl[0].shape[2]
+            al = [jnp.pad(x, ((0, 0), (0, pad))) for x in al]
+            al = [x.reshape(x.shape[0], nchunks, kc).transpose(1, 0, 2)
+                  for x in al]
+            dn_l = (2,)
+        bl = [jnp.pad(x, ((0, pad), (0, 0))) for x in bl]
+        bl = [x.reshape(nchunks, kc, x.shape[1]) for x in bl]
+        dn = ((dn_l, (1,)), ((0,), (0,)))
+        cat_ax, P = 2, bl[0].shape[2]
     else:
-        dn = ((((1,), (1,)) if cache_layout else ((1,), (0,))), ((), ()))
-        cat_ax = 0 if cache_layout else 1
-        P = bl[0].shape[cat_ax if cache_layout else 1]
+        dn = ((((0,) if lhs_t else (1,)), (0,)), ((), ()))
+        cat_ax = 1
+        P = bl[0].shape[1]
 
     # One dot per LEFT limb against the concatenation of every right
     # limb it pairs with (j < nl - i): same flops as the 36 pair
@@ -441,10 +444,13 @@ def _split_fixed_ff(x, scale, w: int, nl: int):
 
 def _pair_dot(al, bl, K: int, w: int, nl: int, kc: int):
     """Unscaled limb product sum_l 2^{-w(l+2)} sum_{i+j=l}
-    al[i] @ bl[j]^T (contraction on the LAST axis of both operands —
-    the natural layout for cached factor limbs)."""
+    al[i]^T @ bl[j]: ``al`` (K, M) and ``bl`` (K, N) — both K-major,
+    the slice layout of the TRANSPOSED factor-limb cache Wt[l, col,
+    row] (one cache serves both operands; measured r5: the MXU runs
+    this at 333-387 TOPS where the r4 row-major cache's minor-axis rhs
+    contraction got 29-175)."""
     return _level_recombine(
-        _limb_levels(al, bl, K, w, nl, kc, cache_layout=True), w)
+        _limb_levels(al, bl, K, w, nl, kc, lhs_t=True), w)
 
 
 def _potrf_tile_ir(Akk, refine: int = 3, newton: int = 2,
@@ -542,12 +548,17 @@ def _panel_trsm_ir(Lkk, slab, iters: int = 2):
 
 @partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))
 def _cache_write(W, limbs, s: int):
-    """In-place (donated) limb-cache column write (rows clipped to the
-    cache extent inside the executable — eager slicing of big arrays
-    costs ~35 ms/op on the tunneled transport, measured r4)."""
-    N = W.shape[1]
+    """In-place (donated) limb-cache column write. ``W`` is the
+    TRANSPOSED cache Wt[l, col, row] (nl, N-nb, N): the finished
+    column block's limbs (nl, N, nb; rows beyond N-s are zero pad)
+    land at Wt[:, s:s+nb, s:] transposed, so trail slices contract
+    K-major on the MXU (measured r5: 9x on early skinny-K steps).
+    Rows are clipped inside the executable — eager slicing of big
+    arrays costs ~35 ms/op on the tunneled transport (measured r4)."""
+    N = W.shape[2]
+    lim = jax.lax.slice_in_dim(limbs, 0, N - s, axis=1)
     return jax.lax.dynamic_update_slice(
-        W, jax.lax.slice_in_dim(limbs, 0, N - s, axis=1), (0, s, s))
+        W, lim.transpose(0, 2, 1), (0, s, s))
 
 
 @partial(jax.jit, static_argnums=(3, 4))
@@ -593,17 +604,19 @@ def _jit_tile(slab, refine: int):
 @partial(jax.jit, static_argnums=(3, 4))
 def _jit_trail(A, W, scale, s: int, nb: int):
     """A[s:, s:s+nb] - (pair-dot of cached limbs) * outer(scales):
-    the N^3/3 bulk. Full arrays in, slicing INSIDE the executable
-    (eager big-array slices cost ~35 ms each on the tunneled
-    transport, measured r4); one executable per s."""
+    the N^3/3 bulk. ``W`` is the transposed cache Wt[l, col, row] —
+    lhs (K, M) and rhs (K, nb) slices come K-major off the same
+    column band Wt[:, :s, s:]. Full arrays in, slicing INSIDE the
+    executable (eager big-array slices cost ~35 ms each on the
+    tunneled transport, measured r4); one executable per s."""
     N = A.shape[0]
     K = s
     w, nl, kc = _plan(K, 53)
-    al = jax.lax.slice(W, (0, s, 0), (nl, N, K))
-    bl = jax.lax.slice(W, (0, s, 0), (nl, s + nb, K))
+    band = jax.lax.slice(W, (0, 0, s), (nl, K, N))   # (nl, K, N-s)
     slabA = jax.lax.slice(A, (s, s), (N, s + nb))
-    U = _pair_dot([al[i] for i in range(nl)],
-                  [bl[i] for i in range(nl)], K=K, w=w, nl=nl, kc=kc)
+    U = _pair_dot([band[i] for i in range(nl)],
+                  [jax.lax.slice_in_dim(band[i], 0, nb, axis=1)
+                   for i in range(nl)], K=K, w=w, nl=nl, kc=kc)
     out = slabA - U * (scale[s:] * scale[s:s + nb].T)
     return jnp.pad(out, ((0, s), (0, 0)))   # fixed (N, nb) for _jit_panel
 
@@ -620,7 +633,7 @@ def _potrf_f64_blocked_cached(A, nb: int, refine: int):
     nt = N // nb
     w, nl, _ = _plan(N, 53)
     scale = _row_norm_scales(jnp.diag(A))[:, None]
-    W = jnp.zeros((nl, N, N - nb), jnp.int8)
+    W = jnp.zeros((nl, N - nb, N), jnp.int8)   # transposed: [l, col, row]
     out = jnp.zeros((N, N), jnp.float64)
     for k in range(nt):
         s = k * nb
@@ -673,18 +686,21 @@ def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
         return _potrf_f64_blocked_cached(A, nb, refine)
     w, nl, kc = _plan(N, 53)
     scale = _row_norm_scales(jnp.diag(A))[:, None]
-    # preallocated stacked limb cache (nl, N, N-nb): column blocks are
-    # written in place by dynamic_update_slice — a growing concat
-    # re-copies the whole cache every step (~4 GB of traffic at
-    # N=8192, profiled r4)
-    W = jnp.zeros((nl, N, N - nb), jnp.int8)
+    # preallocated stacked limb cache, TRANSPOSED layout (nl, N-nb, N)
+    # = Wt[l, col, row]: trail products then contract K-major on both
+    # operands (measured r5: 29-175 TOPS for the row-major cache's
+    # minor-axis rhs vs 333-387 transposed). Column blocks are written
+    # in place by dynamic_update_slice — a growing concat re-copies
+    # the whole cache every step (~4 GB of traffic at N=8192,
+    # profiled r4)
+    W = jnp.zeros((nl, N - nb, N), jnp.int8)
     cols = []
     for k in range(nt):
         s = k * nb
         slab = A[s:, s:s + nb]
         if k:
-            U = _pair_dot([W[i, s:, :s] for i in range(nl)],
-                          [W[i, s:s + nb, :s] for i in range(nl)],
+            U = _pair_dot([W[i, :s, s:] for i in range(nl)],
+                          [W[i, :s, s:s + nb] for i in range(nl)],
                           K=s, w=w, nl=nl, kc=kc)
             slab = slab - U * (scale[s:] * scale[s:s + nb].T)
         Lkk, _ = _potrf_tile_ir(slab[:nb], refine=refine,
@@ -700,7 +716,8 @@ def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
         cols.append(colL)
         if k + 1 < nt:
             limbs = jnp.stack(_split_fixed(colL, scale[s:], w, nl))
-            W = jax.lax.dynamic_update_slice(W, limbs, (0, s, s))
+            W = jax.lax.dynamic_update_slice(
+                W, limbs.transpose(0, 2, 1), (0, s, s))
     out = [jnp.concatenate(
         [jnp.zeros((j * nb, nb), jnp.float64), c], axis=0)
         for j, c in enumerate(cols)]
