@@ -577,24 +577,28 @@ def getrf_cyclic(A: CyclicMatrix):
     return CyclicMatrix(out, desc), perm[:Mp]
 
 
-def _cqr2_panel(x, M: int, mb: int, eps: float, pdiag, ldiag, p, ct):
+def _cqr2_panel(x, M: int, mb: int, eps: float, pdiag, ldiag, p, ct,
+                axis: str = None):
     """Distributed CholeskyQR2 + TSQR-HR panel factorization (shared
-    by the QR and herbt sweeps; must run inside a shard_map body).
+    by the QR, herbt, and ge2gb sweeps; must run inside a shard_map
+    body).
 
-    ``x``: masked local panel rows (mloc, mb), distributed along 'p';
-    ``pdiag``/``ldiag``: owner rank and local tile slot of the
-    diagonal tile. Returns (packedtop, V1, T, Ub, q2): the packed top
-    block (sign-adjusted R above, V1 below), the replicated T, the
+    ``x``: masked local panel rows (mloc, mb), distributed along
+    ``axis`` (default 'p'; the ge2gb LQ half passes 'q' — the same
+    panel algebra in column coordinates); ``pdiag``/``ldiag``: owner
+    rank and local tile slot of the diagonal tile along that axis.
+    Returns (packedtop, V1, T, Ub, q2): the packed top block
+    (sign-adjusted R above, V1 below), the replicated T, the
     reconstruction's U (for V2 = q2 U^{-1}), and the distributed
     orthonormal factor q2."""
     from dplasma_tpu.kernels import blas as kb
     from dplasma_tpu.kernels import householder as hh
 
+    ax = axis or pmesh.ROW_AXIS
     eye = jnp.eye(mb, dtype=x.dtype)
 
     def cqr(xx, shift):
-        g = jax.lax.psum(kb.dot(xx, xx, ta=True, conj_a=True),
-                         pmesh.ROW_AXIS)
+        g = jax.lax.psum(kb.dot(xx, xx, ta=True, conj_a=True), ax)
         if shift:
             sft = 11.0 * (M * mb + mb * (mb + 1)) * eps
             g = g + (sft * jnp.trace(g).real.astype(
@@ -610,7 +614,7 @@ def _cqr2_panel(x, M: int, mb: int, eps: float, pdiag, ldiag, p, ct):
                   jax.lax.dynamic_slice_in_dim(q2, ldiag * mb, mb,
                                                axis=0),
                   jnp.zeros((mb, mb), x.dtype)),
-        pmesh.ROW_AXIS)
+        ax)
     packedtop, V1, T, Ub = hh.householder_reconstruct(
         topq, R, return_u=True)
     return packedtop, V1, T, Ub, q2
@@ -872,6 +876,159 @@ def heev_cyclic(A: CyclicMatrix):
     if d_.shape[0] == 1:
         return d_
     return jsl.eigh_tridiagonal(d_, e_, eigvals_only=True)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _ge2gb_cyclic_jit(data, desc: CyclicDesc, mesh):
+    """Distributed general dense -> upper band-bidiagonal reduction
+    over cyclic slabs (the dplasma_zgebrd_ge2gb stage 1, ref
+    src/zgebrd_ge2gb.jdf:1-1191; composed into the SVD chain by
+    zgesvd_wrapper.c). Panel k alternates:
+
+      * a QR half on column block k (rows >= k) — the geqrf_cyclic
+        step: distributed CholeskyQR2 + TSQR-HR along 'p', trailing
+        A <- Q^H A via psum_p(V^H A);
+      * an LQ half on row block k (columns >= k+1) — the SAME panel
+        algebra run along 'q' on the conjugate-transposed row strip,
+        trailing A <- A Q2^H via psum_q(A conj(V)).
+
+    Leaves R_k on diagonal tiles and L_k^H = ct(Rtilde) on the first
+    superdiagonal tiles: an upper block-bidiagonal band of bandwidth
+    mb whose singular values equal A's. V/T are discarded (values-only
+    jobz=N, as the reference CI drives it)."""
+    from dplasma_tpu.kernels import blas as kb
+
+    d = desc.dist
+    P, Q = d.P, d.Q
+    mb = desc.mb
+    assert desc.mb == desc.nb and desc.M == desc.N
+    KT = desc.MT
+    mloc = desc.MTL * mb
+    nloc = desc.NTL * mb
+    cplx = jnp.iscomplexobj(data)
+
+    def ct(x):
+        return x.conj().T if cplx else x.T
+
+    def cj(x):
+        return x.conj() if cplx else x
+
+    eps = float(jnp.finfo(
+        jnp.zeros((), data.dtype).real.dtype).eps)
+
+    def body(local):
+        A = local.reshape(mloc, nloc)
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        grow, gcol, gid, gcid = _slab_coords(desc, p, q)
+        A = _seed_pad_diag(A, desc, gid, gcid)
+        for k in range(KT):
+            pk = layout.owner(k, P, d.kp, d.ip)
+            qk = layout.owner(k, Q, d.kq, d.jq)
+            lrk = layout.local_index(k, P, d.kp)
+            lck = layout.local_index(k, Q, d.kq)
+            e = k * mb
+            # ---- QR half: column block k, rows >= k ----
+            cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
+            pan = jax.lax.psum(
+                jnp.where(q == qk, cs, jnp.zeros_like(cs)),
+                pmesh.COL_AXIS)
+            act = (gid >= e)[:, None]
+            x = jnp.where(act, pan, 0)
+            packedtop, V1, T, Ub, q2 = _cqr2_panel(
+                x, desc.M, mb, eps, pk, lrk, p, ct)
+            below = (gid >= e + mb)[:, None]
+            V2 = kb.trsm(Ub, q2, side="R", lower=False)
+            v1slab = jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros_like(q2), V1, lrk * mb, axis=0)
+            diagrow = ((grow == k) & (p == pk))[:, None]
+            Vloc = jnp.where(below, V2, jnp.where(diagrow, v1slab, 0))
+            # trailing cols > k: A <- A - V (T^H (V^H A))
+            S = jax.lax.psum(kb.dot(Vloc, A, ta=True, conj_a=True),
+                             pmesh.ROW_AXIS)
+            upd = kb.dot(Vloc, kb.dot(T, S, ta=True, conj_a=True))
+            trail = (gcid >= e + mb)[None, :]
+            A = A - jnp.where(trail, upd, 0)
+            # write column k: R on the diagonal tile, zeros below
+            Rw = jnp.triu(packedtop)
+            at_k = jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros_like(cs), Rw, lrk * mb, axis=0)
+            newcs = jnp.where(act, jnp.where(diagrow, at_k, 0), cs)
+            A = jnp.where(q == qk,
+                          jax.lax.dynamic_update_slice_in_dim(
+                              A, newcs, lck * mb, axis=1), A)
+            if k == KT - 1:
+                break
+            # ---- LQ half: row block k, columns >= k+1 ----
+            qk1 = layout.owner(k + 1, Q, d.kq, d.jq)
+            lck1 = layout.local_index(k + 1, Q, d.kq)
+            rs = jax.lax.dynamic_slice_in_dim(A, lrk * mb, mb, axis=0)
+            strip = jax.lax.psum(
+                jnp.where(p == pk, rs, jnp.zeros_like(rs)),
+                pmesh.ROW_AXIS)
+            actq = (gcid >= e + mb)[:, None]
+            xq = jnp.where(actq, ct(strip), 0)
+            packedq, V1q, Tq, Ubq, q2q = _cqr2_panel(
+                xq, desc.N, mb, eps, qk1, lck1, q, ct,
+                axis=pmesh.COL_AXIS)
+            beyond = (gcid >= e + 2 * mb)[:, None]
+            V2q = kb.trsm(Ubq, q2q, side="R", lower=False)
+            v1slabq = jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros_like(q2q), V1q, lck1 * mb, axis=0)
+            diagcol = ((gcol == k + 1) & (q == qk1))[:, None]
+            Vq = jnp.where(beyond, V2q,
+                           jnp.where(diagcol, v1slabq, 0))
+            # trailing rows > k: A <- A - (A conj(Vq)) conj(Tq) Vq^T
+            Y = jax.lax.psum(kb.dot(A, cj(Vq)), pmesh.COL_AXIS)
+            updr = kb.dot(kb.dot(Y, cj(Tq)), Vq.T)
+            rtrail = (gid >= e + mb)[:, None]
+            A = A - jnp.where(rtrail, updr, 0)
+            # write row k: ct(Rtilde) on the superdiagonal tile,
+            # zeros to its right
+            Lw = ct(jnp.triu(packedq))
+            at_c1 = jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros_like(rs), Lw, lck1 * mb, axis=1)
+            # only the owner rank-column of tile k+1 holds Lw; on any
+            # other rank local slot lck1 is a DIFFERENT global block
+            at_c1 = jnp.where(q == qk1, at_c1, jnp.zeros_like(at_c1))
+            rows = jax.lax.dynamic_slice_in_dim(A, lrk * mb, mb,
+                                                axis=0)
+            keepleft = (gcid < e + mb)[None, :]
+            newrow = jnp.where(keepleft, rows, at_c1)
+            A = jnp.where(p == pk,
+                          jax.lax.dynamic_update_slice_in_dim(
+                              A, newrow, lrk * mb, axis=0), A)
+        return A.reshape(1, 1, mloc, nloc)
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                               None),
+        out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None))
+    return f(data)
+
+
+def gebrd_ge2gb_cyclic(A: CyclicMatrix) -> CyclicMatrix:
+    """Distributed dense -> band-bidiagonal reduction (SVD stage 1) on
+    block-cyclic local storage (ref src/zgebrd_ge2gb.jdf). Square with
+    N % mb == 0 (the LQ panels need full real blocks, as herbt)."""
+    m = _mesh_of(A)
+    assert A.desc.mb == A.desc.nb and A.desc.M == A.desc.N
+    assert A.desc.M % A.desc.mb == 0, "ge2gb_cyclic: need N % mb == 0"
+    return CyclicMatrix(_ge2gb_cyclic_jit(A.data, A.desc, m), A.desc)
+
+
+def gesvd_cyclic(A: CyclicMatrix):
+    """Distributed singular values (the dplasma_zgesvd composition,
+    ref src/zgesvd_wrapper.c): ge2gb on the cyclic slabs, then the
+    band finishes per-rank through the existing band-bidiagonal
+    stage 2 (ops.eig), the way the reference ships its bidiagonal to
+    rank-0 LAPACK. Returns descending singular values (N,)."""
+    from dplasma_tpu.ops import eig as eig_mod
+
+    Bt = gebrd_ge2gb_cyclic(A).to_tile()
+    return eig_mod.gesvd(Bt)
 
 
 def qr_t_factor(Ts, A: TileMatrix) -> TileMatrix:
@@ -1143,6 +1300,407 @@ def herk_cyclic(A: CyclicMatrix) -> CyclicMatrix:
                        A.desc.dist)
     out = _herk_cyclic_jit(A.data, A.desc, cdesc, m)
     return CyclicMatrix(out, cdesc)
+
+
+def _row_pick(desc, grow_like, nloc_src: int):
+    """Index table mapping my local ROW ids (global column coordinate
+    ``grow_like`` per element) into a 'q'-axis all_gather of a row
+    slab reshaped (mb, Q*nloc_src): entry for element with global id g
+    is q_owner(g)*nloc_src + local_col(g). The column-coordinate twin
+    of the herk/potrf row-formation pick."""
+    d = desc.dist
+    gid = grow_like
+    t = gid // desc.nb
+    qj = (t // d.kq + d.jq) % d.Q
+    lj = (t // (d.kq * d.Q)) * d.kq + t % d.kq
+    idx = qj * nloc_src + lj * desc.nb + gid % desc.nb
+    return jnp.clip(idx, 0, d.Q * nloc_src - 1), (t < desc.NT)
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _trmm_cyclic_jit(adata, bdata, desc, bdesc, mesh, opts):
+    """Distributed left triangular MULTIPLY over cyclic slabs — B <-
+    op(T) B (the role of ref src/ztrmm_LLN.jdf on
+    parsec_matrix_block_cyclic). trans=N is the SUMMA loop with the T
+    column element-masked to its triangle; trans=C forms the lhs
+    conj(T(k, r)) by the 'q'-axis gather + column-coordinate pick."""
+    from dplasma_tpu.kernels import blas as kb
+
+    uplo, trans, unit = opts
+    lower = uplo == "L"
+    d = desc.dist
+    P, Q = d.P, d.Q
+    mb = desc.mb
+    KT = min(desc.MT, desc.NT)
+    mloc = desc.MTL * mb
+    nloc = desc.NTL * mb
+    nlocB = bdesc.NTL * bdesc.nb
+    cplx = jnp.iscomplexobj(adata)
+
+    def cj(x):
+        return x.conj() if cplx else x
+
+    def body(aloc, bloc):
+        A = aloc.reshape(mloc, nloc)
+        B = bloc.reshape(mloc, nlocB)
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        grow, gcol, gid, gcid = _slab_coords(desc, p, q)
+        C = jnp.zeros((mloc, nlocB), A.dtype)
+        for k in range(KT):
+            pk = layout.owner(k, P, d.kp, d.ip)
+            qk = layout.owner(k, Q, d.kq, d.jq)
+            lrk = layout.local_index(k, P, d.kp)
+            lck = layout.local_index(k, Q, d.kq)
+            ke = k * mb + jnp.arange(mb)              # block-k elem ids
+            # B block row k -> everyone in the column ('p' bcast)
+            br = jax.lax.dynamic_slice_in_dim(B, lrk * mb, mb, axis=0)
+            brow = jax.lax.psum(
+                jnp.where(p == pk, br, jnp.zeros_like(br)),
+                pmesh.ROW_AXIS)
+            if trans == "N":
+                # T's block column k ('q' bcast), element-masked
+                cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb,
+                                                  axis=1)
+                acol = jax.lax.psum(
+                    jnp.where(q == qk, cs, jnp.zeros_like(cs)),
+                    pmesh.COL_AXIS)
+                if lower:
+                    keep = gid[:, None] > ke[None, :]
+                else:
+                    keep = gid[:, None] < ke[None, :]
+                dg = (gid[:, None] == ke[None, :])
+                one = jnp.ones((), A.dtype)
+                acol = jnp.where(keep, acol,
+                                 jnp.where(dg, one if unit else acol,
+                                           0))
+                C = C + kb.dot(acol, brow)
+            else:
+                # lhs = conj(T(k, gid_r)): T row slab k ('p' bcast),
+                # gathered along 'q', column-coordinate pick
+                rs = jax.lax.dynamic_slice_in_dim(A, lrk * mb, mb,
+                                                  axis=0)
+                rowk = jax.lax.psum(
+                    jnp.where(p == pk, rs, jnp.zeros_like(rs)),
+                    pmesh.ROW_AXIS)
+                allr = jax.lax.all_gather(rowk, pmesh.COL_AXIS)
+                flat = allr.transpose(1, 0, 2).reshape(mb, Q * nloc)
+                idx, valid = _row_pick(desc, gid, nloc)
+                Wl = jnp.where(valid[:, None], cj(flat[:, idx].T), 0)
+                # Wl[r, t] = conj(T(ke_t, gid_r)): lower T has
+                # T(ke, r) nonzero for ke >= r, upper for ke <= r
+                if lower:
+                    keep = gid[:, None] < ke[None, :]
+                else:
+                    keep = gid[:, None] > ke[None, :]
+                dg = (gid[:, None] == ke[None, :])
+                one = jnp.ones((), A.dtype)
+                Wl = jnp.where(keep, Wl,
+                               jnp.where(dg, one if unit else Wl, 0))
+                C = C + kb.dot(Wl, brow)
+        return C.reshape(1, 1, mloc, nlocB)
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=(PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None),) * 2,
+        out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None))
+    return f(adata, bdata)
+
+
+def trmm_cyclic(A: CyclicMatrix, B: CyclicMatrix, trans: str = "N",
+                unit: bool = False, uplo: str = "L") -> CyclicMatrix:
+    """Distributed B <- op(T) B on block-cyclic local storage (left
+    side; ref src/ztrmm_LLN.jdf family). A and B share the grid and
+    row tiling."""
+    m = _mesh_of(A)
+    assert (A.desc.dist == B.desc.dist and A.desc.mb == B.desc.mb
+            and A.desc.M == B.desc.M), "trmm_cyclic: mismatched descs"
+    assert A.desc.mb == A.desc.nb, "trmm_cyclic needs square tiles"
+    t = trans.upper()
+    # 'T' aliases 'C' only for real data: the non-N branch conjugates
+    assert t in ("N", "C") or not jnp.iscomplexobj(A.data), \
+        "trmm_cyclic: complex plain-transpose not implemented"
+    out = _trmm_cyclic_jit(A.data, B.data, A.desc, B.desc, m,
+                           (uplo.upper(), t, unit))
+    return CyclicMatrix(out, B.desc)
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def _hemm_cyclic_jit(adata, bdata, desc, bdesc, mesh):
+    """Distributed C = A B with A Hermitian stored LOWER, over cyclic
+    slabs (the zhemm/zsymm left-side role, ref src/zhemm.jdf): per
+    k-step the stored column block serves rows >= k directly and rows
+    < k through its conjugate-transposed row strip (the 'q'-gather +
+    column-coordinate pick)."""
+    from dplasma_tpu.kernels import blas as kb
+
+    d = desc.dist
+    P, Q = d.P, d.Q
+    mb = desc.mb
+    KT = desc.MT
+    mloc = desc.MTL * mb
+    nloc = desc.NTL * mb
+    nlocB = bdesc.NTL * bdesc.nb
+    cplx = jnp.iscomplexobj(adata)
+
+    def cj(x):
+        return x.conj() if cplx else x
+
+    def body(aloc, bloc):
+        A = aloc.reshape(mloc, nloc)
+        B = bloc.reshape(mloc, nlocB)
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        grow, gcol, gid, gcid = _slab_coords(desc, p, q)
+        C = jnp.zeros((mloc, nlocB), A.dtype)
+        for k in range(KT):
+            pk = layout.owner(k, P, d.kp, d.ip)
+            qk = layout.owner(k, Q, d.kq, d.jq)
+            lrk = layout.local_index(k, P, d.kp)
+            lck = layout.local_index(k, Q, d.kq)
+            ke = k * mb + jnp.arange(mb)
+            br = jax.lax.dynamic_slice_in_dim(B, lrk * mb, mb, axis=0)
+            brow = jax.lax.psum(
+                jnp.where(p == pk, br, jnp.zeros_like(br)),
+                pmesh.ROW_AXIS)
+            # stored lower column block k: rows >= k (incl. diagonal)
+            cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
+            acol = jax.lax.psum(
+                jnp.where(q == qk, cs, jnp.zeros_like(cs)),
+                pmesh.COL_AXIS)
+            acol = jnp.where(gid[:, None] >= ke[None, :], acol, 0)
+            # rows < k: A(r, ke) = conj(A_stored(ke, r)) — row slab k
+            # gathered along 'q', picked at my rows' global columns
+            rs = jax.lax.dynamic_slice_in_dim(A, lrk * mb, mb, axis=0)
+            rowk = jax.lax.psum(
+                jnp.where(p == pk, rs, jnp.zeros_like(rs)),
+                pmesh.ROW_AXIS)
+            allr = jax.lax.all_gather(rowk, pmesh.COL_AXIS)
+            flat = allr.transpose(1, 0, 2).reshape(mb, Q * nloc)
+            idx, valid = _row_pick(desc, gid, nloc)
+            Wl = jnp.where(valid[:, None], cj(flat[:, idx].T), 0)
+            Wl = jnp.where(gid[:, None] < ke[None, :], Wl, 0)
+            C = C + kb.dot(acol + Wl, brow)
+        return C.reshape(1, 1, mloc, nlocB)
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=(PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None),) * 2,
+        out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None))
+    return f(adata, bdata)
+
+
+def hemm_cyclic(A: CyclicMatrix, B: CyclicMatrix) -> CyclicMatrix:
+    """Distributed C = A B with A Hermitian stored lower (left side;
+    ref src/zhemm.jdf on parsec_matrix_block_cyclic)."""
+    m = _mesh_of(A)
+    assert (A.desc.dist == B.desc.dist and A.desc.mb == B.desc.mb
+            and A.desc.M == B.desc.M and A.desc.M == A.desc.N), \
+        "hemm_cyclic: mismatched descs"
+    assert A.desc.mb == A.desc.nb, "hemm_cyclic needs square tiles"
+    out = _hemm_cyclic_jit(A.data, B.data, A.desc, B.desc, m)
+    return CyclicMatrix(out, B.desc)
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def _her2k_cyclic_jit(adata, bdata, desc, cdesc, mesh):
+    """Distributed C = A B^H + B A^H (lower stored) over cyclic slabs
+    (ref src/zher2k_LN.jdf): the herk_cyclic collectives doubled —
+    per column block one 'q'-bcast of each operand and one 'p'-gather
+    row formation of each, two local MXU matmuls."""
+    from dplasma_tpu.kernels import blas as kb
+
+    d = desc.dist
+    P, Q = d.P, d.Q
+    mb = desc.mb
+    mloc = desc.MTL * mb
+    nloc = desc.NTL * desc.nb
+    cplx = jnp.iscomplexobj(adata)
+
+    def ct(x):
+        return x.conj().T if cplx else x.T
+
+    def body(aloc, bloc):
+        A = aloc.reshape(mloc, nloc)
+        Bm = bloc.reshape(mloc, nloc)
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        grow, _, gid, _ = _slab_coords(desc, p, q)
+        ncloc = cdesc.NTL * cdesc.nb
+        gcol_c = _grow(cdesc.NTL, cdesc.nb, q, Q, d.kq, d.jq)
+        gcid_c = gcol_c * cdesc.nb + jnp.arange(ncloc) % cdesc.nb
+        C = jnp.zeros((mloc, ncloc), A.dtype)
+        jt = gcol_c
+        pj = (jt // d.kp + d.ip) % P
+        lj = (jt // (d.kp * P)) * d.kp + jt % d.kp
+        idx = jnp.clip(pj * mloc + lj * mb
+                       + jnp.arange(ncloc) % mb, 0, P * mloc - 1)
+        valid = (jt < desc.MT)[:, None]
+        for k in range(desc.NT):
+            qk = layout.owner(k, Q, d.kq, d.jq)
+            lck = layout.local_index(k, Q, d.kq)
+
+            def colof(X):
+                c = jax.lax.dynamic_slice_in_dim(
+                    X, lck * desc.nb, desc.nb, axis=1)
+                c = jax.lax.psum(
+                    jnp.where(q == qk, c, jnp.zeros_like(c)),
+                    pmesh.COL_AXIS)
+                allg = jax.lax.all_gather(c, pmesh.ROW_AXIS)
+                W = jnp.where(valid,
+                              allg.reshape(P * mloc, desc.nb)[idx], 0)
+                return c, W
+            acol, Wa = colof(A)
+            bcol, Wb = colof(Bm)
+            C = C + kb.dot(acol, ct(Wb)) + kb.dot(bcol, ct(Wa))
+        lower = (gid[:, None] >= gcid_c[None, :])
+        return jnp.where(lower, C, 0).reshape(1, 1, mloc, ncloc)
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=(PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None),) * 2,
+        out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None))
+    return f(adata, bdata)
+
+
+def her2k_cyclic(A: CyclicMatrix, B: CyclicMatrix) -> CyclicMatrix:
+    """Distributed C = A B^H + B A^H (lower stored, M x M) on
+    block-cyclic local storage (ref src/zher2k_LN.jdf). Square tiles;
+    A and B share shape and grid."""
+    m = _mesh_of(A)
+    assert (A.desc.dist == B.desc.dist and A.desc.mb == B.desc.mb
+            and A.desc.M == B.desc.M and A.desc.N == B.desc.N), \
+        "her2k_cyclic: mismatched descs"
+    assert A.desc.mb == A.desc.nb, "her2k_cyclic needs square tiles"
+    cdesc = CyclicDesc(A.desc.M, A.desc.M, A.desc.mb, A.desc.mb,
+                       A.desc.dist)
+    out = _her2k_cyclic_jit(A.data, B.data, A.desc, cdesc, m)
+    return CyclicMatrix(out, cdesc)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _lauum_cyclic_jit(adata, desc, mesh):
+    """Distributed LAUUM (lower): C = L^H L restricted to the lower
+    triangle, over cyclic slabs (ref src/zlauum_L.jdf) — a Gram sweep
+    over row blocks: lhs conj(L(k, r)) via the 'q'-gather pick, rhs
+    the broadcast row slab, one local MXU matmul per block row."""
+    from dplasma_tpu.kernels import blas as kb
+
+    d = desc.dist
+    P, Q = d.P, d.Q
+    mb = desc.mb
+    KT = desc.MT
+    mloc = desc.MTL * mb
+    nloc = desc.NTL * mb
+    cplx = jnp.iscomplexobj(adata)
+
+    def cj(x):
+        return x.conj() if cplx else x
+
+    def body(aloc):
+        A = aloc.reshape(mloc, nloc)
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        grow, gcol, gid, gcid = _slab_coords(desc, p, q)
+        C = jnp.zeros((mloc, nloc), A.dtype)
+        for k in range(KT):
+            pk = layout.owner(k, P, d.kp, d.ip)
+            lrk = layout.local_index(k, P, d.kp)
+            ke = k * mb + jnp.arange(mb)
+            rs = jax.lax.dynamic_slice_in_dim(A, lrk * mb, mb, axis=0)
+            rowk = jax.lax.psum(
+                jnp.where(p == pk, rs, jnp.zeros_like(rs)),
+                pmesh.ROW_AXIS)
+            # stored lower: row k holds columns <= k
+            rowk = jnp.where(ke[:, None] >= gcid[None, :], rowk, 0)
+            allr = jax.lax.all_gather(rowk, pmesh.COL_AXIS)
+            flat = allr.transpose(1, 0, 2).reshape(mb, Q * nloc)
+            idx, valid = _row_pick(desc, gid, nloc)
+            Wl = jnp.where(valid[:, None], cj(flat[:, idx].T), 0)
+            Wl = jnp.where(ke[None, :] >= gid[:, None], Wl, 0)
+            C = C + kb.dot(Wl, rowk)
+        lower = (gid[:, None] >= gcid[None, :])
+        return jnp.where(lower, C, 0).reshape(1, 1, mloc, nloc)
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                               None),
+        out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None))
+    return f(adata)
+
+
+def lauum_cyclic(A: CyclicMatrix) -> CyclicMatrix:
+    """Distributed L^H L (lower stored) on block-cyclic local storage
+    (ref src/zlauum_L.jdf)."""
+    m = _mesh_of(A)
+    assert A.desc.mb == A.desc.nb and A.desc.M == A.desc.N
+    return CyclicMatrix(_lauum_cyclic_jit(A.data, A.desc, m), A.desc)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _identity_cyclic_jit(data, desc, mesh):
+    def body(loc):
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        _, _, gid, gcid = _slab_coords(desc, p, q)
+        K = min(desc.M, desc.N)
+        eye = ((gid[:, None] == gcid[None, :])
+               & (gid < K)[:, None]).astype(loc.dtype)
+        return eye[None, None]
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                               None),
+        out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None))(data)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def _tri_mask_cyclic_jit(data, desc, mesh, lower):
+    def body(loc):
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        _, _, gid, gcid = _slab_coords(desc, p, q)
+        keep = (gid[:, None] >= gcid[None, :]) if lower else \
+            (gid[:, None] <= gcid[None, :])
+        return jnp.where(keep, loc[0, 0], 0)[None, None]
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                               None),
+        out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None))(data)
+
+
+def trtri_cyclic(A: CyclicMatrix, unit: bool = False,
+                 uplo: str = "L") -> CyclicMatrix:
+    """Distributed triangular inverse on block-cyclic local storage
+    (ref src/ztrtri_L.jdf): the solve-shaped sweep op(T) X = I over
+    the trsm_cyclic collectives (flops 3x the triangular-aware n^3/3
+    — the rhs's own triangularity is not exploited; an honest trade
+    for reusing the one battle-tested distributed solve)."""
+    m = _mesh_of(A)
+    eye = CyclicMatrix(_identity_cyclic_jit(A.data, A.desc, m),
+                       A.desc)
+    X = trsm_cyclic(A, eye, "N", unit=unit, uplo=uplo.upper())
+    out = _tri_mask_cyclic_jit(X.data, X.desc, m,
+                               uplo.upper() == "L")
+    return CyclicMatrix(out, X.desc)
+
+
+def potri_cyclic(L: CyclicMatrix) -> CyclicMatrix:
+    """Distributed POTRI from the cyclic Cholesky factor: A^{-1} =
+    L^{-H} L^{-1} = lauum(trtri(L)) without leaving the slabs (ref
+    src/zpotri_wrapper.c composing ztrtri + zlauum)."""
+    return lauum_cyclic(trtri_cyclic(L))
 
 
 @partial(jax.jit, static_argnums=(2, 3))

@@ -413,3 +413,137 @@ def test_herbt_heev_cyclic(devices8):
         w = np.asarray(cyclic.heev_cyclic(Ac))
         assert np.max(np.abs(w - w_ref)) / np.max(np.abs(w_ref)) \
             < 1e-12 * N
+
+
+@pytest.mark.parametrize("dist", [
+    Dist(P=2, Q=4, kp=1, kq=2),
+    Dist(P=4, Q=2, kp=2, kq=1, ip=1),
+])
+def test_trmm_cyclic_matches_dense(devices8, dist):
+    """Distributed triangular multiply (ref src/ztrmm_LLN.jdf family):
+    all four (uplo, trans) corners plus unit diagonal."""
+    mb, MT = 8, 4
+    N, nrhs = MT * mb, 24
+    rng = np.random.default_rng(6)
+    T = rng.standard_normal((N, N))
+    B = rng.standard_normal((N, nrhs))
+    Tt = TileMatrix.from_dense(jnp.asarray(T), mb, mb, dist)
+    Bt = TileMatrix.from_dense(jnp.asarray(B), mb, mb, dist)
+    m = mesh.make_mesh(dist.P, dist.Q)
+    with mesh.use_grid(m):
+        Tc = cyclic.CyclicMatrix.from_tile(Tt, dist)
+        Bc = cyclic.CyclicMatrix.from_tile(Bt, dist)
+        for uplo in ("L", "U"):
+            Tm = np.tril(T) if uplo == "L" else np.triu(T)
+            for trans in ("N", "C"):
+                op = Tm if trans == "N" else Tm.T
+                got = cyclic.trmm_cyclic(Tc, Bc, trans, uplo=uplo)
+                gd = np.asarray(got.to_tile().data)[:N, :nrhs]
+                np.testing.assert_allclose(gd, op @ B, rtol=1e-10,
+                                           atol=1e-8)
+        Tu = np.tril(T, -1) + np.eye(N)
+        got = cyclic.trmm_cyclic(Tc, Bc, "N", unit=True, uplo="L")
+        gd = np.asarray(got.to_tile().data)[:N, :nrhs]
+        np.testing.assert_allclose(gd, Tu @ B, rtol=1e-10, atol=1e-8)
+
+
+@pytest.mark.parametrize("dist", [
+    Dist(P=2, Q=4, kp=2, kq=2),
+    Dist(P=4, Q=2, kp=1, kq=1, jq=1),
+])
+def test_hemm_her2k_cyclic(devices8, dist):
+    """Distributed hemm (stored-lower Hermitian multiply, ref
+    src/zhemm.jdf) and her2k (ref src/zher2k_LN.jdf)."""
+    mb, MT = 8, 4
+    N, nrhs = MT * mb, 16
+    rng = np.random.default_rng(8)
+    a0 = rng.standard_normal((N, N))
+    H = a0 + a0.T
+    B = rng.standard_normal((N, nrhs))
+    # stored-lower input: upper triangle holds scratch that must not leak
+    stored = np.tril(H) + np.triu(rng.standard_normal((N, N)), 1)
+    Ht = TileMatrix.from_dense(jnp.asarray(stored), mb, mb, dist)
+    Bt = TileMatrix.from_dense(jnp.asarray(B), mb, mb, dist)
+    m = mesh.make_mesh(dist.P, dist.Q)
+    with mesh.use_grid(m):
+        Hc = cyclic.CyclicMatrix.from_tile(Ht, dist)
+        Bc = cyclic.CyclicMatrix.from_tile(Bt, dist)
+        got = cyclic.hemm_cyclic(Hc, Bc)
+        gd = np.asarray(got.to_tile().data)[:N, :nrhs]
+        np.testing.assert_allclose(gd, H @ B, rtol=1e-10, atol=1e-8)
+        # her2k on rectangular A, B
+        K = 16
+        A2 = rng.standard_normal((N, K))
+        B2 = rng.standard_normal((N, K))
+        At2 = TileMatrix.from_dense(jnp.asarray(A2), mb, mb, dist)
+        Bt2 = TileMatrix.from_dense(jnp.asarray(B2), mb, mb, dist)
+        Ac2 = cyclic.CyclicMatrix.from_tile(At2, dist)
+        Bc2 = cyclic.CyclicMatrix.from_tile(Bt2, dist)
+        got2 = cyclic.her2k_cyclic(Ac2, Bc2)
+        gd2 = np.asarray(got2.to_tile().data)[:N, :N]
+        ref2 = A2 @ B2.T + B2 @ A2.T
+        np.testing.assert_allclose(np.tril(gd2), np.tril(ref2),
+                                   rtol=1e-10, atol=1e-8)
+
+
+@pytest.mark.parametrize("dist", [
+    Dist(P=2, Q=4, kp=2, kq=1),
+    Dist(P=4, Q=2, kp=1, kq=2),
+])
+def test_trtri_lauum_potri_cyclic(devices8, dist):
+    """Distributed trtri/lauum/potri chain (ref src/ztrtri_L.jdf,
+    src/zlauum_L.jdf, zpotri_wrapper.c): inverse, Gram, and the
+    composed SPD inverse all verified against dense references."""
+    mb, MT = 8, 4
+    N = MT * mb
+    rng = np.random.default_rng(12)
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    Lf = np.linalg.cholesky(spd)
+    Lt = TileMatrix.from_dense(jnp.asarray(Lf), mb, mb, dist)
+    m = mesh.make_mesh(dist.P, dist.Q)
+    with mesh.use_grid(m):
+        Lc = cyclic.CyclicMatrix.from_tile(Lt, dist)
+        Xi = cyclic.trtri_cyclic(Lc)
+        gd = np.asarray(Xi.to_tile().data)[:N, :N]
+        np.testing.assert_allclose(gd, np.linalg.inv(Lf), rtol=1e-8,
+                                   atol=1e-8)
+        La = cyclic.lauum_cyclic(Lc)
+        ga = np.asarray(La.to_tile().data)[:N, :N]
+        np.testing.assert_allclose(np.tril(ga), np.tril(Lf.T @ Lf),
+                                   rtol=1e-9, atol=1e-8)
+        Pi = cyclic.potri_cyclic(Lc)
+        gp = np.asarray(Pi.to_tile().data)[:N, :N]
+        np.testing.assert_allclose(np.tril(gp),
+                                   np.tril(np.linalg.inv(spd)),
+                                   rtol=1e-7, atol=1e-7)
+
+
+@pytest.mark.parametrize("dist", [
+    Dist(P=2, Q=4, kp=2, kq=2),
+    Dist(P=4, Q=2, kp=1, kq=2),
+])
+def test_ge2gb_gesvd_cyclic(devices8, dist):
+    """Distributed SVD stage 1 (ref src/zgebrd_ge2gb.jdf): the QR/LQ
+    alternation on cyclic slabs leaves an upper band of bandwidth mb
+    with A's singular values; gesvd_cyclic finishes the chain."""
+    N, mb = 64, 8
+    rng = np.random.default_rng(21)
+    a = rng.standard_normal((N, N))
+    At = TileMatrix.from_dense(jnp.asarray(a), mb, mb, dist)
+    m = mesh.make_mesh(dist.P, dist.Q)
+    with mesh.use_grid(m):
+        Ac = cyclic.CyclicMatrix.from_tile(At, dist)
+        Bc = cyclic.gebrd_ge2gb_cyclic(Ac)
+        B = np.asarray(Bc.to_tile().data)[:N, :N]
+        # band structure: zero below the diagonal block row and right
+        # of the first superdiagonal block
+        for off in range(1, N):
+            assert np.abs(np.diagonal(B, -off)).max() < 1e-9, off
+        for off in range(2 * mb, N):
+            assert np.abs(np.diagonal(B, off)).max() < 1e-9, off
+        s_ref = np.linalg.svd(a, compute_uv=False)
+        s_band = np.linalg.svd(B, compute_uv=False)
+        assert np.abs(s_band - s_ref).max() / s_ref[0] < 1e-10
+        s_got = np.sort(np.asarray(cyclic.gesvd_cyclic(Ac)))[::-1]
+        assert np.abs(s_got - s_ref).max() / s_ref[0] < 1e-8
