@@ -147,6 +147,60 @@ def _limb_levels(al, bl, K: int, w: int, nl: int, kc: int,
     return levels
 
 
+def _pallas_epilogue_ok(levels, N: int) -> bool:
+    """Route the recombine through the Pallas double-single kernel?
+    Only on float-float backends (where DS width == the platform's
+    own f64), unchunked int32 levels, lane-aligned widths, and not
+    disabled via MCA ``dd_epilogue=off``."""
+    if not _ff_backend() or levels[0].dtype != jnp.int32:
+        return False
+    if N % 128 or levels[0].shape[0] % 8:
+        return False
+    from dplasma_tpu.utils import config as _cfg
+    if (_cfg.mca_get("dd_epilogue") or "auto").lower() == "off":
+        return False
+    from dplasma_tpu.kernels import pallas_dd
+    return pallas_dd.HAVE_PALLAS
+
+
+def _recombine_scale_base(levels, base, sa, sb, w: int):
+    """``base - (sa*sb) * sum_l levels[l] * 2^(-w(l+2))`` — the
+    epilogue that closes every exact limb product.  On the TPU
+    float-float backend this is ONE fused Pallas double-single pass
+    (kernels/pallas_dd.py; profiled r5 at ~60% of the blocked-dd
+    panel IR and half the trailing-update time when left to the x64
+    rewriter's emulated chain); elsewhere the exact emulated
+    recombine."""
+    if base is None and not isinstance(sa, jax.Array):
+        sa = jnp.asarray(sa)
+    N = levels[0].shape[1]
+    if _pallas_epilogue_ok(levels, N):
+        from dplasma_tpu.kernels import pallas_dd
+        return pallas_dd.recombine_base(levels, base, sa, sb, w)
+    U = _level_recombine(levels, w)
+    prod = U * (sa * sb)
+    return -prod if base is None else base - prod
+
+
+def gemm_residual(base, a, b, bits: int = 53):
+    """``base - a @ b`` at f64-equivalent accuracy with the limb
+    recombine and the subtraction fused into one epilogue pass — the
+    residual form every dd iterative-refinement step consumes
+    (_potrf_tile_ir / _panel_trsm_ir / lu_ir). Real f64 only."""
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "gemm_residual requires jax_enable_x64 (inputs would "
+            "silently truncate to f32, breaking the FP64 contract)")
+    a = jnp.asarray(a, jnp.float64)
+    b = jnp.asarray(b, jnp.float64)
+    K = a.shape[1]
+    w, nl, kc = _plan(K, bits)
+    al, sa, _ = _split_int(a, w, nl, axis=0)
+    bl, sb, _ = _split_int(b, w, nl, axis=1)
+    levels = _limb_levels(al, bl, K, w, nl, kc)
+    return _recombine_scale_base(levels, base, sa, sb, w)
+
+
 def gemm_f64(a, b, bits: int = 53, _nonfinite_mask: bool = True):
     """C = A @ B with f64-equivalent accuracy from int8 MXU matmuls.
 
@@ -173,7 +227,7 @@ def gemm_f64(a, b, bits: int = 53, _nonfinite_mask: bool = True):
     al, sa, ma = _split_int(a, w, nl, axis=0)   # row-scaled
     bl, sb, mb = _split_int(b, w, nl, axis=1)   # col-scaled
     levels = _limb_levels(al, bl, K, w, nl, kc)
-    out = _level_recombine(levels, w) * (sa * sb)
+    out = _recombine_scale_base(levels, None, -sa, sb, w)
     # NaN/Inf propagation: the digit cast would silently turn
     # non-finite entries into garbage integers (review r3); a bad
     # entry must poison its result row/column as a real matmul would
@@ -442,6 +496,14 @@ def _split_fixed_ff(x, scale, w: int, nl: int):
     return [o.astype(jnp.int8) for o in out]
 
 
+def _pair_dot_base(al, bl, base, sa, sb, K: int, w: int, nl: int,
+                   kc: int):
+    """``base - (sa*sb) * pair-dot`` with the epilogue fused (the
+    trailing-update form of the blocked sweeps)."""
+    levels = _limb_levels(al, bl, K, w, nl, kc, lhs_t=True)
+    return _recombine_scale_base(levels, base, sa, sb, w)
+
+
 def _pair_dot(al, bl, K: int, w: int, nl: int, kc: int):
     """Unscaled limb product sum_l 2^{-w(l+2)} sum_{i+j=l}
     al[i]^T @ bl[j]: ``al`` (K, M) and ``bl`` (K, N) — both K-major,
@@ -493,7 +555,7 @@ def _potrf_tile_ir(Akk, refine: int = 3, newton: int = 2,
     f32 = jnp.float32
     for r in range(refine):
         bits = refine_bits[min(r, len(refine_bits) - 1)]
-        E = Af - gemm_f64(L, L.T, bits=bits, _nonfinite_mask=False)
+        E = gemm_residual(Af, L, L.T, bits=bits)
         L32 = jnp.tril(L).astype(f32)
         Y = jnp.matmul(X32, E.astype(f32),
                        preferred_element_type=f32)
@@ -540,8 +602,7 @@ def _panel_trsm_ir(Lkk, slab, iters: int = 2):
         # noise floor sits below the eps32 seed error it corrects
         # (the same ladder argument as _potrf_tile_ir's refine_bits)
         bits = 32 if it == 0 and iters > 1 else 53
-        E = slab - gemm_f64(pan, Lkk.T, bits=bits,
-                            _nonfinite_mask=False)
+        E = gemm_residual(slab, pan, Lkk.T, bits=bits)
         pan = pan + rsolve(E.astype(f32)).astype(jnp.float64)
     return pan
 
@@ -614,10 +675,10 @@ def _jit_trail(A, W, scale, s: int, nb: int):
     w, nl, kc = _plan(K, 53)
     band = jax.lax.slice(W, (0, 0, s), (nl, K, N))   # (nl, K, N-s)
     slabA = jax.lax.slice(A, (s, s), (N, s + nb))
-    U = _pair_dot([band[i] for i in range(nl)],
-                  [jax.lax.slice_in_dim(band[i], 0, nb, axis=1)
-                   for i in range(nl)], K=K, w=w, nl=nl, kc=kc)
-    out = slabA - U * (scale[s:] * scale[s:s + nb].T)
+    out = _pair_dot_base([band[i] for i in range(nl)],
+                         [jax.lax.slice_in_dim(band[i], 0, nb, axis=1)
+                          for i in range(nl)], slabA, scale[s:],
+                         scale[s:s + nb].T, K=K, w=w, nl=nl, kc=kc)
     return jnp.pad(out, ((0, s), (0, 0)))   # fixed (N, nb) for _jit_panel
 
 
@@ -699,10 +760,10 @@ def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
         s = k * nb
         slab = A[s:, s:s + nb]
         if k:
-            U = _pair_dot([W[i, :s, s:] for i in range(nl)],
-                          [W[i, :s, s:s + nb] for i in range(nl)],
-                          K=s, w=w, nl=nl, kc=kc)
-            slab = slab - U * (scale[s:] * scale[s:s + nb].T)
+            slab = _pair_dot_base(
+                [W[i, :s, s:] for i in range(nl)],
+                [W[i, :s, s:s + nb] for i in range(nl)], slab,
+                scale[s:], scale[s:s + nb].T, K=s, w=w, nl=nl, kc=kc)
         Lkk, _ = _potrf_tile_ir(slab[:nb], refine=refine,
                                 need_inverse=False)
         if s + nb < N:
@@ -759,7 +820,7 @@ def lu_ir(pp, L, U, refine: int = 2):
         # convergence survives, halving the exact-product count).
         L1i = trtri_f64(L[:nb], lower=True, unit=True)
         Ui = trtri_f64(U, lower=False)
-        E = pp - gemm_f64(L, U)
+        E = gemm_residual(pp, L, U)
         G = gemm_f64(gemm_f64(L1i, E[:nb]), Ui)
         dU = gemm_f64(jnp.triu(G), U)
         dL1 = gemm_f64(L[:nb], jnp.tril(G, -1))

@@ -440,9 +440,11 @@ def _mr_cyclic(name: str, a, pend, P: int, Q: int, dt):
             A = _load_cyclic(pend, 8, 11, P, Q, dt, mesh)
             B = _load_cyclic(pend, 12, 15, P, Q, dt, mesh)
             prod = cyc.gemm_cyclic(A, B)
-            C = _load_cyclic(pend, 16, 19, P, Q, dt, mesh,
-                             zero=(beta == 0.0))
-            out = dt(alpha) * prod.data + dt(beta) * C.data
+            if beta == 0.0:   # PBLAS: C unreferenced — skip its load
+                out = dt(alpha) * prod.data
+            else:
+                C = _load_cyclic(pend, 16, 19, P, Q, dt, mesh)
+                out = dt(alpha) * prod.data + dt(beta) * C.data
             _scatter_cyclic(cyc.CyclicMatrix(out, prod.desc), pend,
                             16, 19, P, Q, dt)
             return 0

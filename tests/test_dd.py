@@ -318,3 +318,48 @@ def test_getrf_dd_eager_many_panels():
                            rtol=0, atol=0)
     finally:
         cfg.mca_set("dd_gemm", None)
+
+
+def test_pallas_recombine_base_matches_exact():
+    """The Pallas double-single epilogue (interpret mode here) must
+    match the exact emulated recombine to ~2^-45 relative — the DS
+    width contract (kernels/pallas_dd.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dplasma_tpu.kernels import dd, pallas_dd
+
+    if not pallas_dd.HAVE_PALLAS:
+        import pytest
+        pytest.skip("no pallas")
+    rng = np.random.default_rng(3)
+    M, N, nl, w = 64, 128, 8, 7
+    levels = [jnp.asarray(rng.integers(-2**30, 2**30, (M, N)),
+                          jnp.int32) for _ in range(nl)]
+    base = jnp.asarray(rng.standard_normal((M, N)) * 8.0)
+    sa = jnp.asarray(2.0 ** rng.integers(-2, 3, (M, 1)))
+    sb = jnp.asarray(2.0 ** rng.integers(-2, 3, (1, N)))
+    exact = np.asarray(base - dd._level_recombine(levels, w)
+                       * (sa * sb))
+    got = np.asarray(pallas_dd.recombine_base(levels, base, sa, sb, w,
+                                              interpret=True))
+    scale = np.abs(np.asarray(dd._level_recombine(levels, w)
+                              * (sa * sb))).max()
+    assert np.abs(got - exact).max() / scale < 2.0 ** -45
+
+
+def test_gemm_residual_matches_sub():
+    """gemm_residual(base, a, b) == base - gemm_f64(a, b) (the fused
+    epilogue path used by every dd IR step)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from dplasma_tpu.kernels import dd
+
+    rng = np.random.default_rng(5)
+    m, k, n = 48, 32, 40
+    a = jnp.asarray(rng.standard_normal((m, k)))
+    b = jnp.asarray(rng.standard_normal((k, n)))
+    base = jnp.asarray(rng.standard_normal((m, n)))
+    ref = np.asarray(base) - np.asarray(a) @ np.asarray(b)
+    got = np.asarray(dd.gemm_residual(base, a, b))
+    assert np.abs(got - ref).max() < 1e-12
