@@ -332,19 +332,79 @@ def trtri_f64(T, lower: bool = True, unit: bool = False, iters: int = 2):
 
 
 def trsm_f64(T, B, *, side="L", lower=True, trans="N", unit=False,
-             alpha=1.0):
-    """Triangular solve at f64-equivalent accuracy via multiplication by
-    the Newton-refined inverse (the GPU-standard trsm-via-trtri scheme;
-    here it also moves the flops onto the MXU limb path). Reads only
-    the named triangle of T."""
+             alpha=1.0, iters=2):
+    """Triangular solve at f64-equivalent accuracy: f32-inverse seed +
+    exact-residual iterative refinement.
+
+    Each refinement step costs ONE exact limb product (the residual;
+    first step rides the cheap bits=32 ladder rung) plus f32 MXU
+    applies of the seed inverse — the r4 Newton-trtri composition paid
+    ~4x that in exact nb^3 products and its emulated-f64 Newton chains
+    dominated the dd LU/QR sweeps' per-step time (profiled r5).  Error
+    contracts ~eps32*kappa(T) per step: 2 steps reach the kappa*eps64
+    floor for condition to ~1e5 (the Newton path's ~1e7 envelope is
+    kept for complex inputs, which stay on it).  Reads only the named
+    triangle of T."""
     T = jnp.asarray(T, _wdtype(T))
-    X = trtri_f64(T, lower=lower, unit=unit)
-    if trans == "T":
-        X = X.T
-    elif trans == "C":
-        X = X.conj().T
-    out = mm(X, B) if side == "L" else mm(B, X)
-    return alpha * out
+    if jnp.iscomplexobj(T) or jnp.iscomplexobj(B):
+        X = trtri_f64(T, lower=lower, unit=unit)
+        if trans == "T":
+            X = X.T
+        elif trans == "C":
+            X = X.conj().T
+        out = mm(X, B) if side == "L" else mm(B, X)
+        return alpha * out
+    B = jnp.asarray(B, jnp.float64)
+    f32 = jnp.float32
+    Tm = _take_triangle(T, lower, unit)
+    if trans in ("T", "C"):
+        Tm = Tm.T
+    n = Tm.shape[0]
+    # power-of-two row prescale: keeps the f32 seed solve in range for
+    # f64 magnitudes outside f32's span (as trtri_f64)
+    m_ = jnp.max(jnp.abs(Tm), axis=1, keepdims=True)
+    s = 0.25 * _pow2_scale_bits(jnp.where(m_ > 0, m_, 1.0))
+    Ts = Tm / s                           # exact pow2 row scale
+    lo_eff = lower != (trans in ("T", "C"))
+    Xi = jax.lax.linalg.triangular_solve(
+        Ts.astype(f32), jnp.eye(n, dtype=f32), left_side=True,
+        lower=lo_eff)
+
+    if side == "L":
+        Bs = B / s                        # (S T') X = B  ->  T' X = S^-1 B
+        # per-COLUMN power-of-two prescale of the rhs: each column
+        # solves independently and X is linear in it, so B magnitudes
+        # outside f32's range would otherwise Inf/flush the f32 seed
+        # and every f32-cast correction (the _panel_lu_dd bug class,
+        # review r3/r5)
+        mB = jnp.max(jnp.abs(Bs), axis=0, keepdims=True)
+        c = _pow2_scale_bits(jnp.where(mB > 0, mB, 1.0))
+        Bs = Bs / c
+        X = jnp.matmul(Xi, Bs.astype(f32),
+                       preferred_element_type=f32).astype(jnp.float64)
+        for it in range(iters):
+            bits = 32 if it == 0 and iters > 1 else 53
+            E = gemm_residual(Bs, Ts, X, bits=bits)
+            X = X + jnp.matmul(Xi, E.astype(f32),
+                               preferred_element_type=f32
+                               ).astype(jnp.float64)
+        X = X * c
+    else:
+        # X (S T') = B: solve Y T' = B for Y = X S, unscale exactly;
+        # per-ROW rhs prescale for f32 range safety (independent rows)
+        mB = jnp.max(jnp.abs(B), axis=1, keepdims=True)
+        c = _pow2_scale_bits(jnp.where(mB > 0, mB, 1.0))
+        Bc = B / c
+        X = jnp.matmul(Bc.astype(f32), Xi,
+                       preferred_element_type=f32).astype(jnp.float64)
+        for it in range(iters):
+            bits = 32 if it == 0 and iters > 1 else 53
+            E = gemm_residual(Bc, X, Ts, bits=bits)
+            X = X + jnp.matmul(E.astype(f32), Xi,
+                               preferred_element_type=f32
+                               ).astype(jnp.float64)
+        X = (X * c) / s[:, 0][None, :]
+    return alpha * X
 
 
 # ---------------------------------------------------------------------
@@ -610,16 +670,17 @@ def _panel_trsm_ir(Lkk, slab, iters: int = 2):
 @partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))
 def _cache_write(W, limbs, s: int):
     """In-place (donated) limb-cache column write. ``W`` is the
-    TRANSPOSED cache Wt[l, col, row] (nl, N-nb, N): the finished
-    column block's limbs (nl, N, nb; rows beyond N-s are zero pad)
-    land at Wt[:, s:s+nb, s:] transposed, so trail slices contract
-    K-major on the MXU (measured r5: 9x on early skinny-K steps).
-    Rows are clipped inside the executable — eager slicing of big
-    arrays costs ~35 ms/op on the tunneled transport (measured r4)."""
+    TRANSPOSED cache Wt[l, col, row] (nl, N-nb, N); ``limbs`` arrive
+    ALREADY transposed as (nl, nb, N) — _jit_panel splits colL.T so
+    the transpose fuses into the split's elementwise chain (an
+    explicit post-split int8 transpose measured ~95 ms/step) — and
+    land at Wt[:, s:s+nb, s:], so trail slices contract K-major on
+    the MXU (measured r5: 9x on early skinny-K steps). Row extent is
+    clipped inside the executable — eager slicing of big arrays costs
+    ~35 ms/op on the tunneled transport (measured r4)."""
     N = W.shape[2]
-    lim = jax.lax.slice_in_dim(limbs, 0, N - s, axis=1)
-    return jax.lax.dynamic_update_slice(
-        W, lim.transpose(0, 2, 1), (0, s, s))
+    lim = jax.lax.slice_in_dim(limbs, 0, N - s, axis=2)
+    return jax.lax.dynamic_update_slice(W, lim, (0, s, s))
 
 
 @partial(jax.jit, static_argnums=(3, 4))
@@ -638,7 +699,11 @@ def _jit_panel(slab, scale, s, nb: int, refine: int):
                             need_inverse=False)
     pan = _panel_trsm_ir(Lkk, slab[nb:])
     colL = jnp.concatenate([Lkk, pan], axis=0)
-    limbs = jnp.stack(_split_fixed(colL, sc, w, nl))
+    # split the TRANSPOSE: the cache stores Wt[l, col, row], and an
+    # explicit post-split int8 transpose measured ~95 ms/step at
+    # N=16384 (byte-granularity shuffles); transposing the f64 operand
+    # fuses into the split's elementwise chain instead
+    limbs = jnp.stack(_split_fixed(colL.T, sc[:, 0][None, :], w, nl))
     return colL, limbs
 
 
@@ -776,9 +841,8 @@ def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
             colL = Lkk
         cols.append(colL)
         if k + 1 < nt:
-            limbs = jnp.stack(_split_fixed(colL, scale[s:], w, nl))
-            W = jax.lax.dynamic_update_slice(
-                W, limbs.transpose(0, 2, 1), (0, s, s))
+            limbs = jnp.stack(_split_fixed(colL.T, scale[s:].T, w, nl))
+            W = jax.lax.dynamic_update_slice(W, limbs, (0, s, s))
     out = [jnp.concatenate(
         [jnp.zeros((j * nb, nb), jnp.float64), c], axis=0)
         for j, c in enumerate(cols)]
@@ -793,48 +857,59 @@ def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
 # ---------------------------------------------------------------------
 
 
-def lu_ir(pp, L, U, refine: int = 2):
+def lu_ir(pp, L, U, refine: int = 4):
     """Refine a seed factorization pp ~= L U to f64-equivalent accuracy
     (pp is the already-row-permuted panel, L (m,nb) unit-lower
     trapezoidal, U (nb,nb) upper).
 
     Correction step: with exact E = pp - L U, G = L1^{-1} E1 U^{-1}
     gives dU = triu(G) U, dL1 = L1 stril(G) (so dL1 U + L1 dU = E1),
-    and dL2 = (E2 - L2 dU) U^{-1} for the rows below.  The inverses
-    are Newton-refined ONCE (f64-accurate, nb-sized) and the two
-    E-sized products ride exact limb GEMMs, so convergence is genuinely
-    quadratic — f32 correction solves contract only ~eps32*kappa per
-    step (measured ~1/100: the round-3 first cut shipped 2000-unit
-    residuals that way).  Two steps from an eps32 seed reach f64 for
-    panel condition up to ~1e7.
+    and dL2 = (E2 - L2 dU) U^{-1} for the rows below.  ONLY the
+    residual E rides an exact limb product (first two steps on the
+    cheap bits=32 rung — their 2^-32 noise floor sits below the
+    corrections they drive); every solve/product is f32 against the
+    SEED inverses, capping contraction at ~eps32*kappa per step
+    (measured ~1/100), which refine=4 turns into ~1e-8 of the seed
+    error — at or below the kappa*eps64 floor for panel condition to
+    ~1e5.  The r4 form Newton-refined BOTH factor inverses to f64
+    inside every step (~4x the exact products, and its emulated-f64
+    chains dominated the dd LU sweep's per-step time — profiled r5).
     """
     nb = U.shape[0]
     f32 = jnp.float32
-    for _ in range(refine):
-        # Inverses of the CURRENT factors, Newton-refined to f64, and
-        # exact nb-sized correction products: f32 here caps the
-        # contraction at ~eps32*kappa per step (measured ~1/100 — the
-        # round-3 first cut shipped 2000-unit residuals that way).
-        # The one big product allowed to ride f32 is L2 @ dU, whose
-        # error is second order in the residual (measured: quadratic
-        # convergence survives, halving the exact-product count).
-        L1i = trtri_f64(L[:nb], lower=True, unit=True)
-        Ui = trtri_f64(U, lower=False)
-        E = gemm_residual(pp, L, U)
-        G = gemm_f64(gemm_f64(L1i, E[:nb]), Ui)
-        dU = gemm_f64(jnp.triu(G), U)
-        dL1 = gemm_f64(L[:nb], jnp.tril(G, -1))
+    n_ = jnp.arange(nb)
+    L1_32 = jnp.tril(L[:nb], -1).astype(f32).at[n_, n_].set(1.0)
+    U32 = jnp.triu(U).astype(f32)
+    eye = jnp.eye(nb, dtype=f32)
+    L1i = jax.lax.linalg.triangular_solve(
+        L1_32, eye, left_side=True, lower=True, unit_diagonal=True)
+    # exactly-singular panels (legal: LAPACK completes with a zero U
+    # diagonal and INFO>0) must not NaN-poison the refinement — the
+    # guarded inverse is finite, the singular column's residual is
+    # zero, so its (garbage-direction) correction vanishes and the
+    # honest zero diagonal survives for INFO detection
+    dg = jnp.diagonal(U32)
+    Ui = jax.lax.linalg.triangular_solve(
+        U32.at[n_, n_].set(jnp.where(dg == 0, 1.0, dg)), eye,
+        left_side=True, lower=False)
+
+    def f32mm(a, b):
+        return jnp.matmul(a, b, preferred_element_type=f32)
+
+    for r in range(refine):
+        bits = 32 if (r < 2 and refine > 2) else 53
+        E = gemm_residual(pp, L, U, bits=bits)
+        E32 = E.astype(f32)
+        G = f32mm(f32mm(L1i, E32[:nb]), Ui)
+        dU = f32mm(jnp.triu(G), U32)
+        dL1 = f32mm(L1_32, jnp.tril(G, -1))
         if L.shape[0] > nb:
-            LdU = jnp.matmul(
-                L[nb:].astype(f32), dU.astype(f32),
-                preferred_element_type=f32).astype(jnp.float64)
-            dL2 = gemm_f64(E[nb:] - LdU, Ui)
+            dL2 = f32mm(E32[nb:] - f32mm(L[nb:].astype(f32), dU), Ui)
             dL = jnp.concatenate([dL1, dL2], axis=0)
         else:
             dL = dL1
-        n_ = jnp.arange(nb)
-        L = jnp.tril(L + dL, -1).at[n_, n_].set(1.0)
-        U = jnp.triu(U + dU)
+        L = jnp.tril(L + dL.astype(jnp.float64), -1).at[n_, n_].set(1.0)
+        U = jnp.triu(U + dU.astype(jnp.float64))
     return L, U
 
 
@@ -845,9 +920,11 @@ def geqrt_f64(panel):
     every heavy product exact and every small factorization f32+IR).
 
     Returns (packed, V, T) in the CORE_zgeqrt layout.  Real f64;
-    requires a numerically full-rank panel with cond below ~1e7 (the
-    Gram matrix squares the condition and its Cholesky seeds in f32 —
-    same envelope as the f32 cholqr path's working-precision claim).
+    requires a numerically full-rank panel with cond below ~1e5 (the
+    Gram matrix squares the condition and its Cholesky seeds in f32;
+    the lean f32-correction IR in the reconstruction solves contracts
+    ~eps32*kappa per step — MCA ``qr_panel=lapack`` keeps the slow
+    rank-safe vendor panel for harder panels).
     """
     m, nb = panel.shape
     eps32 = float(jnp.finfo(jnp.float32).eps)
@@ -875,14 +952,16 @@ def geqrt_f64(panel):
     Ub = jnp.triu(p32).astype(jnp.float64)
     V1, Ub = lu_ir(b[:nb], V1, Ub)
     if m > nb:
-        Uinv = trtri_f64(Ub, lower=False)
-        V2 = gemm_f64(b[nb:], Uinv)
+        # V2 Ub = b2: right IR solve (one exact product per step —
+        # the r4 Newton trtri cost ~4x that, profiled r5)
+        V2 = trsm_f64(Ub, b[nb:], side="R", lower=False)
         v = jnp.concatenate([V1, V2], axis=0)
     else:
         v = V1
-    # T = -(Ub S^{-1}) V1^{-T};  S^{-1} = S (unimodular real)
-    Zt = trtri_f64(V1, lower=True, unit=True)   # V1^{-1}
-    t = gemm_f64(-(Ub * s[None, :]), Zt.T)
+    # T = -(Ub S^{-1}) V1^{-T} (S^{-1} = S, unimodular real):
+    # t V1^T = -(Ub S) as a right transposed IR solve
+    t = trsm_f64(V1, -(Ub * s[None, :]), side="R", lower=True,
+                 trans="T", unit=True)
     packed = _hh.reconstruct_pack(s, r, v, nb)
     return packed, v, t
 

@@ -250,6 +250,7 @@ def test_a2a_conversion_memory_bounded(devices8):
             stats.temp_size_in_bytes, full)
 
 
+@pytest.mark.slow
 def test_a2a_dispatch_via_mca(devices8):
     """MCA cyclic.convert=a2a routes the standard from_tile/to_tile
     through the exchange path (the accelerator default)."""
@@ -521,7 +522,8 @@ def test_trtri_lauum_potri_cyclic(devices8, dist):
 
 @pytest.mark.parametrize("dist", [
     Dist(P=2, Q=4, kp=2, kq=2),
-    Dist(P=4, Q=2, kp=1, kq=2),
+    pytest.param(Dist(P=4, Q=2, kp=1, kq=2),
+                 marks=pytest.mark.slow),
 ])
 def test_ge2gb_gesvd_cyclic(devices8, dist):
     """Distributed SVD stage 1 (ref src/zgebrd_ge2gb.jdf): the QR/LQ

@@ -18,8 +18,9 @@ def _err_units(out, a, b):
     return float(np.max(np.abs(out - refq) / (K * EPS * mag)))
 
 
-@pytest.mark.parametrize("M,K,N", [(64, 512, 64), (48, 4096, 32),
-                                   (33, 100, 57)])
+@pytest.mark.parametrize("M,K,N", [
+    pytest.param(64, 512, 64, marks=pytest.mark.slow),
+    (48, 4096, 32), (33, 100, 57)])
 def test_gemm_f64_equivalent(rng, M, K, N):
     # wide dynamic range stresses the per-row/col scaling
     a = rng.standard_normal((M, K)) * np.exp(rng.uniform(-8, 8, (M, 1)))
@@ -86,7 +87,8 @@ def test_dd_potrf_end_to_end(rng, N, nb, seed, uplo):
         cfg._MCA_OVERRIDES.pop("dd_gemm", None)
 
 
-@pytest.mark.parametrize("kappa", [1.0, 1e3, 1e6])
+@pytest.mark.parametrize("kappa", [
+    pytest.param(1.0, marks=pytest.mark.slow), 1e3, 1e6])
 def test_potrf_f64_refinement_accuracy(rng, kappa):
     """f32-seed + limb-IR tile Cholesky reaches f64-level residuals
     even for ill-conditioned tiles (the d-precision CORE_zpotrf role)."""
@@ -286,8 +288,11 @@ def test_split_fixed_ff_matches_bits(rng):
         assert (np.abs(rec - x) <= sc * tol).all(), split
 
 
+@pytest.mark.slow
 def test_getrf_dd_eager_many_panels():
-    """The eager shape-cached dd LU route (>8 panels, non-traced):
+    """[slow: ~107 s warm — the eager route compiles ~27 shape-cached
+    executables and the cost is trace/lowering, not compute]
+    The eager shape-cached dd LU route (>8 panels, non-traced):
     padded-panel pivot bookkeeping must match the getrf_1d contract
     (review r4: the route was only reachable on TPU bench runs)."""
     import jax
@@ -316,6 +321,27 @@ def test_getrf_dd_eager_many_panels():
         assert np.array_equal(np.asarray(pt), np.asarray(perm))
         assert np.allclose(np.asarray(LUt.data), np.asarray(LU.data),
                            rtol=0, atol=0)
+
+        # singular-panel pivot safety (ADVICE r4): with an exactly
+        # zero trailing column AND pad rows present (N % nb != 0), the
+        # pivot tie-break among all-zero candidates must keep pad-row
+        # indices out of perm[:N] — pinned here so a future pivot-
+        # search change cannot silently corrupt rows via the clipped
+        # gather. Reuses the shape-cached executables from above.
+        Ns = 140                        # pads to 144: 4 pad rows
+        As = generators.plrnt(Ns, Ns, nb, nb, seed=7,
+                              dtype=jnp.float64)
+        data = As.data.at[:, Ns - 1].set(0.0)
+        LUs, perms = lu_mod.getrf_1d(TileMatrix(data, As.desc))
+        ps = np.asarray(perms)[:Ns]
+        assert (ps < Ns).all(), ps[ps >= Ns]
+        xs = np.asarray(LUs.to_dense())
+        asd = np.asarray(TileMatrix(data, As.desc).to_dense())[ps]
+        Ls = np.tril(xs, -1)[:Ns, :Ns] + np.eye(Ns)
+        Us = np.triu(xs)[:Ns, :Ns]
+        rs = np.abs(asd - Ls @ Us).max() / (
+            np.abs(asd).max() * Ns * np.finfo(np.float64).eps)
+        assert rs < 60.0, rs
     finally:
         cfg.mca_set("dd_gemm", None)
 

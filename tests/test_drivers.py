@@ -31,6 +31,7 @@ def test_driver_runs_clean(prog, args, capsys):
     assert "FAILED" not in out
 
 
+@pytest.mark.slow
 def test_driver_distributed_grid(capsys):
     rc = main(["-N", "128", "-t", "16", "-P", "2", "-Q", "4", "-x"],
               prog="testing_dpotrf")

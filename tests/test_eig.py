@@ -42,6 +42,7 @@ def test_heev_eigenvalues(N, nb, dtype):
     assert np.allclose(np.sort(np.asarray(w)), ref, atol=1e-9 * N)
 
 
+@pytest.mark.slow
 def test_hetrd_tridiagonal_spectrum():
     N, nb = 32, 8
     A0 = generators.plghe(0.0, N, nb, seed=7, dtype=jnp.complex128)
@@ -67,8 +68,10 @@ def test_band_to_rect():
 
 @pytest.mark.parametrize("M,N,nb,dtype", [
     (48, 48, 12, jnp.float64),
-    (64, 48, 16, jnp.complex128),
-    (48, 64, 16, jnp.float64),
+    pytest.param(64, 48, 16, jnp.complex128,
+                 marks=pytest.mark.slow),
+    pytest.param(48, 64, 16, jnp.float64,
+                 marks=pytest.mark.slow),
 ])
 def test_gesvd_singular_values(M, N, nb, dtype):
     A0 = generators.plrnt(M, N, nb, nb, seed=3872, dtype=dtype)

@@ -52,7 +52,8 @@ def test_stream_complex_conj():
 
 
 @pytest.mark.parametrize("transa,transb", [
-    ("N", "N"), ("T", "C"),
+    ("N", "N"),
+    pytest.param("T", "C", marks=pytest.mark.slow),
     pytest.param("N", "C", marks=pytest.mark.slow),
     pytest.param("T", "N", marks=pytest.mark.slow)])
 def test_summa_matches_dot(devices8, transa, transb):

@@ -31,7 +31,9 @@ def test_hetrf_reconstruction(N, nb, dtype):
     assert np.abs(rec - a).max() / (np.abs(a).max() * N) < 1e-13
 
 
-@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+@pytest.mark.parametrize("dtype", [
+    jnp.float64,
+    pytest.param(jnp.complex128, marks=pytest.mark.slow)])
 def test_hesv_axmb(dtype):
     N, nrhs, nb = 96, 7, 16
     A0 = _herm_full(N, nb, dtype, shift=float(N))

@@ -102,6 +102,7 @@ def test_getrs_trans(trans):
     assert r < 1e-9, r
 
 
+@pytest.mark.slow
 def test_gesv_1d_axmb():
     N, nrhs, nb = 77, 13, 25   # odd tiles kept; 40s at 117 (1-core box)
     A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=jnp.float64)

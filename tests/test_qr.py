@@ -22,7 +22,9 @@ def _qr_parts(Af, Tf):
     (130, 130, 32), (93, 147, 25),
     pytest.param(147, 93, 25, marks=pytest.mark.slow),
     pytest.param(64, 64, 64, marks=pytest.mark.slow)])
-@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+@pytest.mark.parametrize("dtype", [
+    jnp.float64,
+    pytest.param(jnp.complex128, marks=pytest.mark.slow)])
 def test_geqrf_residual_orthogonality(M, N, nb, dtype):
     A0 = generators.plrnt(M, N, nb, nb, seed=3872, dtype=dtype)
     Af, Tf = jax.jit(qr.geqrf)(A0)
@@ -164,6 +166,7 @@ def test_stacked_qr_ts_tt_kernels():
                        np.asarray(ref), atol=1e-12)
 
 
+@pytest.mark.slow
 def test_geqrf_rec_matches_flat(rng):
     """Recursive-panel QR (-z/--HNB, ref zgeqrfr_*.jdf): same
     factorization contract as the flat sweep — Q R reproduces A and
