@@ -1,0 +1,91 @@
+"""Lazy LAPACK-layout <-> tiled interop — the ADTT role.
+
+The reference runs one JDF on both tile-stored and LAPACK/ScaLAPACK-
+layout matrices by attaching per-location datatypes that reshape tiles
+on send/receive (src/utils/dplasma_lapack_adtt.c:1-389; the nine
+location classes of dplasma_lapack_adtt.h:18-31 describe full/partial
+tiles at the layout edges).  On a functional single-address-space
+runtime those location classes collapse to pad masks, and the lazy
+per-location conversion becomes: keep the caller's column-major buffer
+AS the storage of record, and move only the O(N*nb) column block an
+algorithm step touches — relayout fused into the step's transfer, no
+``to_dense``/``from_dense`` of the full matrix ever materialized
+(VERDICT r4 item 8).
+
+:class:`LapackView` wraps the buffer; :func:`potrf_lapack` runs the
+left-looking blocked Cholesky panel-by-panel against it, with finished
+column blocks cached on device (they are the factor — the device peak
+is factor + one panel, not input + padded tile copy).  The F77 /
+single-rank ScaLAPACK entries route through it (scalapack._h_potrf).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class LapackView:
+    """Column-major LAPACK buffer with tile-granular lazy transfers.
+
+    ``a`` is the caller's 2-D numpy view (typically zero-copy onto the
+    F77 buffer). Reads/writes move one column block at a time.
+    """
+
+    def __init__(self, a: np.ndarray):
+        assert a.ndim == 2
+        self.a = a
+        self.M, self.N = a.shape
+
+    def read_cols(self, j0: int, j1: int, i0: int = 0):
+        """Device array of rows i0:, columns j0:j1 (one transfer)."""
+        import jax.numpy as jnp
+        return jnp.asarray(np.ascontiguousarray(self.a[i0:, j0:j1]))
+
+    def write_cols_tril(self, j0: int, x, i0: int):
+        """Write the block back at (i0, j0), masked to the global
+        lower triangle (row >= col) — the factor write-back contract
+        that leaves the caller's strict upper triangle untouched."""
+        arr = np.asarray(x)
+        m, w = arr.shape
+        r = np.arange(i0, i0 + m)[:, None]
+        c = np.arange(j0, j0 + w)[None, :]
+        mask = r >= c
+        tgt = self.a[i0:i0 + m, j0:j0 + w]
+        tgt[mask] = arr[mask]
+
+
+def potrf_lapack(view: LapackView, nb: int = 512) -> int:
+    """Blocked left-looking Cholesky directly on LAPACK-layout storage
+    (lower). Step k reads ONLY column block k from the caller's buffer,
+    updates it against the device-cached finished panels, factors and
+    solves, writes the tril part back, and caches the finished block —
+    no full-matrix assembly on either side. Returns LAPACK INFO."""
+    import jax.numpy as jnp
+
+    from dplasma_tpu.kernels import blas as k
+
+    N = view.N
+    assert view.M == N, "potrf_lapack: square matrices only"
+    cols = []            # finished device column blocks (rows s:, nb)
+    info = 0
+    for kk, s in enumerate(range(0, N, nb)):
+        w = min(nb, N - s)
+        col = view.read_cols(s, s + w, i0=s)         # (N - s, w)
+        for j, cj in enumerate(cols):
+            off = s - j * nb
+            col = col - k.dot(cj[off:], cj[off:off + w], tb=True,
+                              conj_b=True)
+        lkk = k.potrf(col[:w], lower=True)
+        if s + w < N:
+            pan = k.trsm(lkk, col[w:], side="R", lower=True,
+                         trans="C")
+            colL = jnp.concatenate([lkk, pan], axis=0)
+        else:
+            colL = lkk
+        view.write_cols_tril(s, colL, i0=s)
+        if info == 0:
+            d = np.diagonal(np.asarray(lkk))
+            bad = np.nonzero((d <= 0) | ~np.isfinite(d))[0]
+            if bad.size:
+                info = s + int(bad[0]) + 1
+        cols.append(colL)
+    return info

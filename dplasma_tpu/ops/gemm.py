@@ -189,18 +189,26 @@ def gemm_stream(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
 
 def gemm_summa(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
                transa: str = "N", transb: str = "N",
-               steps_per_panel: int = 1) -> TileMatrix:
+               steps_per_panel: int | None = None) -> TileMatrix:
     """SUMMA over the active P×Q mesh with explicitly scheduled panel
     broadcasts (zgemm_summa JDF analog).
 
     k advances in panels sized so each panel is owned by exactly one
     mesh row (for B) and one mesh column (for A); masked ``psum``
     broadcasts the panel along the other axis — the ICI realization of
-    the reference's pipelined ring broadcasts.
+    the reference's pipelined ring broadcasts. ``steps_per_panel`` > 1
+    splits each owner's block into that many broadcast panels, so a
+    step's matmul overlaps the next panel's broadcast (the pipelined
+    lookahead; MCA ``summa_steps``, default 2). Arbitrary shapes are
+    edge-padded to the mesh tiling INSIDE this routine (the reference
+    SUMMA handles any block-cyclic shape, zgemm_wrapper.c:79-101 —
+    the r4 fallback to the GSPMD dot on non-divisible shapes is gone).
     """
     m = pmesh.active()
     if m is None:
         return gemm_dot(alpha, A, B, beta, C, transa, transb)
+    if steps_per_panel is None:
+        steps_per_panel = config.mca_get_int("summa_steps", 2)
     Pn = m.shape[pmesh.ROW_AXIS]
     Qn = m.shape[pmesh.COL_AXIS]
 
@@ -210,14 +218,21 @@ def gemm_summa(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
     Mp, Kp = a.shape
     Np = bmat.shape[1]
 
-    # panel width: must divide both the p-block (Kp/P) and q-block (Kp/Q)
+    # panel width: must divide both the p-block (Kp/P) and q-block
+    # (Kp/Q) — edge-pad every extent to the mesh quantum (zero rows/
+    # cols contribute nothing; C crops after the shard_map)
     lcm = Pn * Qn // math.gcd(Pn, Qn)
-    if Mp % Pn or Np % Qn or Kp % (lcm * steps_per_panel):
-        # shapes don't tile the mesh — fall back to the GSPMD dot
-        return gemm_dot(alpha, A, B, beta, C, transa, transb)
-    kb = Kp // (lcm * steps_per_panel)
-    nsteps = Kp // kb
-    kq, kp = Kp // Qn, Kp // Pn
+    quant = lcm * max(steps_per_panel, 1)
+    Mp2 = -(-Mp // Pn) * Pn
+    Np2 = -(-Np // Qn) * Qn
+    Kp2 = -(-Kp // quant) * quant
+    if (Mp2, Np2, Kp2) != (Mp, Np, Kp):
+        a = jnp.pad(a, ((0, Mp2 - Mp), (0, Kp2 - Kp)))
+        bmat = jnp.pad(bmat, ((0, Kp2 - Kp), (0, Np2 - Np)))
+        cmat = jnp.pad(cmat, ((0, Mp2 - Mp), (0, Np2 - Np)))
+    kb = Kp2 // quant
+    nsteps = Kp2 // kb
+    kq, kp = Kp2 // Qn, Kp2 // Pn
     al = jnp.asarray(alpha, C.dtype)
     be = jnp.asarray(beta, C.dtype)
 
@@ -246,6 +261,8 @@ def gemm_summa(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
         local, mesh=m,
         in_specs=(spec2d, spec2d, spec2d),
         out_specs=spec2d)(a, bmat, cmat)
+    if (Mp2, Np2) != (Mp, Np):
+        out = out[:Mp, :Np]
     return TileMatrix(out, C.desc).zero_pad()
 
 

@@ -709,6 +709,15 @@ def _h_potrf(uplo, prec, n, pa, ia, ja, desca):
     u = _c(uplo).upper()
     av = _view(pa, desca, dt)
     a = _sub(av, ia, ja, n, n)
+    if u == "L":
+        # ADTT role: the caller's LAPACK-layout buffer IS the storage
+        # of record — the sweep reads/writes one column block at a
+        # time with relayout fused into the transfer; no full-matrix
+        # assembly on either side (ref dplasma_lapack_adtt.c's lazy
+        # per-location LAPACK<->TILED machinery)
+        from dplasma_tpu import adtt
+        return adtt.potrf_lapack(adtt.LapackView(a),
+                                 _tile_nb(desca, n, n))
     A = _to_tm(a, _tile_nb(desca, n, n))
     L = potrf_mod.potrf(A, u)
     info = int(info_mod.factor_info(L, u))

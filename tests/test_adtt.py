@@ -1,0 +1,69 @@
+"""Lazy LAPACK-layout execution (dplasma_tpu.adtt — the ADTT role,
+ref src/utils/dplasma_lapack_adtt.c): ops run panel-by-panel on the
+caller's column-major buffer with NO full-matrix assembly."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dplasma_tpu import adtt
+from dplasma_tpu.descriptors import TileMatrix
+
+
+@pytest.mark.parametrize("N,nb", [(96, 32), (100, 32), (64, 64)])
+def test_potrf_lapack_matches_cholesky(rng, N, nb):
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    a = np.asfortranarray(spd)
+    info = adtt.potrf_lapack(adtt.LapackView(a), nb)
+    assert info == 0
+    ref = np.linalg.cholesky(spd)
+    assert np.abs(np.tril(a) - ref).max() < 1e-9
+    # strict upper triangle untouched (the write-back contract)
+    assert np.array_equal(np.triu(a, 1), np.triu(spd, 1))
+
+
+def test_potrf_lapack_never_assembles(rng, monkeypatch):
+    """The lazy path must not materialize the full matrix: from_dense
+    and to_dense are tripwired for the whole run."""
+    def boom(*a, **k):
+        raise AssertionError("full-matrix assembly on the ADTT path")
+
+    monkeypatch.setattr(TileMatrix, "from_dense", boom)
+    monkeypatch.setattr(TileMatrix, "to_dense", boom)
+    N, nb = 96, 32
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    a = np.asfortranarray(spd)
+    info = adtt.potrf_lapack(adtt.LapackView(a), nb)
+    assert info == 0
+    assert np.abs(np.tril(a) - np.linalg.cholesky(spd)).max() < 1e-9
+
+
+def test_potrf_lapack_info_non_spd(rng):
+    N, nb = 64, 16
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    spd[40, 40] = -1e6       # break SPD inside the third panel
+    a = np.asfortranarray(spd)
+    info = adtt.potrf_lapack(adtt.LapackView(a), nb)
+    assert info > 0
+    assert 33 <= info <= 48  # within the failing panel
+
+
+def test_shim_pdpotrf_rides_adtt(rng, monkeypatch):
+    """The F77/ScaLAPACK single-rank lower potrf routes through the
+    LapackView path — no global assembly (VERDICT r4 item 8)."""
+    import dplasma_tpu.scalapack as sp
+
+    def boom(*a, **k):
+        raise AssertionError("pdpotrf assembled a global")
+
+    monkeypatch.setattr(sp, "_to_tm", boom)
+    N = 96
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    a = np.asfortranarray(spd)
+    desc = (1, 0, N, N, 32, 32, 0, 0, N)
+    info = sp._h_potrf(b"L", b"d", N, a.ctypes.data, 1, 1, desc)
+    assert info == 0
+    assert np.abs(np.tril(a) - np.linalg.cholesky(spd)).max() < 1e-9

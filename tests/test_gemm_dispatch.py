@@ -157,3 +157,36 @@ def test_mca_resolution_order(monkeypatch):
     finally:
         config._MCA_OVERRIDES.clear()
     assert "gemm.lookahead" in config.mca_help()
+
+
+def test_summa_nondivisible_shapes(devices8):
+    """SUMMA must ENGAGE (no GSPMD-dot fallback) on shapes that don't
+    tile the mesh: the edge pad happens inside the routine (VERDICT r4
+    item 9; ref zgemm_wrapper.c:79-101 handles arbitrary block-cyclic
+    shapes)."""
+    import numpy as np
+
+    m = pmesh.make_mesh(2, 4, devices=devices8)
+    calls = []
+    orig = gemm_mod.gemm_dot
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    gemm_mod.gemm_dot, saved = spy, orig
+    try:
+        with pmesh.use_grid(m):
+            # M=33 (not %2), N=37 (not %4), K=45 (not %lcm*steps)
+            A = mk(33, 45, 8, 8, 1)
+            B = mk(45, 37, 8, 8, 2)
+            C = mk(33, 37, 8, 8, 3)
+            got = gemm_mod.gemm_summa(1.5, A, B, -0.5, C)
+        assert not calls, "gemm_summa fell back to the GSPMD dot"
+        a = np.asarray(A.to_dense())
+        b = np.asarray(B.to_dense())
+        c = np.asarray(C.to_dense())
+        ref = 1.5 * a @ b - 0.5 * c
+        assert np.abs(np.asarray(got.to_dense()) - ref).max() < 1e-10
+    finally:
+        gemm_mod.gemm_dot = saved
