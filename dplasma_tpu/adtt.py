@@ -82,10 +82,14 @@ def potrf_lapack(view: LapackView, nb: int = 512) -> int:
         else:
             colL = lkk
         view.write_cols_tril(s, colL, i0=s)
-        if info == 0:
-            d = np.diagonal(np.asarray(lkk))
-            bad = np.nonzero((d <= 0) | ~np.isfinite(d))[0]
-            if bad.size:
-                info = s + int(bad[0]) + 1
+        d = np.diagonal(np.asarray(lkk))
+        if np.iscomplexobj(d):
+            d = d.real      # Hermitian factor diagonal is real
+        bad = np.nonzero((d <= 0) | ~np.isfinite(d))[0]
+        if bad.size:
+            # LAPACK contract: stop at the first non-PD panel (the
+            # failing block is written as computed; the trailing
+            # buffer stays untouched rather than NaN-clobbered)
+            return s + int(bad[0]) + 1
         cols.append(colL)
     return info

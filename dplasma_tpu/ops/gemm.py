@@ -208,7 +208,7 @@ def gemm_summa(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
     if m is None:
         return gemm_dot(alpha, A, B, beta, C, transa, transb)
     if steps_per_panel is None:
-        steps_per_panel = config.mca_get_int("summa_steps", 2)
+        steps_per_panel = config.mca_get_int("gemm.summa_steps", 2)
     Pn = m.shape[pmesh.ROW_AXIS]
     Qn = m.shape[pmesh.COL_AXIS]
 

@@ -160,6 +160,10 @@ mca_register("gemm.lookahead", "2",
 mca_register("runtime.scheduler", "wavefront",
              "Trace-time tile ordering policy (analog of the 8 PaRSEC "
              "scheduler modules, tests/common.c:35-45).")
+mca_register("gemm.summa_steps", "2",
+             "SUMMA broadcast panels per owner block (pipelined "
+             "lookahead; >1 overlaps a step's matmul with the next "
+             "panel's broadcast)")
 mca_register("lu.panel_ib", "0",
              "Sub-panel width for a nested in-panel LU sweep "
              "(0 = disabled; the LU custom call's cost is ~linear in "

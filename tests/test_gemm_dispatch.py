@@ -177,10 +177,14 @@ def test_summa_nondivisible_shapes(devices8):
     gemm_mod.gemm_dot, saved = spy, orig
     try:
         with pmesh.use_grid(m):
-            # M=33 (not %2), N=37 (not %4), K=45 (not %lcm*steps)
-            A = mk(33, 45, 8, 8, 1)
-            B = mk(45, 37, 8, 8, 2)
-            C = mk(33, 37, 8, 8, 3)
+            # tile size 5 makes the PADDED dense extents miss the
+            # mesh quantum (Mp=35 not %P=2, Kp=45 not %lcm*steps=8),
+            # so the in-routine edge pad/crop genuinely runs — with
+            # 8-wide tiles every padded extent is already divisible
+            # and the branch would go untested (review r5)
+            A = mk(33, 41, 5, 5, 1)
+            B = mk(41, 37, 5, 5, 2)
+            C = mk(33, 37, 5, 5, 3)
             got = gemm_mod.gemm_summa(1.5, A, B, -0.5, C)
         assert not calls, "gemm_summa fell back to the GSPMD dot"
         a = np.asarray(A.to_dense())
