@@ -297,29 +297,30 @@ def _panel_lu(panel, ib: int | None = None):
 
 
 # -- shape-cached dd LU sweep (eager) ----------------------------------
-# The QR treatment (ops.qr._dd_sweep_eager) applied to LU: eager
-# callers ride ONE fixed-(Npad, nb) panel executable + per-k trailing
-# executables. Zero-padded panel rows are PIVOT-SAFE: partial pivoting
-# never selects a zero row over a nonzero one, and an unselected zero
-# row stays zero and in place — so perm[:m] permutes only real rows.
+# Eager callers ride ONE fused executable per step k (panel + pivot
+# bookkeeping + trailing update), compiled per shrinking-window shape
+# and persistent-cached. r5 profiling of the r4 three-executables-per-
+# step form at N=8192: ~0.34 s of the 0.95 s run was per-exec dispatch
+# and ~half the panel time was the FIXED full-height seed LU — fusing
+# and factoring at the true height removes both. Zero-padded panel
+# rows remain PIVOT-SAFE: partial pivoting never selects a zero row
+# over a nonzero one, and an unselected zero row stays zero and in
+# place — so perm[:m] permutes only real rows.
 
 import functools as _functools
 
 import jax as _jax
 
 
-@_jax.jit
-def _jit_dd_lu_panel(pin):
-    return _panel_lu(pin)
-
-
-@_functools.partial(_jax.jit, static_argnums=(4,))
-def _jit_dd_lu_trail(rest, ids, panfull, permfull, bw: int):
+@_functools.partial(_jax.jit, static_argnums=(2,))
+def _jit_dd_lu_step(rest, ids, bw: int):
+    """One full LU step at the window's true shape: factor the bw-wide
+    panel, permute the trailing window, solve U12, Schur-update."""
     m, n = rest.shape
-    perm = lax.slice(permfull, (0,), (m,))
-    pan = lax.slice(panfull, (0, 0), (m, bw))
+    assert n >= bw, (n, bw)   # KT = min//bw keeps every window >= bw
+    pan, perm = _panel_lu(rest[:, :bw])
     idsp = ids[perm]
-    trail = lax.slice(rest, (0, bw), (m, n))
+    trail = rest[:, bw:]
     if n > bw:
         trail = trail[perm]
         u12 = k.trsm(pan[:bw], trail[:bw], side="L", lower=True,
@@ -334,7 +335,7 @@ def _jit_dd_lu_trail(rest, ids, panfull, permfull, bw: int):
 
 
 def _lu_sweep_dd_eager(X, bw: int):
-    """Eager twin of :func:`_lu_sweep` over shape-cached executables
+    """Eager twin of :func:`_lu_sweep` over per-step fused executables
     (same deferred-pivot bookkeeping and assembly)."""
     Mp, Np = X.shape
     KT = min(Mp, Np) // bw
@@ -342,12 +343,8 @@ def _lu_sweep_dd_eager(X, bw: int):
     rest = X
     ids = jnp.arange(Mp)
     packs, urows, step_ids = [], [], []
-    from dplasma_tpu.ops.qr import _jit_dd_panel_in
     for kk in range(KT):
-        pin = _jit_dd_panel_in(rest, bw, Mp)
-        panf, permf = _jit_dd_lu_panel(pin)
-        pan, idsp, u12, rest = _jit_dd_lu_trail(rest, ids, panf,
-                                                permf, bw)
+        pan, idsp, u12, rest = _jit_dd_lu_step(rest, ids, bw)
         packs.append(pan)
         urows.append(u12)
         step_ids.append(idsp)

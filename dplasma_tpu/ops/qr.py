@@ -37,46 +37,30 @@ from dplasma_tpu.parallel import mesh as pmesh
 # -- shape-cached dd QR sweep (eager) ----------------------------------
 # The monolithic traced dd sweep OOM-kills the tunnel compile helper
 # above N=2048 (each panel inlines the full geqrt_f64 limb graph —
-# ~30-40 exact-product subgraphs). Eager callers instead ride cached
-# executables: ONE fixed-(Npad, nb) panel compile reused across all
-# panels + cheap per-k apply/slice executables — the potrf treatment
-# (kernels.dd._potrf_f64_blocked_cached), applied to QR (VERDICT r4
-# item 2 residue).
+# ~30-40 exact-product subgraphs). Eager callers instead ride ONE
+# fused executable per step (panel at the TRUE shrinking height +
+# compact-WY trailing apply), compiled per window shape and
+# persistent-cached — r5 profiling of the r4 fixed-height three-exec
+# form showed ~1/3 of the run in per-exec dispatch and ~half the
+# panel time factoring zero pad rows.
 
-@partial(jax.jit, static_argnums=(1, 2))
-def _jit_dd_panel_in(rest, nb: int, npad: int):
-    m = rest.shape[0]
-    pin = jax.lax.slice(rest, (0, 0), (m, nb))
-    return jnp.pad(pin, ((0, npad - m), (0, 0)))
-
-
-@jax.jit
-def _jit_dd_panel(pin):
+@partial(jax.jit, static_argnums=(1,))
+def _jit_dd_qr_step(rest, nb: int):
     from dplasma_tpu.kernels import dd as _dd
-    return _dd.geqrt_f64(pin)
-
-
-@partial(jax.jit, static_argnums=(4,))
-def _jit_dd_apply(rest, vfull, T, packedfull, nb: int):
-    m, n = rest.shape
-    v = jax.lax.slice(vfull, (0, 0), (m, nb))
-    packed = jax.lax.slice(packedfull, (0, 0), (m, nb))
-    trail = jax.lax.slice(rest, (0, nb), (m, n))
+    n = rest.shape[1]
+    packed, v, T = _dd.geqrt_f64(rest[:, :nb])
+    trail = rest[:, nb:]
     if n > nb:
         trail = hh.apply_q(v, T, trail, trans="C")
-    return packed, trail[:nb], trail[nb:]
+    return packed, T, trail[:nb], trail[nb:]
 
 
 def _dd_sweep_eager(rest, nb: int, KT: int, NT: int):
-    """Eager dd QR sweep over shape-cached executables; same math as
-    the traced loop below (zero-padded panel rows factor exactly: the
-    Gram, q2 and V2 all vanish on pad rows)."""
-    npad = rest.shape[0]
+    """Eager dd QR sweep over per-step fused executables; same math as
+    the traced loop below."""
     panels, packs, rrows = [], [], []
     for _ in range(KT):
-        pin = _jit_dd_panel_in(rest, nb, npad)
-        packedf, vf, T = _jit_dd_panel(pin)
-        packed, rrow, rest = _jit_dd_apply(rest, vf, T, packedf, nb)
+        packed, T, rrow, rest = _jit_dd_qr_step(rest, nb)
         panels.append((None, T))
         packs.append(packed)
         rrows.append(rrow)
@@ -190,7 +174,7 @@ def geqrf(A: TileMatrix, *, panel_kernel=None) -> tuple[TileMatrix,
 
     if (use_dd and panel_kernel is None and KT > 1
             and not isinstance(rest, jax.core.Tracer)):
-        # eager callers: shape-cached executables (ONE panel compile)
+        # eager callers: per-step fused executables, persistent-cached
         # — the monolithic trace OOM-kills the compile helper > 2048
         panels, packs, rrows = _dd_sweep_eager(rest, nb, KT, NT)
     else:
