@@ -309,17 +309,15 @@ def main():
         # compile cost (~6-10 min at 2048/512 in r3); larger sizes get
         # their own cost_s so the gate prices them honestly.
         dd_potrf_cfgs = [dict(N=8192, nb=512), dict(N=4096, nb=512)]
-        # dd QR rides EAGER shape-cached executables (bench_geqrf dd
-        # branch): the traced monolith OOM-killed the compile helper
-        # above 2048; eager lands 8192 at 830 GF/s in ~400s cold /
-        # cached thereafter (r4). dd LU at 4096 compiles traced (941s
-        # cold, persistent-cached); 8192 stays off the LU ladder
-        # pending the same eager treatment.
-        dd_geqrf_cfgs = [dict(N=8192, nb=512, cost_s=500),
-                         dict(N=4096, nb=512, cost_s=350),
+        # dd QR/LU ride EAGER per-step fused executables (one compile
+        # per shrinking-window shape, persistent-cached). nb=1024
+        # measured 3-4x faster than 512 at N=8192 (r5: the per-step
+        # costs dominate at 16 steps; 1324 vs 336 GF/s for LU).
+        dd_geqrf_cfgs = [dict(N=8192, nb=1024, cost_s=500),
+                         dict(N=4096, nb=1024, cost_s=350),
                          dict(N=2048, nb=512)]
-        dd_getrf_cfgs = [dict(N=8192, nb=512, cost_s=600),
-                         dict(N=4096, nb=512, cost_s=600),
+        dd_getrf_cfgs = [dict(N=8192, nb=1024, cost_s=500),
+                         dict(N=4096, nb=1024, cost_s=400),
                          dict(N=2048, nb=512)]
         dd_cost = 420.0
     else:  # CI / smoke path: tiny shapes, same code

@@ -158,8 +158,17 @@ def _base_lu(panel, chunk: int | None = None):
     rather than a diagnostic (ADVICE r2; the reference's nopiv path
     has the same contract)."""
     m, ib = panel.shape
+    from dplasma_tpu.utils import config as _cfg
+    if (panel.dtype == jnp.float32
+            and (_cfg.mca_get("lu.pallas_panel") or "off").lower()
+            == "on" and m * ib * 4 <= 8 * 2 ** 20 and ib % 8 == 0):
+        # blocked register-tile Pallas panel (kernels/pallas_lu.py;
+        # VMEM-resident, JB-wide column blocks, rank-JB MXU updates) —
+        # opt-in while the vendor custom call holds the measured edge
+        from dplasma_tpu.kernels import pallas_lu
+        if pallas_lu.HAVE_PALLAS:
+            return pallas_lu.lu_panel(panel)
     if chunk is None:
-        from dplasma_tpu.utils import config as _cfg
         chunk = _cfg.mca_get_int("lu.panel_chunk", _LU_CHUNK)
     # A chunk narrower than the panel cannot elect ib candidates, and a
     # chunk in [ib, 2*ib) leaves C*ib >= m so the candidate recursion

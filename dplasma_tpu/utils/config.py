@@ -164,6 +164,9 @@ mca_register("gemm.summa_steps", "2",
              "SUMMA broadcast panels per owner block (pipelined "
              "lookahead; >1 overlaps a step's matmul with the next "
              "panel's broadcast)")
+mca_register("lu.pallas_panel", "off",
+             "on = factor f32 LU panels with the blocked Pallas "
+             "register-tile kernel instead of the vendor custom call")
 mca_register("lu.panel_ib", "0",
              "Sub-panel width for a nested in-panel LU sweep "
              "(0 = disabled; the LU custom call's cost is ~linear in "
