@@ -309,12 +309,15 @@ def main():
         # compile cost (~6-10 min at 2048/512 in r3); larger sizes get
         # their own cost_s so the gate prices them honestly.
         dd_potrf_cfgs = [dict(N=8192, nb=512), dict(N=4096, nb=512)]
-        # dd QR/LU ride EAGER per-step fused executables (one compile
-        # per shrinking-window shape, persistent-cached). nb=1024
-        # measured 3-4x faster than 512 at N=8192 (r5: the per-step
-        # costs dominate at 16 steps; 1324 vs 336 GF/s for LU).
-        dd_geqrf_cfgs = [dict(N=8192, nb=1024, cost_s=500),
-                         dict(N=4096, nb=1024, cost_s=350),
+        # dd QR rides EAGER per-step fused executables (one compile
+        # per shrinking-window shape, persistent-cached; r5: 952 GF/s
+        # at 8192/512 vs 671 at 8192/1024 — QR keeps nb=512, and the
+        # 16-step cold compile is why pre-warming the EXACT ladder
+        # configs before the driver's run matters). dd LU at nb=1024
+        # stays at <= 8 panels and rides the traced monolith (r5:
+        # 1324 GF/s at 8192/1024 vs 336 eager at 512).
+        dd_geqrf_cfgs = [dict(N=8192, nb=512, cost_s=600),
+                         dict(N=4096, nb=512, cost_s=350),
                          dict(N=2048, nb=512)]
         dd_getrf_cfgs = [dict(N=8192, nb=1024, cost_s=500),
                          dict(N=4096, nb=1024, cost_s=400),
