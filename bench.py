@@ -310,14 +310,15 @@ def main():
         # their own cost_s so the gate prices them honestly.
         dd_potrf_cfgs = [dict(N=8192, nb=512), dict(N=4096, nb=512)]
         # dd QR rides EAGER per-step fused executables (one compile
-        # per shrinking-window shape, persistent-cached; r5: 952 GF/s
-        # at 8192/512 vs 671 at 8192/1024 — QR keeps nb=512, and the
-        # 16-step cold compile is why pre-warming the EXACT ladder
-        # configs before the driver's run matters). dd LU at nb=1024
-        # stays at <= 8 panels and rides the traced monolith (r5:
-        # 1324 GF/s at 8192/1024 vs 336 eager at 512).
-        dd_geqrf_cfgs = [dict(N=8192, nb=512, cost_s=600),
-                         dict(N=4096, nb=512, cost_s=350),
+        # per shrinking-window shape, persistent-cached); nb=1024
+        # measured 671 GF/s at 8192 vs 582 at 512 via the bench
+        # harness, and halves the cold-compile bill (8 steps vs 16 —
+        # the 512 compile ate a full bench budget once; pre-warm the
+        # EXACT ladder configs before the driver's run). dd LU at
+        # nb=1024 stays at <= 8 panels and rides the traced monolith
+        # (r5: 1324 GF/s at 8192/1024 vs 336 eager at 512).
+        dd_geqrf_cfgs = [dict(N=8192, nb=1024, cost_s=500),
+                         dict(N=4096, nb=1024, cost_s=350),
                          dict(N=2048, nb=512)]
         dd_getrf_cfgs = [dict(N=8192, nb=1024, cost_s=500),
                          dict(N=4096, nb=1024, cost_s=400),
