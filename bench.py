@@ -385,7 +385,7 @@ def main():
         # after every mandatory entry has been captured; emit() keeps
         # the best dpotrf_f64equiv as the headline automatically.
         run_entry("dpotrf_f64equiv", bench_potrf,
-                  [dict(N=16384, nb=1024)], dd_bound, cost_s=600.0,
+                  [dict(N=16384, nb=1024)], dd_bound, cost_s=450.0,
                   dtype=jnp.float64, hi=3)
     emit()
 
