@@ -389,3 +389,21 @@ def test_gemm_residual_matches_sub():
     ref = np.asarray(base) - np.asarray(a) @ np.asarray(b)
     got = np.asarray(dd.gemm_residual(base, a, b))
     assert np.abs(got - ref).max() < 1e-12
+
+
+def test_trsm_f64_extreme_magnitudes(rng):
+    """The IR trsm's f32 seed must survive f64 magnitudes outside
+    f32's range (the pow2 prescales on BOTH operands — review r5):
+    huge and denormal-tiny rhs columns solve to full relative
+    accuracy instead of Inf/0."""
+    n, m = 64, 8
+    T = np.tril(rng.standard_normal((n, n))) + n * np.eye(n)
+    B = rng.standard_normal((n, m))
+    B[:, 0] *= 1e38
+    B[:, 1] *= 1e-38
+    X = np.asarray(dd.trsm_f64(jnp.asarray(T), jnp.asarray(B),
+                               side="L", lower=True))
+    ref = np.linalg.solve(T, B)
+    rel = np.abs(X - ref) / np.abs(ref).max(axis=0, keepdims=True)
+    assert np.isfinite(X).all()
+    assert rel.max() < 1e-10, rel.max()
