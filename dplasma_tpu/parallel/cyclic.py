@@ -1140,8 +1140,8 @@ def _trsm_cyclic_jit(adata, bdata, desc, bdesc, mesh, uplo, trans,
     """Distributed left triangular solve over cyclic local slabs (the
     role of the reference's ztrsm_LL* JDFs on
     parsec_matrix_block_cyclic, ref src/ztrsm_LLN.jdf:1-60): op(T) X =
-    B for T the lower (trans N/C) or upper (trans N) triangle of the
-    stored factor. The per-step collectives are the POTRF set —
+    B for T the named stored triangle, all trans (N/T/C) on either
+    uplo. The per-step collectives are the POTRF set —
     masked-psum panel broadcast along 'q', diagonal tile along 'p',
     and for trans=C a partial-sum psum along 'p' — so a solve after
     :func:`potrf_cyclic`/:func:`getrf_cyclic` never leaves the slabs
@@ -1149,7 +1149,6 @@ def _trsm_cyclic_jit(adata, bdata, desc, bdesc, mesh, uplo, trans,
     from dplasma_tpu.kernels import blas as kb
 
     lower = uplo == "L"
-    assert lower or trans == "N", "upper solve: trans=N only"
     d = desc.dist
     P, Q = d.P, d.Q
     mb = desc.mb
@@ -1161,7 +1160,11 @@ def _trsm_cyclic_jit(adata, bdata, desc, bdesc, mesh, uplo, trans,
     def ct(x):
         return x.conj().T if cplx else x.T
 
-    forward = lower and trans == "N"
+    # op(T) is effectively lower-triangular (forward substitution) for
+    # (lower, N) and (upper, C/T); backward otherwise — the masked
+    # partial-sum structure below is uplo-general (``off`` keeps only
+    # the already-solved rows' couplings)
+    forward = lower == (trans == "N")
 
     def body(aloc, bloc):
         A = aloc.reshape(mloc, desc.NTL * mb)
@@ -1193,9 +1196,12 @@ def _trsm_cyclic_jit(adata, bdata, desc, bdesc, mesh, uplo, trans,
             if trans == "N":
                 rhs = bk
             else:
-                # X_k = T_kk^{-H} (B_k - sum_{i>k} T_ik^H X_i): the
-                # partial sums ride one masked psum along 'p'
-                s = jax.lax.psum(kb.dot(ct(Tb), B), pmesh.ROW_AXIS)
+                # X_k = op(T)_kk^{-1} (B_k - sum_i op(T)_ik X_i): the
+                # partial sums ride one masked psum along 'p'; the
+                # coupling blocks must match the solve's op — plain
+                # transpose for trans=T, conjugate for C (review r5)
+                Tbt = Tb.T if trans == "T" else ct(Tb)
+                s = jax.lax.psum(kb.dot(Tbt, B), pmesh.ROW_AXIS)
                 rhs = bk - s
             xk = kb.trsm(Tkk, jnp.where(p == pk, rhs, 0), side="L",
                          lower=lower, trans=trans, unit=unit)
@@ -1220,9 +1226,9 @@ def _trsm_cyclic_jit(adata, bdata, desc, bdesc, mesh, uplo, trans,
 def trsm_cyclic(A: CyclicMatrix, B: CyclicMatrix, trans: str = "N",
                 unit: bool = False, uplo: str = "L") -> CyclicMatrix:
     """Distributed op(T) X = B on block-cyclic local storage (left
-    side; lower with ``trans`` N/C, upper with N — the POTRS/GETRS
-    building block, ref src/ztrsm_LLN.jdf). A and B share the grid; B
-    keeps its own column blocking."""
+    side; every (uplo, trans) corner — the POTRS/GETRS building
+    block, ref src/ztrsm_LLN.jdf). A and B share the grid; B keeps
+    its own column blocking."""
     m = _mesh_of(A)
     assert (A.desc.dist == B.desc.dist and A.desc.mb == B.desc.mb
             and A.desc.M == B.desc.M), "trsm_cyclic: mismatched descs"
@@ -1231,10 +1237,16 @@ def trsm_cyclic(A: CyclicMatrix, B: CyclicMatrix, trans: str = "N",
     return CyclicMatrix(out, B.desc)
 
 
-def potrs_cyclic(L: CyclicMatrix, B: CyclicMatrix) -> CyclicMatrix:
+def potrs_cyclic(L: CyclicMatrix, B: CyclicMatrix,
+                 uplo: str = "L") -> CyclicMatrix:
     """Solve A X = B from the distributed Cholesky factor without
     leaving the slabs (the pdpotrs / zpotrs_wrapper.c composition of
-    two distributed TRSMs)."""
+    two distributed TRSMs). ``uplo`` names the factor's storage:
+    A = L L^H (L) or A = U^H U (U)."""
+    assert uplo.upper() in ("L", "U"), uplo
+    if uplo.upper() == "U":
+        return trsm_cyclic(L, trsm_cyclic(L, B, "C", uplo="U"), "N",
+                           uplo="U")
     return trsm_cyclic(L, trsm_cyclic(L, B, "N"), "C")
 
 
@@ -1833,17 +1845,94 @@ def getrs_cyclic(LU: CyclicMatrix, perm, B: CyclicMatrix
     return trsm_cyclic(Lp, Y, "N", uplo="U")
 
 
+@partial(jax.jit, static_argnums=(1, 2))
+def _potrf_cyclic_upper_jit(data, desc: CyclicDesc, mesh):
+    """Upper-storage right-looking Cholesky (A = U^H U) — the lower
+    sweep with the mesh axes' roles mirrored: row-panel broadcast
+    along 'p', diagonal along 'q', column formation by all_gather
+    along 'q' + cyclic pick (ref src/zpotrf_U.jdf)."""
+    d = desc.dist
+    P, Q = d.P, d.Q
+    mb = desc.mb
+    assert desc.mb == desc.nb and desc.M == desc.N
+    KT = min(desc.MT, desc.NT)
+    mloc = desc.MTL * mb
+    nloc = desc.NTL * mb
+    cplx = jnp.iscomplexobj(data)
+
+    def body(local):
+        from dplasma_tpu.kernels import blas as kb
+        A = local.reshape(mloc, nloc)
+        p = jax.lax.axis_index(pmesh.ROW_AXIS)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        grow = _grow(desc.MTL, mb, p, P, d.kp, d.ip)
+        gcol = _grow(desc.NTL, mb, q, Q, d.kq, d.jq)
+        for k in range(KT):
+            pk = layout.owner(k, P, d.kp, d.ip)
+            qk = layout.owner(k, Q, d.kq, d.jq)
+            lrk = layout.local_index(k, P, d.kp)
+            lck = layout.local_index(k, Q, d.kq)
+            # 1) broadcast block row k along 'p' (row-panel bcast)
+            rs = jax.lax.dynamic_slice_in_dim(A, lrk * mb, mb, axis=0)
+            pan = jax.lax.psum(
+                jnp.where(p == pk, rs, jnp.zeros_like(rs)),
+                pmesh.ROW_AXIS)
+            # 2) broadcast diagonal tile along 'q'
+            dt = jax.lax.dynamic_slice_in_dim(pan, lck * mb, mb, axis=1)
+            ddt = jax.lax.psum(
+                jnp.where(q == qk, dt, jnp.zeros_like(dt)),
+                pmesh.COL_AXIS)
+            Ukk = kb.potrf(ddt, lower=False)
+            # 3) local row-panel solve (cols strictly right of k)
+            sol = kb.trsm(Ukk, pan, side="L", lower=False, trans="C")
+            right = (gcol > k)[None, :]
+            diagcol = ((gcol == k) & (q == qk))[None, :]
+            at_k = jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros_like(pan), Ukk, lck * mb, axis=1)
+            Upan = jnp.where(right, sol, jnp.where(diagcol, at_k, 0))
+            # 4) owners write the factored row panel back
+            keep = (gcol >= k)[None, :]
+            newrs = jnp.where(keep, Upan, rs)
+            A = jnp.where(p == pk,
+                          jax.lax.dynamic_update_slice_in_dim(
+                              A, newrs, lrk * mb, axis=0), A)
+            # 5) column formation: all_gather along 'q' + cyclic pick
+            allg = jax.lax.all_gather(Upan, pmesh.COL_AXIS)
+            flat = allg.transpose(1, 0, 2).reshape(mb, Q * nloc)
+            it = grow                                    # row tiles
+            qi = (it // d.kq + d.jq) % Q
+            li = (it // (d.kq * Q)) * d.kq + it % d.kq
+            idx = jnp.clip(qi * nloc + li * mb
+                           + jnp.arange(mloc) % mb, 0, Q * nloc - 1)
+            W = jnp.where((it > k)[:, None], flat[:, idx].T, 0)
+            # W[i, t] = U[k*mb+t, gid_i]; trailing A_ij -= conj(W_i) U_j
+            Uright = jnp.where(right, Upan, 0)
+            A = A - kb.dot(W.conj() if cplx else W, Uright)
+        return A.reshape(1, 1, mloc, nloc)
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                               None),
+        out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None))
+    return f(data)
+
+
 def potrf_cyclic(A: CyclicMatrix, uplo: str = "L") -> CyclicMatrix:
     """Distributed right-looking Cholesky on block-cyclic local storage
-    (the pdpotrf shape; ref src/zpotrf_L.jdf over
-    parsec_matrix_block_cyclic). Lower only; the global-array
+    (the pdpotrf shape; ref src/zpotrf_L.jdf / zpotrf_U.jdf over
+    parsec_matrix_block_cyclic). Both uplo storages; the global-array
     left-looking :func:`dplasma_tpu.ops.potrf.potrf` remains the
     single-chip path."""
-    assert uplo.upper() == "L", "cyclic potrf: lower storage only"
+    assert uplo.upper() in ("L", "U"), uplo
     m = pmesh.active()
     assert m is not None, "potrf_cyclic needs an active mesh (use_grid)"
     ms = (m.shape[pmesh.ROW_AXIS], m.shape[pmesh.COL_AXIS])
     assert ms == (A.desc.dist.P, A.desc.dist.Q), (
         f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
-    out = _potrf_cyclic_jit(A.data, A.desc, m)
+    if uplo.upper() == "U":
+        out = _potrf_cyclic_upper_jit(A.data, A.desc, m)
+    else:
+        out = _potrf_cyclic_jit(A.data, A.desc, m)
     return CyclicMatrix(out, A.desc)

@@ -369,18 +369,20 @@ def _mr_cyclic(name: str, a, pend, P: int, Q: int, dt):
     with pmesh.use_grid(mesh):
         if name == "potrf":
             uplo, prec, n, _, ia, ja, desca = a
-            if _c(uplo).upper() != "L" or not ok_desc(desca) \
+            u = _c(uplo).upper()
+            if u not in ("L", "U") or not ok_desc(desca) \
                     or not _whole(desca, ia, ja, n, n):
                 return None
             A = _load_cyclic(pend, 3, 6, P, Q, dt, mesh)
-            L = cyc.potrf_cyclic(A)
+            L = cyc.potrf_cyclic(A, u)
             info = _cyclic_diag_info(L)
-            _scatter_cyclic(L, pend, 3, 6, P, Q, dt, tri="L")
+            _scatter_cyclic(L, pend, 3, 6, P, Q, dt, tri=u)
             return info
         if name in ("potrs", "posv"):
             (uplo, prec, n, nrhs, _, ia, ja, desca,
              _, ib, jb, descb) = a
-            if (_c(uplo).upper() != "L" or not ok_desc(desca)
+            u = _c(uplo).upper()
+            if (u not in ("L", "U") or not ok_desc(desca)
                     or not ok_desc(descb, square=False)
                     or int(descb[_MB]) != int(desca[_MB])
                     or not same_src(desca, descb)
@@ -390,13 +392,13 @@ def _mr_cyclic(name: str, a, pend, P: int, Q: int, dt):
             A = _load_cyclic(pend, 4, 7, P, Q, dt, mesh)
             B = _load_cyclic(pend, 8, 11, P, Q, dt, mesh)
             if name == "posv":
-                A = cyc.potrf_cyclic(A)
+                A = cyc.potrf_cyclic(A, u)
                 info = _cyclic_diag_info(A)
                 if info:
                     return info
-            X = cyc.potrs_cyclic(A, B)
+            X = cyc.potrs_cyclic(A, B, uplo=u)
             if name == "posv":
-                _scatter_cyclic(A, pend, 4, 7, P, Q, dt, tri="L")
+                _scatter_cyclic(A, pend, 4, 7, P, Q, dt, tri=u)
             _scatter_cyclic(X, pend, 8, 11, P, Q, dt)
             return 0
         if name == "trsm":
@@ -404,9 +406,8 @@ def _mr_cyclic(name: str, a, pend, P: int, Q: int, dt):
              desca, _, ib, jb, descb) = a
             s, u, t, dg = (_c(x).upper() for x in (side, uplo, transa,
                                                    diag))
-            lower_ok = u == "L" and t in ("N", "T", "C")
-            upper_ok = u == "U" and t == "N"
-            if (s != "L" or not (lower_ok or upper_ok)
+            if (s != "L" or u not in ("L", "U")
+                    or t not in ("N", "T", "C")
                     or not ok_desc(desca)
                     or not ok_desc(descb, square=False)
                     or int(descb[_MB]) != int(desca[_MB])

@@ -549,3 +549,84 @@ def test_ge2gb_gesvd_cyclic(devices8, dist):
         assert np.abs(s_band - s_ref).max() / s_ref[0] < 1e-10
         s_got = np.sort(np.asarray(cyclic.gesvd_cyclic(Ac)))[::-1]
         assert np.abs(s_got - s_ref).max() / s_ref[0] < 1e-8
+
+
+@pytest.mark.parametrize("dist", [
+    Dist(P=2, Q=4, kp=2, kq=1),
+    Dist(P=4, Q=2, kp=1, kq=2, jq=1),
+])
+def test_potrf_potrs_cyclic_upper(devices8, dist):
+    """Upper-storage distributed Cholesky + solve (ref
+    src/zpotrf_U.jdf): A = U^H U factored and solved on slabs —
+    the r4 lower-only contract widened."""
+    mb, MT = 8, 4
+    N, nrhs = MT * mb, 16
+    rng = np.random.default_rng(14)
+    a0 = rng.standard_normal((N, N))
+    spd = a0 @ a0.T + N * np.eye(N)
+    X0 = rng.standard_normal((N, nrhs))
+    B0 = spd @ X0
+    At = TileMatrix.from_dense(jnp.asarray(np.triu(spd)), mb, mb, dist)
+    Bt = TileMatrix.from_dense(jnp.asarray(B0), mb, mb, dist)
+    m = mesh.make_mesh(dist.P, dist.Q)
+    with mesh.use_grid(m):
+        Ac = cyclic.CyclicMatrix.from_tile(At, dist)
+        Bc = cyclic.CyclicMatrix.from_tile(Bt, dist)
+        Uc = cyclic.potrf_cyclic(Ac, "U")
+        U = np.triu(np.asarray(Uc.to_tile().data))[:N, :N]
+        ref = np.linalg.cholesky(spd).T
+        np.testing.assert_allclose(U, ref, rtol=1e-8, atol=1e-8)
+        Xc = cyclic.potrs_cyclic(Uc, Bc, uplo="U")
+        X = np.asarray(Xc.to_tile().data)[:N, :nrhs]
+        np.testing.assert_allclose(X, X0, rtol=1e-6, atol=1e-6)
+
+
+def test_trsm_cyclic_all_corners(devices8):
+    """All four (uplo, trans) trsm corners on slabs (the r4 contract
+    allowed upper only with trans=N)."""
+    dist = Dist(P=2, Q=4, kp=1, kq=2)
+    mb, MT = 8, 4
+    N, nrhs = MT * mb, 24
+    rng = np.random.default_rng(15)
+    T = rng.standard_normal((N, N)) + N * np.eye(N)
+    B = rng.standard_normal((N, nrhs))
+    Tt = TileMatrix.from_dense(jnp.asarray(T), mb, mb, dist)
+    Bt = TileMatrix.from_dense(jnp.asarray(B), mb, mb, dist)
+    m = mesh.make_mesh(dist.P, dist.Q)
+    with mesh.use_grid(m):
+        Tc = cyclic.CyclicMatrix.from_tile(Tt, dist)
+        Bc = cyclic.CyclicMatrix.from_tile(Bt, dist)
+        for uplo in ("L", "U"):
+            Tm = np.tril(T) if uplo == "L" else np.triu(T)
+            for trans in ("N", "C"):
+                op = Tm if trans == "N" else Tm.T
+                Xc = cyclic.trsm_cyclic(Tc, Bc, trans, uplo=uplo)
+                X = np.asarray(Xc.to_tile().data)[:N, :nrhs]
+                np.testing.assert_allclose(X, np.linalg.solve(op, B),
+                                           rtol=1e-8, atol=1e-8)
+
+
+def test_trsm_cyclic_complex_T_vs_C(devices8):
+    """Complex plain-transpose vs conjugate-transpose must both be
+    right: the partial-sum coupling blocks follow the solve's op
+    (review r5 — a mixed conj/no-conj gave silently wrong T)."""
+    dist = Dist(P=2, Q=4)
+    mb, MT = 8, 3
+    N, nrhs = MT * mb, 8
+    rng = np.random.default_rng(16)
+    T = (rng.standard_normal((N, N)) + 1j * rng.standard_normal((N, N))
+         + 2 * N * np.eye(N))
+    B = rng.standard_normal((N, nrhs)) + 1j * rng.standard_normal(
+        (N, nrhs))
+    Tt = TileMatrix.from_dense(jnp.asarray(np.triu(T)), mb, mb, dist)
+    Bt = TileMatrix.from_dense(jnp.asarray(B), mb, mb, dist)
+    m = mesh.make_mesh(dist.P, dist.Q)
+    with mesh.use_grid(m):
+        Tc = cyclic.CyclicMatrix.from_tile(Tt, dist)
+        Bc = cyclic.CyclicMatrix.from_tile(Bt, dist)
+        for trans, op in (("T", np.triu(T).T),
+                          ("C", np.triu(T).conj().T)):
+            Xc = cyclic.trsm_cyclic(Tc, Bc, trans, uplo="U")
+            X = np.asarray(Xc.to_tile().data)[:N, :nrhs]
+            np.testing.assert_allclose(X, np.linalg.solve(op, B),
+                                       rtol=1e-9, atol=1e-9)
