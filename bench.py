@@ -211,12 +211,20 @@ def _dd_bound_products(K: int) -> int:
 
 
 def main():
+    from dplasma_tpu.observability import RunReport
+
     on_tpu = jax.default_backend() != "cpu"
     budget_s = float(os.environ.get(
         "DPLASMA_BENCH_BUDGET_S", "1500" if on_tpu else "600"))
     deadline = time.monotonic() + budget_s
-    ladder = []
-    peaks = {}
+    # the ladder and peak reads live in a versioned run-report; the
+    # printed one-line JSON doc (format unchanged — external parsers
+    # depend on it) is derived from the report state, and the full
+    # report is written to DPLASMA_BENCH_REPORT when set
+    report = RunReport("bench")
+    ladder = report.entries
+    peaks = report.extra.setdefault("peaks", {})
+    report.extra["budget_s"] = budget_s
 
     def remaining():
         return deadline - time.monotonic()
@@ -234,7 +242,7 @@ def main():
                        key=lambda x: x.get("vs_baseline", 0.0),
                        default={"metric": "none", "value": 0.0,
                                 "unit": "GFlop/s", "vs_baseline": 0.0})
-        print(json.dumps({
+        doc = {
             "metric": head["metric"] + f"_{jax.default_backend()}",
             "value": head["value"],
             "unit": head["unit"],
@@ -243,7 +251,18 @@ def main():
             "elapsed_s": round(budget_s - remaining(), 1),
             "ladder": ladder,
             "peaks": peaks,
-        }), flush=True)
+        }
+        report.extra["headline"] = {
+            k: doc[k] for k in ("metric", "value", "unit",
+                                "vs_baseline", "elapsed_s")}
+        print(json.dumps(doc), flush=True)
+        rp = os.environ.get("DPLASMA_BENCH_REPORT")
+        if rp:
+            try:
+                report.write(rp)
+            except OSError as exc:
+                print(f"#! cannot write bench report: {exc}",
+                      file=sys.stderr)
 
     def run_entry(name, fn, cfg_list, bound, cost_s=90.0, **fixed):
         """Measure one ladder entry with budget-gated size fallbacks.
@@ -272,6 +291,8 @@ def main():
                              "value": round(g, 2), "unit": "GFlop/s",
                              "vs_baseline": round((g / bound) / 0.70, 4)}
                     ladder.append(entry)
+                    report.metrics.gauge(
+                        "bench_gflops", metric=entry["metric"]).set(g)
                     emit()
                     return entry
                 except Exception as exc:  # noqa: BLE001

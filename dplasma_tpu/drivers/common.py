@@ -17,6 +17,7 @@ verbosity >= 5.
 """
 from __future__ import annotations
 
+import contextlib
 import sys
 import time
 from dataclasses import dataclass, field
@@ -29,6 +30,12 @@ PRECISIONS = {"s": "float32", "d": "float64", "c": "complex64",
               "z": "complex128"}
 
 SCHEDULERS = ("LFQ", "LTQ", "AP", "LHQ", "GD", "PBQ", "IP", "RND")
+
+# Implicit DAG-analytics cap (--report / -v>=3): the analytic tile-DAG
+# builders materialize O(tiles^1.5) tasks in Python, so past this many
+# tiles the run-report carries an explicit null instead (an explicit
+# --dot always builds the DAG).
+_DAG_TILE_CAP = 4096
 
 
 @dataclass
@@ -77,6 +84,10 @@ class IParam:
     scheduler: str = "LFQ"
     thread_multi: bool = False
     dot: Optional[str] = None
+    # observability outputs (--profile/--report/--jaxtrace)
+    profile: Optional[str] = None    # DTPUPROF1 binary trace
+    report: Optional[str] = None     # versioned JSON run-report
+    jaxtrace: Optional[str] = None   # JAX/XLA profiler logdir
     extra: list = field(default_factory=list)   # args after `--` (MCA-style)
 
     @property
@@ -118,6 +129,13 @@ Optional arguments:
  -c --cores -g --gpus -o --scheduler -V --vpmap -m : accepted for
                      compatibility (scheduling is compiled into XLA)
  --dot[=file]      : dump the trace-time tile DAG as graphviz
+ --profile[=file]  : write the binary DTPUPROF1 run trace (convert with
+                     tools/tracecat.py; default file: run.prof)
+ --report[=file]   : write the versioned JSON run-report (timings,
+                     per-run stats, XLA cost/memory analysis, comm
+                     model, DAG analytics; default file: report.json)
+ --jaxtrace[=dir]  : capture a device-side JAX/XLA profiler trace into
+                     dir (default: jax_trace)
  -h --help         : this message
 ENVIRONMENT
   [SDCZ]<FUNCTION> : per-precision priority limit (recorded, trace-time)
@@ -197,6 +215,12 @@ def _parse_arguments(args: list[str], ip: IParam) -> IParam:
                 ip.warmup = False
             elif name == "dot":
                 ip.dot = val if eq else "dag.dot"
+            elif name == "profile":
+                ip.profile = val if eq else "run.prof"
+            elif name == "report":
+                ip.report = val if eq else "report.json"
+            elif name == "jaxtrace":
+                ip.jaxtrace = val if eq else "jax_trace"
             elif name in _LONG:
                 field_, conv = _LONG[name]
                 if conv is None:
@@ -253,16 +277,58 @@ def _parse_arguments(args: list[str], ip: IParam) -> IParam:
     return ip
 
 
+def _algo_of(name: str) -> str:
+    """Precision-less algo name of a driver: testing_dpotrf -> potrf."""
+    base = name.rsplit("/", 1)[-1]
+    if base.startswith("testing_"):
+        rest = base[8:]
+        if rest[:1] in PRECISIONS and rest[1:]:
+            return rest[1:]
+        return rest
+    return base
+
+
+@contextlib.contextmanager
+def _jaxtrace_guard(logdir: str):
+    """--jaxtrace wrapper around the timed loop: profiler start/stop
+    failures (backend without a profiler plugin) degrade to a warning,
+    never a failed run."""
+    from dplasma_tpu.utils.profiling import jax_trace
+    cm = jax_trace(logdir)
+    try:
+        cm.__enter__()
+    except Exception as exc:
+        sys.stderr.write(f"#! jax profiler unavailable: {exc}\n")
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            cm.__exit__(None, None, None)
+        except Exception as exc:
+            sys.stderr.write(f"#! jax profiler stop failed: {exc}\n")
+
+
 class Driver:
     """Per-run context: devices, mesh, timing, reporting."""
 
     def __init__(self, ip: IParam, name: str):
         import jax
+        from dplasma_tpu.observability.report import RunReport
+        from dplasma_tpu.utils.profiling import Profile
+
         from dplasma_tpu.parallel import mesh as pmesh
 
         self.ip = ip
         self.name = name
         self.mesh = None
+        # observability: one profile + one run-report per driver run
+        # (written at close() when --profile/--report asked for them)
+        self.prof = Profile(rank=ip.rank)
+        self.prof.save_info("driver", name)
+        self.prof.save_info("prec", getattr(ip, "prec", "d"))
+        self.report = RunReport(name, ip)
         try:
             # cache now: the lookup can fail after a backend error
             self._cpu = jax.devices("cpu")[0]
@@ -281,6 +347,21 @@ class Driver:
             self._cm.__enter__()
 
     def close(self):
+        ip = self.ip
+        if getattr(ip, "profile", None):
+            try:
+                self.prof.write(ip.profile)
+                if ip.rank == 0 and ip.loud >= 1:
+                    print(f"#+ profile trace written to {ip.profile}")
+            except OSError as exc:
+                sys.stderr.write(f"#! cannot write profile: {exc}\n")
+        if getattr(ip, "report", None):
+            try:
+                self.report.write(ip.report)
+                if ip.rank == 0 and ip.loud >= 1:
+                    print(f"#+ run-report written to {ip.report}")
+            except OSError as exc:
+                sys.stderr.write(f"#! cannot write report: {exc}\n")
         if self._cm:
             self._cm.__exit__(None, None, None)
             self._cm = None
@@ -296,58 +377,101 @@ class Driver:
             x = leaves[0]
             np.asarray(x[(0,) * getattr(x, "ndim", 0)])
 
+    def _comm_model(self):
+        """Analytic comm-volume model for this driver's op class (None
+        when the op has no model — the report shows an explicit null)."""
+        import numpy as _np
+
+        from dplasma_tpu.descriptors import Dist
+        from dplasma_tpu.observability.comm import comm_volume_model
+        ip = self.ip
+        try:
+            itemsize = _np.dtype(PRECISIONS[ip.prec]).itemsize
+            return comm_volume_model(
+                _algo_of(self.name), ip.M, ip.N, ip.K, ip.MB, ip.NB,
+                itemsize, Dist(P=ip.P, Q=ip.Q, kp=ip.kp, kq=ip.kq))
+        except Exception:
+            return None
+
     def progress(self, fn: Callable, args: tuple, flops: float,
                  label: Optional[str] = None, dag_fn: Callable = None):
         """Compile, run nruns times, print the reference-format perf line.
 
         ENQ = trace+compile (the taskpool-construction analog),
         PROG = best device execution time, DEST = teardown (~0 here).
-        Returns (output, gflops).
+        Every phase lands in ``self.prof`` (DTPUPROF1 spans) and an op
+        entry in ``self.report`` (per-run stats, XLA cost/memory
+        analysis, comm model, DAG analytics). Returns (output, gflops).
         """
         import jax
+
+        from dplasma_tpu.observability.xla import capture_compiled
+        from dplasma_tpu.utils import profiling
         ip, name = self.ip, label or self.name
         jfn = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
         t0 = time.perf_counter()
-        try:
-            lowered = jfn.lower(*args)
-            compiled = lowered.compile()
-        except Exception:
-            # Device-chore fallback (the reference's multi-chore body
-            # selection, zpotrf_L.jdf:540-555): some ops lack an
-            # accelerator lowering for this dtype (e.g. f64
-            # LuDecomposition on TPU) — rerun the whole taskpool on the
-            # host backend. (Catch is broad: backend compile errors
-            # surface as several exception types; a genuine trace bug
-            # reproduces identically on the host and is re-raised there.)
-            cpu = getattr(self, "_cpu", None)
-            if cpu is None or jax.default_backend() == "cpu":
-                raise
-            if ip.rank == 0 and ip.loud >= 1:
-                print("#+ no accelerator chore for this op/dtype; "
-                      "falling back to the host backend")
-            with jax.default_device(cpu):
-                args = jax.device_put(args, cpu)
-                jfn = jax.jit(fn)
+        with self.prof.span(f"enq:{name}"):
+            try:
                 lowered = jfn.lower(*args)
                 compiled = lowered.compile()
+            except Exception:
+                # Device-chore fallback (the reference's multi-chore body
+                # selection, zpotrf_L.jdf:540-555): some ops lack an
+                # accelerator lowering for this dtype (e.g. f64
+                # LuDecomposition on TPU) — rerun the whole taskpool on
+                # the host backend. (Catch is broad: backend compile
+                # errors surface as several exception types; a genuine
+                # trace bug reproduces identically on the host and is
+                # re-raised there.)
+                cpu = getattr(self, "_cpu", None)
+                if cpu is None or jax.default_backend() == "cpu":
+                    raise
+                if ip.rank == 0 and ip.loud >= 1:
+                    print("#+ no accelerator chore for this op/dtype; "
+                          "falling back to the host backend")
+                with jax.default_device(cpu):
+                    args = jax.device_put(args, cpu)
+                    jfn = jax.jit(fn)
+                    lowered = jfn.lower(*args)
+                    compiled = lowered.compile()
         enq = time.perf_counter() - t0
-        if ip.dot:
-            # --dot analog (tests/common.c:406-431). When the op exposes
-            # an analytic tile-DAG builder, emit true Graphviz of task
-            # classes/priorities/owner ranks; otherwise fall back to the
-            # lowered XLA program text.
-            if dag_fn is not None:
-                from dplasma_tpu.utils.profiling import DagRecorder
-                rec = DagRecorder(enabled=True)
+        # XLA-side capture + comm model only when something consumes
+        # them (--report): the un-instrumented driver path stays as
+        # cheap as before this layer existed
+        xla_info = capture_compiled(compiled) if ip.report else None
+        dag_info = None
+        # analytic DAG construction is cubic-ish in tile count; the
+        # implicit consumers (--report, -v>=3) cap it, the explicit
+        # --dot opt-in always honors the request. K tiles count too:
+        # the GEMM DAG is MT*NT*KT tasks.
+        tiles = max(-(-ip.M // max(ip.MB, 1)), 1) * \
+            max(-(-ip.N // max(ip.NB, 1)), 1) * \
+            max(-(-ip.K // max(ip.NB, 1)), 1)
+        want_dag = dag_fn is not None and (
+            ip.dot or ((ip.report or ip.loud >= 3)
+                       and tiles <= _DAG_TILE_CAP))
+        if want_dag:
+            from dplasma_tpu.observability.dag import (dag_stats,
+                                                       format_dag_stats)
+            # scoped recording on the module-global recorder: cleared
+            # per run, restored after (no cross-run accumulation)
+            with profiling.recording() as rec:
                 dag_fn(rec)
-                with open(ip.dot, "w") as f:
-                    f.write(rec.to_dot(name or "dag"))
-            else:
-                with open(ip.dot, "w") as f:
-                    f.write(lowered.as_text())
-            if ip.rank == 0 and ip.loud >= 1:
-                print(f"#+ traced DAG written to {ip.dot}")
+                if ip.dot:
+                    with open(ip.dot, "w") as f:
+                        f.write(rec.to_dot(name or "dag"))
+                dag_info = dag_stats(rec)
+            if ip.rank == 0 and ip.loud >= 3:
+                print(format_dag_stats(dag_info, name))
+        elif ip.dot:
+            # no analytic tile-DAG builder for this op: fall back to
+            # the lowered XLA program text (tests/common.c:406-431)
+            with open(ip.dot, "w") as f:
+                f.write(lowered.as_text())
+        if ip.dot and ip.rank == 0 and ip.loud >= 1:
+            print(f"#+ traced DAG written to {ip.dot}")
         out = None
+        warm = None
         if getattr(ip, "warmup", True):
             # rank-local warm run EXCLUDED from stats (the reference
             # drivers' warmup pattern, ref tests/testing_zpotrf.c:
@@ -355,18 +479,64 @@ class Driver:
             # here one untimed execution absorbs first-run effects —
             # autotuning, allocator growth — that ENQ's compile split
             # does not cover)
-            self._sync(compiled(*args))
-        best = float("inf")
-        for _ in range(max(ip.nruns, 1)):
             t0 = time.perf_counter()
-            out = compiled(*args)
-            self._sync(out)
-            best = min(best, time.perf_counter() - t0)
+            with self.prof.span(f"warmup:{name}"):
+                self._sync(compiled(*args))
+            warm = time.perf_counter() - t0
+        # --jaxtrace: device-side op/kernel capture around the timed
+        # loop only (not compile/warmup)
+        trace_cm = _jaxtrace_guard(ip.jaxtrace) if ip.jaxtrace \
+            else contextlib.nullcontext()
+        times = []
+        with trace_cm:
+            for i in range(max(ip.nruns, 1)):
+                t0 = time.perf_counter()
+                with self.prof.span(f"run[{i}]:{name}", flops=flops,
+                                    track=self.prof.TRACK_RUN):
+                    out = compiled(*args)
+                    self._sync(out)
+                times.append(time.perf_counter() - t0)
+        best = min(times)
         t0 = time.perf_counter()
         dest = time.perf_counter() - t0
         gflops = (flops / 1e9) / best
         total = enq + best + dest
+        comm = self._comm_model() if ip.report else None
+        entry = self.report.add_op(
+            name, prec=ip.prec, flops=flops, enq_s=enq, warmup_s=warm,
+            dest_s=dest, runs_s=times, gflops=gflops, xla=xla_info,
+            comm=comm, dag=dag_info)
+        stats = entry["timings"]
+        reg = self.report.metrics
+        lbl = dict(op=name, prec=ip.prec)
+        reg.counter("runs_total", **lbl).inc(len(times))
+        hist = reg.histogram("run_seconds", **lbl)
+        for t in times:
+            hist.observe(t)
+        reg.gauge("gflops_best", **lbl).set(gflops)
+        reg.gauge("enq_seconds", **lbl).set(enq)
+        reg.gauge("model_flops", **lbl).set(flops)
+        if xla_info and xla_info.get("flops") is not None:
+            reg.gauge("xla_flops", **lbl).set(xla_info["flops"])
+        if xla_info and xla_info.get("peak_bytes") is not None:
+            reg.gauge("xla_peak_bytes", **lbl).set(xla_info["peak_bytes"])
+        if comm and comm.get("dag_model"):
+            reg.gauge("comm_bytes_dag_model", **lbl).set(
+                comm["dag_model"]["bytes_total"])
+        self.prof.save_dinfo(f"GFLOPS:{name}", gflops)
         if ip.rank == 0:
+            if ip.loud >= 2:
+                # per-run lines (the reference prints each run), then
+                # the spread: best alone hides variance
+                for i, t in enumerate(times):
+                    print(f"#+ run {i}: {t:12.5f} s : "
+                          f"{(flops / 1e9) / t:14f} gflops")
+                if len(times) > 1:
+                    print("#+ runs %d : min/median/max %g/%g/%g s "
+                          "stddev %g" % (len(times), stats["min_s"],
+                                         stats["median_s"],
+                                         stats["max_s"],
+                                         stats["stddev_s"]))
             print("[****] TIME(s) %12.5f : %s\tPxQxg= %3d %-3d %d NB= %4d "
                   "N= %7d : %14f gflops - ENQ&PROG&DEST %12.5f : %14f gflops"
                   " - ENQ %12.5f - DEST %12.5f"

@@ -14,8 +14,9 @@ import jax.numpy as jnp
 
 from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.drivers.common import Driver
-from dplasma_tpu.ops import (aux, blas3, checks, eig, generators, hqr, ldl,
-                             lu, norms, potrf as potrf_mod, qr, rbt)
+from dplasma_tpu.ops import (aux, blas3, checks, eig, gemm as gemm_ops,
+                             generators, hqr, ldl, lu, norms,
+                             potrf as potrf_mod, qr, rbt)
 from dplasma_tpu.utils import flops as lawn41
 
 TREE_NAMES = {0: "flat", 1: "greedy", 2: "fibonacci", 3: "binary",
@@ -58,7 +59,8 @@ def gemm(drv: Driver):
     alpha, beta = (0.51, -0.42)
     out, _ = drv.progress(
         lambda a, b, c: blas3.gemm(alpha, a, b, beta, c),
-        (A, B, C), lawn41.gemm(ip.M, ip.N, ip.K, cplx))
+        (A, B, C), lawn41.gemm(ip.M, ip.N, ip.K, cplx),
+        dag_fn=lambda rec: gemm_ops.dag(C, A, B, rec))
     if ip.check:
         ref = alpha * (A.to_dense() @ B.to_dense()) + beta * C.to_dense()
         got = out.to_dense()
@@ -262,7 +264,8 @@ def geqrf(drv: Driver):
     out, _ = drv.progress(lambda a: qr.geqrf_rec(a, hnb),
                           (_put(drv, A0),),
                           lawn41.geqrf(ip.M, ip.N,
-                                       _is_complex(ip.prec_dtype)))
+                                       _is_complex(ip.prec_dtype)),
+                          dag_fn=lambda rec: qr.dag(A0, rec))
     if ip.check:
         Af, Tf = out
         Q = qr.ungqr(Af, Tf).to_dense()
@@ -430,7 +433,8 @@ def _lu_flops(ip):
 def getrf_nopiv(drv: Driver):
     ip = drv.ip
     A0 = _gen(drv, ip.N, ip.N, 0, kind="he")   # diag-dominant-ish, safe
-    LU, _ = drv.progress(lu.getrf_nopiv, (_put(drv, A0),), _lu_flops(ip))
+    LU, _ = drv.progress(lu.getrf_nopiv, (_put(drv, A0),), _lu_flops(ip),
+                         dag_fn=lambda rec: lu.dag(A0, rec))
     if ip.check:
         B = _gen(drv, ip.N, ip.K, 1)
         Y = blas3.trsm(1.0, LU, _put(drv, B), side="L", uplo="L",
@@ -446,7 +450,8 @@ def getrf_1d(drv: Driver):
     A0 = _gen(drv, ip.N, ip.N)
     hnb = max(ip.HNB, 0)  # -z/--HNB: recursive-panel variant
     out, _ = drv.progress(lambda a: lu.getrf_rec(a, hnb),
-                          (_put(drv, A0),), _lu_flops(ip))
+                          (_put(drv, A0),), _lu_flops(ip),
+                          dag_fn=lambda rec: lu.dag(A0, rec))
     if ip.check:
         LU, perm = out
         B = _gen(drv, ip.N, ip.K, 1)

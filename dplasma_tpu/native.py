@@ -199,6 +199,11 @@ def potrf_priority(kind: str, NT: int, k: int, m: int = 0,
 # Binary trace writer
 # ---------------------------------------------------------------------
 
+#: DTPUPROF1 on-disk magic (shared by the C++ writer, the Python
+#: mirror, and readers/converters like tools/tracecat.py)
+TRACE_MAGIC = b"DTPUPROF1"
+
+
 class TraceWriter:
     """Binary profiling trace (DTPUPROF1 format; PaRSEC-trace analogue).
 
@@ -217,7 +222,7 @@ class TraceWriter:
         else:
             self._h = None
             self._f = open(path, "wb")
-            self._f.write(b"DTPUPROF1")
+            self._f.write(TRACE_MAGIC)
 
     def event(self, name: str, begin_ns: int, end_ns: int,
               flops: float = 0.0) -> None:
@@ -254,28 +259,44 @@ class TraceWriter:
         self.close()
 
 
-def read_trace(path: str):
-    """Parse a DTPUPROF1 file → (events, info) lists."""
+def read_trace(path: str, strict: bool = True):
+    """Parse a DTPUPROF1 file → (events, info) lists.
+
+    ``strict=False`` tolerates a truncated final record (a run killed
+    mid-write — the external-timeout case the bench harness plans for)
+    and returns everything before the tear instead of raising.
+    """
     import struct
+
+    def take(f, n: int) -> bytes:
+        buf = f.read(n)
+        if len(buf) != n:
+            raise EOFError(f"truncated trace record in {path}")
+        return buf
+
     events, info = [], {}
     with open(path, "rb") as f:
-        magic = f.read(9)
-        if magic != b"DTPUPROF1":
+        magic = f.read(len(TRACE_MAGIC))
+        if magic != TRACE_MAGIC:
             raise ValueError(f"bad trace magic {magic!r}")
-        while True:
-            tag = f.read(1)
-            if not tag:
-                break
-            if tag == b"\x01":
-                (n,) = struct.unpack("<i", f.read(4))
-                name = f.read(n).decode()
-                b, e, fl = struct.unpack("<qqd", f.read(24))
-                events.append((name, b, e, fl))
-            elif tag == b"\x02":
-                (n,) = struct.unpack("<i", f.read(4))
-                key = f.read(n).decode()
-                (n,) = struct.unpack("<i", f.read(4))
-                info[key] = f.read(n).decode()
-            else:
-                raise ValueError(f"bad trace tag {tag!r}")
+        try:
+            while True:
+                tag = f.read(1)
+                if not tag:
+                    break
+                if tag == b"\x01":
+                    (n,) = struct.unpack("<i", take(f, 4))
+                    name = take(f, n).decode()
+                    b, e, fl = struct.unpack("<qqd", take(f, 24))
+                    events.append((name, b, e, fl))
+                elif tag == b"\x02":
+                    (n,) = struct.unpack("<i", take(f, 4))
+                    key = take(f, n).decode()
+                    (n,) = struct.unpack("<i", take(f, 4))
+                    info[key] = take(f, n).decode()
+                else:
+                    raise ValueError(f"bad trace tag {tag!r}")
+        except EOFError:
+            if strict:
+                raise
     return events, info

@@ -1,0 +1,34 @@
+"""Observability subsystem: metrics, run-reports, traces, DAG analytics.
+
+The reference runtime's observability stack is what made its schedulers
+debuggable and its GFlop/s claims reproducible (PAPER §5.1): PaRSEC's
+binary task trace with driver-stamped metadata (``PROFILING_SAVE_[di]INFO``,
+ref tests/common.h:198-231), the ``--dot`` DAG dump, and per-kernel trace
+prints. This package is the TPU-native equivalent, layered on the
+skeleton in :mod:`dplasma_tpu.utils.profiling`:
+
+* :mod:`.metrics` — a labelled counter/gauge/histogram registry whose
+  snapshot embeds in the versioned JSON run-report;
+* :mod:`.report` — the run-report itself (``"schema": 1``), assembled by
+  :class:`dplasma_tpu.drivers.common.Driver` and consumed by ``bench.py``;
+* :mod:`.xla` — post-``compile()`` capture of XLA's
+  ``cost_analysis()`` / ``memory_analysis()`` (model-flops vs XLA-flops
+  vs achieved-GFlop/s side by side);
+* :mod:`.comm` — the analytic comm-volume model computed from the
+  block-cyclic layout (``parallel.cyclic`` + ``native.rank_grid``);
+* :mod:`.dag` — analytics over :class:`~dplasma_tpu.utils.profiling.
+  DagRecorder` (task counts, critical path, wavefront width profile);
+* :mod:`.chrome` — DTPUPROF1 → Chrome trace-event JSON conversion
+  (the PaRSEC profile-converter analogue; view in Perfetto).
+"""
+from dplasma_tpu.observability.chrome import profile_to_chrome
+from dplasma_tpu.observability.comm import comm_volume_model
+from dplasma_tpu.observability.dag import dag_stats
+from dplasma_tpu.observability.metrics import MetricsRegistry
+from dplasma_tpu.observability.report import REPORT_SCHEMA, RunReport
+from dplasma_tpu.observability.xla import capture_compiled
+
+__all__ = [
+    "MetricsRegistry", "RunReport", "REPORT_SCHEMA", "capture_compiled",
+    "comm_volume_model", "dag_stats", "profile_to_chrome",
+]

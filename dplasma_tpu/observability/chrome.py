@@ -1,0 +1,53 @@
+"""DTPUPROF1 -> Chrome trace-event JSON (the profile-converter analogue).
+
+PaRSEC ships converters from its binary trace to visualizer formats;
+the TPU-world target is the Chrome trace-event schema, which Perfetto
+and ``chrome://tracing`` both load. Spans become complete ('X') events
+on a (pid, tid) = (rank, track) grid; run metadata (the
+``save_[di]info`` pairs) rides in ``otherData`` and per-event flops in
+``args`` so Perfetto queries can compute achieved rates per span.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Tuple
+
+
+def profile_to_chrome(events: Iterable[tuple], info: Dict[str, str],
+                      name: str = "dplasma_tpu") -> dict:
+    """Convert profile events + info to a Chrome trace-event document.
+
+    ``events`` are ``(name, begin_ns, end_ns, flops[, track])`` tuples
+    (4-tuples — raw :func:`dplasma_tpu.native.read_trace` output — get
+    track 0); ``info`` is the metadata kv dict. Timestamps are
+    rebased to the earliest event and expressed in microseconds, as the
+    schema requires. The rank (trace-event ``pid``) comes from
+    ``info["rank"]`` when present.
+    """
+    evs = list(events)
+    pid = 0
+    try:
+        pid = int(info.get("rank", 0))
+    except (TypeError, ValueError):
+        pid = 0
+    t0 = min((e[1] for e in evs), default=0)
+    trace = []
+    tracks = set()
+    for e in evs:
+        nm, b, en, fl = e[0], e[1], e[2], e[3]
+        track = int(e[4]) if len(e) > 4 else 0
+        tracks.add(track)
+        ev = {"name": nm, "cat": "span", "ph": "X",
+              "ts": (b - t0) / 1e3, "dur": max(en - b, 0) / 1e3,
+              "pid": pid, "tid": track}
+        if fl:
+            ev["args"] = {"flops": fl}
+        trace.append(ev)
+    # metadata events name the process/threads for the viewer UI
+    meta = [{"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": f"{name} rank {pid}"}}]
+    for tr in sorted(tracks):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tr, "args": {"name": f"track {tr}"}})
+    return {"traceEvents": meta + trace,
+            "displayTimeUnit": "ms",
+            "otherData": dict(info)}

@@ -1,0 +1,137 @@
+"""Labelled metrics registry: counters, gauges, histograms.
+
+The driver harness populates one registry per run (ENQ/warmup/run/DEST
+timings, flop counts, comm-volume figures — each labelled with the
+``[SDCZ]`` op name), and its :meth:`MetricsRegistry.snapshot` embeds in
+the versioned JSON run-report. The design follows the usual
+client-library shape (a metric family keyed by name, instruments keyed
+by label values) with none of the server machinery: everything is
+in-process and serializes to plain JSON.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotone counter. ``inc`` by non-negative amounts only."""
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        self.value += amount
+
+
+class Gauge:
+    """Point-in-time value; ``set`` wins, ``add`` adjusts."""
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+    def add(self, amount: float) -> None:
+        self.value += amount
+
+
+class Histogram:
+    """Observation accumulator; exports count/sum/min/max/mean/stddev.
+
+    Raw observations are kept (runs are small — nruns, panels), so the
+    snapshot can also report the exact median.
+    """
+
+    def __init__(self):
+        self.samples: List[float] = []
+
+    def observe(self, value: float) -> None:
+        self.samples.append(float(value))
+
+    def stats(self) -> dict:
+        s = self.samples
+        if not s:
+            return {"count": 0, "sum": 0.0, "min": None, "max": None,
+                    "mean": None, "median": None, "stddev": None}
+        n = len(s)
+        mean = sum(s) / n
+        var = sum((x - mean) ** 2 for x in s) / n
+        ordered = sorted(s)
+        mid = n // 2
+        median = ordered[mid] if n % 2 else \
+            0.5 * (ordered[mid - 1] + ordered[mid])
+        return {"count": n, "sum": sum(s), "min": ordered[0],
+                "max": ordered[-1], "mean": mean, "median": median,
+                "stddev": math.sqrt(var)}
+
+
+_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Families of labelled instruments; snapshot() -> JSON-able list.
+
+    Usage::
+
+        reg = MetricsRegistry()
+        reg.counter("runs_total", op="dpotrf").inc()
+        reg.gauge("gflops", op="dpotrf").set(812.0)
+        reg.histogram("run_seconds", op="dpotrf").observe(0.031)
+        reg.snapshot()
+    """
+
+    def __init__(self):
+        self._families: Dict[str, str] = {}          # name -> type
+        self._metrics: Dict[Tuple[str, tuple], object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, kind: str, name: str, labels: dict):
+        with self._lock:
+            seen = self._families.get(name)
+            if seen is None:
+                self._families[name] = kind
+            elif seen != kind:
+                raise TypeError(
+                    f"metric {name!r} already registered as {seen}")
+            key = (name, _label_key(labels))
+            m = self._metrics.get(key)
+            if m is None:
+                m = _TYPES[kind]()
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get("histogram", name, labels)
+
+    def get(self, name: str, **labels) -> Optional[object]:
+        """Lookup without creating; None when absent."""
+        return self._metrics.get((name, _label_key(labels)))
+
+    def snapshot(self) -> List[dict]:
+        """All instruments as JSON-able dicts, sorted by (name, labels)."""
+        out = []
+        with self._lock:
+            for (name, lk), m in sorted(self._metrics.items()):
+                kind = self._families[name]
+                entry = {"name": name, "type": kind, "labels": dict(lk)}
+                if kind == "histogram":
+                    entry.update(m.stats())
+                else:
+                    entry["value"] = m.value
+                out.append(entry)
+        return out

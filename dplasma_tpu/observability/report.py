@@ -1,0 +1,132 @@
+"""The versioned JSON run-report (``"schema": 1``).
+
+One report per driver invocation (``--report[=file]``): the machine-
+readable record of everything the ``[****] TIME(s)`` line summarizes
+plus what it drops — per-run times (not just best), the phase breakdown
+(ENQ/warmup/PROG/DEST), XLA's cost/memory analysis, the analytic
+comm-volume model, and DAG analytics. ``bench.py`` sources its metric
+lines from a report rather than scraping stdout.
+
+Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
+
+    {"schema": 1, "name": ..., "created_unix_ns": ...,
+     "iparam": {...},              # the parsed driver parameter block
+     "env": {"backend": ..., "jax": ..., "device_count": ...},
+     "ops": [{"label": ..., "prec": ...,
+              "timings": {"enq_s", "warmup_s", "dest_s", "runs_s": [...],
+                          "best_s", "min_s", "median_s", "max_s",
+                          "mean_s", "stddev_s"},
+              "model_flops": ..., "gflops": ...,
+              "xla": {...} | null,  # observability.xla.capture_compiled
+              "comm": {...} | null, # observability.comm model
+              "dag": {...} | null}],# observability.dag.dag_stats
+     "metrics": [...],             # MetricsRegistry.snapshot()
+     "extra": {...}}               # free-form (bench ladder, peaks)
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import List, Optional
+
+from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
+
+REPORT_SCHEMA = 1
+
+
+def run_stats(runs_s: List[float]) -> dict:
+    """min/median/max/mean/stddev of the per-run times (the reference
+    prints per-run lines; ``best`` alone hides variance). The math is
+    :meth:`Histogram.stats` — one statistics implementation for both
+    the report timings and the metrics snapshot."""
+    h = Histogram()
+    h.samples = list(runs_s)
+    s = h.stats()
+    return {"runs_s": list(runs_s), "best_s": s["min"],
+            "min_s": s["min"], "median_s": s["median"],
+            "max_s": s["max"], "mean_s": s["mean"],
+            "stddev_s": s["stddev"]}
+
+
+class RunReport:
+    """Accumulates per-op entries + metrics; writes versioned JSON."""
+
+    def __init__(self, name: str, iparam=None):
+        self.name = name
+        self.iparam = iparam
+        self.metrics = MetricsRegistry()
+        self.ops: List[dict] = []
+        self.entries: List[dict] = []   # free-form (bench ladder)
+        self.extra: dict = {}
+        self._t0 = time.time_ns()
+
+    def add_op(self, label: str, *, prec: str = "", flops: float = 0.0,
+               enq_s: float = 0.0, warmup_s: Optional[float] = None,
+               dest_s: float = 0.0, runs_s: Optional[List[float]] = None,
+               gflops: Optional[float] = None, xla: Optional[dict] = None,
+               comm: Optional[dict] = None,
+               dag: Optional[dict] = None) -> dict:
+        timings = {"enq_s": enq_s, "warmup_s": warmup_s,
+                   "dest_s": dest_s}
+        timings.update(run_stats(runs_s or []))
+        entry = {"label": label, "prec": prec, "model_flops": flops,
+                 "gflops": gflops, "timings": timings,
+                 "xla": xla, "comm": comm, "dag": dag}
+        self.ops.append(entry)
+        return entry
+
+    def snapshot(self) -> dict:
+        env = {}
+        try:
+            import jax
+            env = {"backend": jax.default_backend(),
+                   "jax": jax.__version__,
+                   "device_count": jax.device_count()}
+        except Exception:
+            env = {"backend": None, "jax": None, "device_count": None}
+        ipd = None
+        if self.iparam is not None:
+            ipd = {k: v for k, v in
+                   dataclasses.asdict(self.iparam).items()
+                   if isinstance(v, (int, float, str, bool, type(None)))}
+        doc = {"schema": REPORT_SCHEMA, "name": self.name,
+               "created_unix_ns": self._t0, "iparam": ipd, "env": env,
+               "ops": self.ops, "metrics": self.metrics.snapshot()}
+        if self.entries:
+            doc["entries"] = self.entries
+        if self.extra:
+            doc["extra"] = self.extra
+        return doc
+
+    def write(self, path: str) -> str:
+        """Serialize to ``path`` (atomic rename); returns the path."""
+        doc = self.snapshot()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=_json_default)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+
+def _json_default(o):
+    for cast in (float, int):
+        try:
+            return cast(o)
+        except (TypeError, ValueError):
+            continue
+    return str(o)
+
+
+def load_report(path: str) -> dict:
+    """Read a run-report back; raises on schema mismatch newer than
+    this reader."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema", 0) > REPORT_SCHEMA:
+        raise ValueError(
+            f"run-report schema {doc.get('schema')} is newer than "
+            f"supported ({REPORT_SCHEMA})")
+    return doc

@@ -279,3 +279,25 @@ def gemm_ex(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
     if plan.algo == "stream":
         return gemm_stream(alpha, A, B, beta, C, transa, transb, plan)
     return gemm_dot(alpha, A, B, beta, C, transa, transb)
+
+
+def dag(C: TileMatrix, A: TileMatrix, B: TileMatrix, recorder=None):
+    """Record the tile-level owner-computes GEMM DAG (one gemm(m,n,k)
+    task per C tile per k panel, chained along k — the zgemm_NN JDF
+    accumulation structure) into ``recorder``."""
+    from dplasma_tpu import native
+    from dplasma_tpu.utils import profiling
+    rec = recorder if recorder is not None else profiling.recorder
+    MT, NT = C.desc.MT, C.desc.NT
+    KT = A.desc.NT
+    ranks = native.rank_grid(C.desc.dist, MT, NT)
+    for m in range(MT):
+        for n in range(NT):
+            prev = None
+            for kk in range(KT):
+                g = rec.task("gemm", m, n, kk, priority=kk,
+                             rank=int(ranks[m, n]))
+                if prev is not None:
+                    rec.edge(prev, g, "C")
+                prev = g
+    return rec

@@ -779,3 +779,63 @@ def getrf_lowmem(A, nb: int = 512, budget_bytes: int | None = None):
             Ah[s:, s + w:] = Ah[s:, s + w:][p_loc]
         perm[s:] = perm[s:][p_loc]
     return Ah, jnp.asarray(perm)
+
+
+def dag(A: TileMatrix, recorder=None):
+    """Record the tile-level right-looking LU DAG (task classes
+    getrf/trsm_l/trsm_u/gemm with block-cyclic owner ranks) into
+    ``recorder`` for ``--dot`` dumps and DAG analytics.
+
+    Like :func:`dplasma_tpu.ops.potrf.dag` this is pure index algebra
+    (data-independent), so it is emitted analytically. Priorities reuse
+    the cubic critical-path family (getrf on the potrf formula, panel
+    solves on trsm, updates on gemm — the zgetrf JDF uses the same
+    shape).
+    """
+    from dplasma_tpu import native
+    from dplasma_tpu.utils import profiling
+    rec = recorder if recorder is not None else profiling.recorder
+    MT, NT = A.desc.MT, A.desc.NT
+    KT = min(MT, NT)
+    nt = max(MT, NT)
+    ranks = native.rank_grid(A.desc.dist, MT, NT)
+    pri = native.potrf_priority
+
+    def getrf_t(k):
+        return rec.task("getrf", k, priority=pri("potrf", nt, k),
+                        rank=int(ranks[k, k]))
+
+    def trsm_l_t(m, k):
+        return rec.task("trsm_l", m, k, priority=pri("trsm", nt, k, m),
+                        rank=int(ranks[m, k]))
+
+    def trsm_u_t(k, n):
+        return rec.task("trsm_u", k, n, priority=pri("trsm", nt, k, n),
+                        rank=int(ranks[k, n]))
+
+    def gemm_t(m, n, k):
+        return rec.task("gemm", m, n, k,
+                        priority=pri("gemm", nt, k, m, n),
+                        rank=int(ranks[m, n]))
+
+    for k in range(KT):
+        gk = getrf_t(k)
+        if k > 0:
+            rec.edge(gemm_t(k, k, k - 1), gk, "Akk")
+        for m in range(k + 1, MT):
+            tl = trsm_l_t(m, k)
+            rec.edge(gk, tl, "Ukk")
+            if k > 0:
+                rec.edge(gemm_t(m, k, k - 1), tl, "Amk")
+        for n in range(k + 1, NT):
+            tu = trsm_u_t(k, n)
+            rec.edge(gk, tu, "Lkk")
+            if k > 0:
+                rec.edge(gemm_t(k, n, k - 1), tu, "Akn")
+            for m in range(k + 1, MT):
+                gm = gemm_t(m, n, k)
+                rec.edge(trsm_l_t(m, k), gm, "L")
+                rec.edge(tu, gm, "U")
+                if k > 0:
+                    rec.edge(gemm_t(m, n, k - 1), gm, "C")
+    return rec

@@ -395,3 +395,58 @@ def geqrf_lowmem(A, nb: int = 512, budget_bytes: int | None = None):
         Ah[s:, s:s + w] = np.asarray(packed)
         Ts[:T.shape[0], s:s + T.shape[1]] = np.asarray(T)
     return Ah, Ts
+
+
+def dag(A: TileMatrix, recorder=None):
+    """Record the tile-level blocked QR DAG (task classes geqrt/unmqr/
+    tsqrt/tsmqr — the zgeqrf JDF's flat-tree dependence structure) into
+    ``recorder`` for ``--dot`` dumps and DAG analytics.
+
+    Pure index algebra like :func:`dplasma_tpu.ops.potrf.dag`.
+    Priorities grow with the panel index (later panels sit deeper on
+    the critical path).
+    """
+    from dplasma_tpu import native
+    from dplasma_tpu.utils import profiling
+    rec = recorder if recorder is not None else profiling.recorder
+    MT, NT = A.desc.MT, A.desc.NT
+    KT = min(MT, NT)
+    ranks = native.rank_grid(A.desc.dist, MT, NT)
+
+    def t(cls, *ix, tile):
+        return rec.task(cls, *ix, priority=ix[-1],
+                        rank=int(ranks[tile[0], tile[1]]))
+
+    for k in range(KT):
+        ge = t("geqrt", k, tile=(k, k))
+        for n in range(k + 1, NT):
+            un = t("unmqr", k, n, tile=(k, n))
+            rec.edge(ge, un, "V1")
+        prev_panel = ge
+        for m in range(k + 1, MT):
+            ts = t("tsqrt", m, k, tile=(m, k))
+            rec.edge(prev_panel, ts, "R")     # panel reduction chain
+            prev_panel = ts
+            for n in range(k + 1, NT):
+                tm = t("tsmqr", m, n, k, tile=(m, n))
+                rec.edge(ts, tm, "V2")
+                # top row slab rides down the column through tsmqr
+                top = t("unmqr", k, n, tile=(k, n)) if m == k + 1 \
+                    else t("tsmqr", m - 1, n, k, tile=(m - 1, n))
+                rec.edge(top, tm, "A_kn")
+        if k + 1 < KT:
+            # next panel consumes the updated tiles of step k
+            rec.edge(t("tsmqr", k + 1, k + 1, k, tile=(k + 1, k + 1)),
+                     t("geqrt", k + 1, tile=(k + 1, k + 1)), "Akk")
+            for m in range(k + 2, MT):
+                rec.edge(t("tsmqr", m, k + 1, k, tile=(m, k + 1)),
+                         t("tsqrt", m, k + 1, tile=(m, k + 1)), "Amk")
+            for n in range(k + 2, NT):
+                rec.edge(t("tsmqr", k + 1, n, k, tile=(k + 1, n)),
+                         t("unmqr", k + 1, n, tile=(k + 1, n)), "Akn")
+                # trailing tiles accumulate across panels: step k+1's
+                # update of A(m,n) reads step k's
+                for m in range(k + 2, MT):
+                    rec.edge(t("tsmqr", m, n, k, tile=(m, n)),
+                             t("tsmqr", m, n, k + 1, tile=(m, n)), "C")
+    return rec

@@ -1936,3 +1936,82 @@ def potrf_cyclic(A: CyclicMatrix, uplo: str = "L") -> CyclicMatrix:
     else:
         out = _potrf_cyclic_jit(A.data, A.desc, m)
     return CyclicMatrix(out, A.desc)
+
+
+# ---------------------------------------------------------------------
+# Analytic SPMD comm-volume model (observability)
+# ---------------------------------------------------------------------
+
+def spmd_comm_model(desc: CyclicDesc, op: str, itemsize: int) -> dict:
+    """Per-collective wire-byte model of the cyclic shard_map programs.
+
+    Mirrors the collective structure the algorithms above actually
+    emit — per panel step: a masked ``psum`` along 'q' (panel
+    broadcast), a masked ``psum`` along 'p' (diagonal/top-block
+    broadcast), and an ``all_gather`` along 'p'/'q' (row/column panel
+    formation) — priced with the standard ring costs (all-reduce
+    moves ``2(n-1)/n`` of the payload per rank, all-gather ``(n-1)/n``
+    of the gathered output). Returned bytes are TOTAL wire bytes
+    across all ranks and steps; a 1x1 grid prices to zero.
+
+    Known ``op`` values: potrf, getrf, geqrf, herbt, ge2gb (the cyclic
+    kernels in this module). Raises KeyError otherwise — callers
+    surface an explicit null in the run-report rather than a guess.
+    """
+    d = desc.dist
+    P, Q, R = d.P, d.Q, d.P * d.Q
+    mb = desc.mb
+    mloc = desc.MTL * mb
+    nloc = desc.NTL * desc.nb
+    KT = min(desc.MT, desc.NT)
+
+    def psum(payload_elems: float, n: int) -> float:
+        return R * 2.0 * (n - 1) / max(n, 1) * payload_elems * itemsize
+
+    def agather(payload_elems: float, n: int) -> float:
+        # per-rank output is n*payload; ring moves (n-1)*payload/rank
+        return R * (n - 1) * payload_elems * itemsize
+
+    if op == "potrf":
+        by = {
+            "panel_bcast_psum_q": KT * psum(mloc * mb, Q),
+            "diag_bcast_psum_p": KT * psum(mb * mb, P),
+            "row_panel_allgather_p": KT * agather(mloc * mb, P),
+        }
+    elif op == "getrf":
+        by = {
+            "panel_bcast_psum_q": KT * psum(mloc * mb, Q),
+            "candidate_allgather_p": KT * (
+                agather(mb * mb, P) + agather(mb, P)),
+            "pivot_row_exchange_psum_p": KT * psum(mb * nloc, P),
+        }
+    elif op == "geqrf":
+        by = {
+            "panel_bcast_psum_q": KT * psum(mloc * mb, Q),
+            # CholeskyQR2: two Gram psums + the top-block psum along 'p'
+            "gram_psum_p": KT * 3 * psum(mb * mb, P),
+            "trailing_vhc_psum_p": KT * psum(mb * nloc, P),
+        }
+    elif op == "herbt":
+        by = {
+            "panel_bcast_psum_q": (KT - 1) * psum(mloc * mb, Q),
+            "gram_psum_p": (KT - 1) * 3 * psum(mb * mb, P),
+            "inner_products_psum_p": (KT - 1) * psum(mb * nloc, P),
+            "v_allgather_p": (KT - 1) * agather(mloc * mb, P),
+            "two_sided_psum_q": (KT - 1) * 2 * psum(mloc * mb, Q),
+        }
+    elif op == "ge2gb":
+        by = {
+            "qr_panel_bcast_psum_q": KT * psum(mloc * mb, Q),
+            "qr_gram_psum_p": KT * 3 * psum(mb * mb, P),
+            "qr_trailing_psum_p": KT * psum(mb * nloc, P),
+            "lq_row_bcast_psum_p": KT * psum(mb * nloc, P),
+            "lq_gram_psum_q": KT * 3 * psum(mb * mb, Q),
+            "lq_trailing_psum_q": KT * psum(mloc * mb, Q),
+        }
+    else:
+        raise KeyError(f"no spmd comm model for op {op!r}")
+    by = {k: float(v) for k, v in by.items()}
+    return {"model": "spmd_ring", "steps": KT,
+            "bytes_total": float(sum(by.values())),
+            "bytes_by_collective": by}

@@ -33,42 +33,88 @@ from typing import Dict, List, Optional, Tuple
 
 from dplasma_tpu import native
 
-_TRACE_KERNELS = bool(int(os.environ.get("DPLASMA_TRACE_KERNELS", "0")))
+# Programmatic override for kernel tracing; None = defer to the env.
+# The env var is read at CALL time, not import time, so setting
+# DPLASMA_TRACE_KERNELS after import (or monkeypatching os.environ in a
+# test) takes effect immediately.
+_TRACE_KERNELS_OVERRIDE: Optional[bool] = None
+
+
+def set_trace_kernels(enabled: Optional[bool]) -> None:
+    """Force kernel trace prints on/off; ``None`` defers to the
+    ``DPLASMA_TRACE_KERNELS`` environment variable again."""
+    global _TRACE_KERNELS_OVERRIDE
+    _TRACE_KERNELS_OVERRIDE = enabled
+
+
+def trace_kernels_enabled() -> bool:
+    if _TRACE_KERNELS_OVERRIDE is not None:
+        return _TRACE_KERNELS_OVERRIDE
+    try:
+        return bool(int(os.environ.get("DPLASMA_TRACE_KERNELS", "0")))
+    except ValueError:
+        return False
 
 
 def printlog(fmt: str, *args) -> None:
     """Kernel-level trace print, compiled out unless DPLASMA_TRACE_KERNELS
     is set (ref src/dplasmajdf.h:21-31)."""
-    if _TRACE_KERNELS:
+    if trace_kernels_enabled():
         print("[dplasma_tpu] " + (fmt % args if args else fmt), flush=True)
+
+
+# Track-id separator inside DTPUPROF1 event names: spans on track != 0
+# serialize as "<name>\x1f<track>" so the on-disk format (and the
+# native writer's C string path) stays unchanged while the reader
+# recovers (rank, track) lanes for Chrome-trace export. \x1f (unit
+# separator) never appears in task/phase names.
+TRACK_SEP = "\x1f"
 
 
 class Profile:
     """Run profile: named spans + metadata, serialized as DTPUPROF1.
 
+    Spans carry a ``track`` id (a visualizer lane: harness phases on
+    track 0, timed runs on track 1, ...); the profile carries the
+    ``rank`` that produced it. Together they map onto Chrome
+    trace-event (pid, tid) when converted by ``tools/tracecat.py``.
+
     Usage::
 
-        prof = Profile()
-        with prof.span("potrf", flops=1e9):
+        prof = Profile(rank=0)
+        with prof.span("potrf", flops=1e9, track=1):
             run()
         prof.save_dinfo("GFLOPS", gf)      # ref common.h:198-231
         prof.write("run.prof")
     """
 
-    def __init__(self):
-        self.events: List[Tuple[str, int, int, float]] = []
+    #: conventional track ids (purely a display grouping)
+    TRACK_HARNESS = 0
+    TRACK_RUN = 1
+
+    def __init__(self, rank: int = 0):
+        self.events: List[Tuple[str, int, int, float, int]] = []
         self.info: Dict[str, str] = {}
+        self.rank = int(rank)
         self._t0 = time.time_ns()
         self.info["cwd"] = os.getcwd()
         self.info["start_time"] = str(self._t0)
+        self.info["rank"] = str(self.rank)
 
     @contextlib.contextmanager
-    def span(self, name: str, flops: float = 0.0):
+    def span(self, name: str, flops: float = 0.0, track: int = 0):
         b = time.time_ns()
         try:
             yield
         finally:
-            self.events.append((name, b, time.time_ns(), flops))
+            self.events.append((name, b, time.time_ns(), flops,
+                                int(track)))
+
+    def add_event(self, name: str, begin_ns: int, end_ns: int,
+                  flops: float = 0.0, track: int = 0) -> None:
+        """Record an externally-timed span (bench loops, readers)."""
+        self.events.append((name, int(begin_ns), int(end_ns),
+                            float(flops), int(track)))
 
     def save_info(self, key: str, value) -> None:
         self.info[str(key)] = str(value)
@@ -80,8 +126,37 @@ class Profile:
         with native.TraceWriter(path) as t:
             for k, v in self.info.items():
                 t.info(k, v)
-            for name, b, e, fl in self.events:
-                t.event(name, b, e, fl)
+            for name, b, e, fl, track in self.events:
+                wire = name if track == 0 else \
+                    f"{name}{TRACK_SEP}{track}"
+                t.event(wire, b, e, fl)
+
+    @classmethod
+    def load(cls, path: str, strict: bool = True) -> "Profile":
+        """Read a DTPUPROF1 file back into a Profile (track ids
+        decoded; inverse of :meth:`write` up to the synthesized
+        ``cwd``/``start_time`` info of a fresh instance).
+        ``strict=False`` tolerates a torn final record."""
+        raw_events, info = native.read_trace(path, strict=strict)
+        prof = cls(rank=int(info.get("rank", 0) or 0))
+        prof.info = dict(info)
+        prof.events = decode_wire_events(raw_events)
+        return prof
+
+
+def decode_wire_events(raw_events):
+    """Split raw ``native.read_trace`` 4-tuples back into 5-tuples with
+    the track lane decoded from the ``TRACK_SEP`` name suffix (the
+    single authority for the wire encoding — Profile.load and
+    tools/tracecat.py both go through here)."""
+    out = []
+    for wire, b, e, fl in raw_events:
+        name, sep, tr = wire.rpartition(TRACK_SEP)
+        if sep and tr.isdigit():
+            out.append((name, b, e, fl, int(tr)))
+        else:
+            out.append((wire, b, e, fl, 0))
+    return out
 
 
 @contextlib.contextmanager
@@ -178,6 +253,30 @@ class DagRecorder:
             len(self.tasks), [(s, d) for s, d, _ in self.edges], pri,
             lookahead)
 
+    def clear(self) -> None:
+        """Drop all recorded tasks/edges (the module-global recorder
+        otherwise accumulates across runs)."""
+        self.tasks.clear()
+        self.edges.clear()
+        self._names.clear()
+
 
 # Global recorder the ops consult; drivers flip .enabled for --dot.
 recorder = DagRecorder()
+
+
+@contextlib.contextmanager
+def recording(rec: Optional[DagRecorder] = None):
+    """Scoped DAG recording on ``rec`` (default: the module-global
+    recorder): clears it, enables it for the block, and restores the
+    previous enabled state on exit — so back-to-back ``--dot`` runs in
+    one process never bleed tasks/edges into each other. Yields the
+    recorder."""
+    r = recorder if rec is None else rec
+    prev = r.enabled
+    r.clear()
+    r.enabled = True
+    try:
+        yield r
+    finally:
+        r.enabled = prev

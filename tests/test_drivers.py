@@ -82,3 +82,83 @@ def test_driver_warmup_run_excluded(monkeypatch, capsys):
         monkeypatch.undo()
         assert n0[0] == expect, (flag, n0[0])
         capsys.readouterr()
+
+
+class TestParseArguments:
+    """CLI vocabulary coverage (ref tests/common.c:73-259): clustered
+    short flags, optional-value long flags, -v=n, MCA passthrough, and
+    the observability flags."""
+
+    def _parse(self, argv):
+        from dplasma_tpu.drivers import common as dc
+        return dc.parse_arguments(argv)
+
+    def test_clustered_short_flags(self):
+        ip = self._parse(["-N", "64", "-xX"])
+        assert ip.check and ip.check_inv and not ip.sync
+        ip = self._parse(["-N", "64", "-xb"])
+        assert ip.check and ip.sync and not ip.check_inv
+
+    def test_bad_cluster_rejected(self):
+        with pytest.raises(SystemExit):
+            self._parse(["-N", "64", "-xZ"])
+
+    def test_dot_default_and_explicit(self):
+        assert self._parse(["-N", "8"]).dot is None
+        assert self._parse(["-N", "8", "--dot"]).dot == "dag.dot"
+        assert self._parse(["-N", "8", "--dot=g.dot"]).dot == "g.dot"
+
+    def test_verbosity_forms(self):
+        assert self._parse(["-N", "8"]).loud == 1
+        assert self._parse(["-N", "8", "-v"]).loud == 2
+        assert self._parse(["-N", "8", "-v=3"]).loud == 3
+        assert self._parse(["-N", "8", "--verbose=4"]).loud == 4
+
+    def test_mca_passthrough(self):
+        ip = self._parse(["-N", "8", "--", "--mca", "cyclic.convert",
+                          "a2a"])
+        assert ip.extra == ["--mca", "cyclic.convert", "a2a"]
+        assert ip.N == 8
+
+    def test_observability_flags(self):
+        ip = self._parse(["-N", "8"])
+        assert ip.profile is None and ip.report is None \
+            and ip.jaxtrace is None
+        ip = self._parse(["-N", "8", "--profile", "--report",
+                          "--jaxtrace"])
+        assert ip.profile == "run.prof"
+        assert ip.report == "report.json"
+        assert ip.jaxtrace == "jax_trace"
+        ip = self._parse(["-N", "8", "--profile=a.prof",
+                          "--report=b.json", "--jaxtrace=tr"])
+        assert (ip.profile, ip.report, ip.jaxtrace) == \
+            ("a.prof", "b.json", "tr")
+
+
+def test_driver_per_run_stats_printed(capsys):
+    """-v>=2 prints per-run lines and the min/median/max spread (the
+    reference prints each run; best alone hides variance)."""
+    rc = main(["-N", "64", "-t", "16", "--nruns", "3", "-v"],
+              prog="testing_dpotrf")
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "#+ run 0:" in out and "#+ run 2:" in out
+    assert "min/median/max" in out and "stddev" in out
+
+
+def test_driver_dot_uses_global_recorder(tmp_path, capsys):
+    """The --dot path records through the module-global recorder under
+    profiling.recording(): no cross-run task accumulation, disabled
+    again afterwards."""
+    from dplasma_tpu.utils import profiling
+
+    dot = str(tmp_path / "dag.dot")
+    for _ in range(2):
+        rc = main(["-N", "64", "-t", "16", f"--dot={dot}"],
+                  prog="testing_dpotrf")
+        assert rc == 0
+        capsys.readouterr()
+        # recorder was used, then left disabled; its contents are the
+        # single run's DAG (4 panels -> 20 tasks), not an accumulation
+        assert not profiling.recorder.enabled
+        assert len(profiling.recorder.tasks) == 20

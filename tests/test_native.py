@@ -144,3 +144,45 @@ def test_potrf_dag_uplo_u_ranks():
     keyed_u = {(t.cls, t.index): t for t in ru.tasks}
     t_u = keyed_u[("trsm", (2, 0))]
     assert t_u.rank == gl[0, 2]  # upper: panel tile lives at (k, m)
+
+
+def test_profile_track_roundtrip(tmp_path):
+    """Profile.write -> Profile.load: identical events (incl. rank and
+    track lanes) and info under both the native library and the pure-
+    Python fallback — the DTPUPROF1 format itself is unchanged (track
+    ids ride inside the name field)."""
+    def run():
+        prof = Profile(rank=5)
+        with prof.span("enq:potrf"):
+            pass
+        with prof.span("run[0]:potrf", flops=3e9, track=1):
+            pass
+        prof.add_event("run[1]:potrf", 100, 250, 3e9, track=1)
+        prof.save_dinfo("GFLOPS:potrf", 42.0)
+        p = os.path.join(tmp_path, "track.prof")
+        prof.write(p)
+        back = Profile.load(p)
+        return prof.events, back.events, back.info, back.rank
+    (ev_a, back_a, info_a, rank_a), (ev_b, back_b, info_b, rank_b) = \
+        _with_fallback(run)
+    assert back_a == ev_a and back_b == ev_b
+    assert rank_a == rank_b == 5
+    assert float(info_a["GFLOPS:potrf"]) == 42.0
+    assert info_a["rank"] == "5"
+    # track lanes recovered: run spans on track 1, harness on 0
+    tracks = {name.split(":")[0]: tr for name, _, _, _, tr in back_a}
+    assert tracks == {"enq": 0, "run[0]": 1, "run[1]": 1}
+
+
+def test_read_trace_truncated(tmp_path):
+    p = os.path.join(tmp_path, "torn.prof")
+    with native.TraceWriter(p) as t:
+        t.event("full", 1, 2, 0.0)
+        t.event("torn", 3, 4, 0.0)
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:     # tear the last record mid-payload
+        f.truncate(size - 10)
+    with pytest.raises(EOFError):
+        native.read_trace(p)
+    events, info = native.read_trace(p, strict=False)
+    assert [e[0] for e in events] == ["full"]

@@ -1,0 +1,336 @@
+"""Observability subsystem: metrics registry, run-report schema, XLA
+capture, comm-volume model, DAG analytics, Chrome-trace pipeline, and
+the driver acceptance path (--report/--profile end to end on CPU)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dplasma_tpu.descriptors import Dist, TileMatrix
+from dplasma_tpu.observability import (MetricsRegistry, RunReport,
+                                       capture_compiled,
+                                       comm_volume_model, dag_stats,
+                                       profile_to_chrome)
+from dplasma_tpu.observability.report import REPORT_SCHEMA, load_report
+from dplasma_tpu.utils import profiling
+
+
+# ------------------------------------------------------------- metrics
+
+def test_metrics_registry_instruments():
+    reg = MetricsRegistry()
+    reg.counter("runs_total", op="dpotrf").inc()
+    reg.counter("runs_total", op="dpotrf").inc(2)
+    reg.counter("runs_total", op="dgemm").inc()
+    reg.gauge("gflops", op="dpotrf").set(812.5)
+    h = reg.histogram("run_seconds", op="dpotrf")
+    for t in (0.1, 0.3, 0.2):
+        h.observe(t)
+    snap = reg.snapshot()
+    by = {(e["name"], e["labels"].get("op")): e for e in snap}
+    assert by[("runs_total", "dpotrf")]["value"] == 3
+    assert by[("runs_total", "dgemm")]["value"] == 1
+    assert by[("gflops", "dpotrf")]["value"] == 812.5
+    hs = by[("run_seconds", "dpotrf")]
+    assert hs["count"] == 3 and hs["min"] == 0.1 and hs["max"] == 0.3
+    assert hs["median"] == 0.2
+    assert json.loads(json.dumps(snap)) == snap   # JSON-able
+
+
+def test_metrics_registry_guards():
+    reg = MetricsRegistry()
+    reg.counter("x").inc()
+    with pytest.raises(TypeError):
+        reg.gauge("x")                       # family type conflict
+    with pytest.raises(ValueError):
+        reg.counter("y").inc(-1)             # counters only go up
+    assert reg.get("nope") is None
+
+
+# ---------------------------------------------------------- run-report
+
+def test_run_report_schema_and_stats(tmp_path):
+    rep = RunReport("testing_dpotrf")
+    rep.metrics.gauge("gflops_best", op="testing_dpotrf").set(7.0)
+    entry = rep.add_op("testing_dpotrf", prec="d", flops=1e9,
+                       enq_s=1.5, warmup_s=0.2, dest_s=0.0,
+                       runs_s=[0.4, 0.2, 0.3], gflops=5.0)
+    t = entry["timings"]
+    assert t["best_s"] == 0.2 and t["min_s"] == 0.2
+    assert t["median_s"] == 0.3 and t["max_s"] == 0.4
+    assert t["stddev_s"] == pytest.approx(0.0816496580927726)
+    p = str(tmp_path / "r.json")
+    rep.write(p)
+    doc = load_report(p)
+    assert doc["schema"] == REPORT_SCHEMA == 1
+    assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
+    assert doc["metrics"][0]["value"] == 7.0
+    assert doc["env"]["backend"] == "cpu"
+
+
+def test_run_report_rejects_newer_schema(tmp_path):
+    p = str(tmp_path / "future.json")
+    with open(p, "w") as f:
+        json.dump({"schema": REPORT_SCHEMA + 1}, f)
+    with pytest.raises(ValueError):
+        load_report(p)
+
+
+# --------------------------------------------------------- XLA capture
+
+def test_capture_compiled_fields():
+    import jax
+    import jax.numpy as jnp
+    c = jax.jit(lambda a: a @ a).lower(jnp.ones((32, 32))).compile()
+    info = capture_compiled(c)
+    # CPU backend answers both analyses; fields are floats/ints
+    assert info["flops"] and info["flops"] > 2 * 32 ** 3 / 2
+    assert info["bytes_accessed"] > 0
+    assert info["memory"]["argument_size_in_bytes"] == 32 * 32 * 8
+    assert info["peak_bytes"] > 0
+    assert json.loads(json.dumps(info)) == info
+
+
+def test_capture_compiled_never_raises():
+    class Broken:
+        def cost_analysis(self):
+            raise RuntimeError("no analysis on this backend")
+
+        def memory_analysis(self):
+            return None
+    info = capture_compiled(Broken())
+    assert info["flops"] is None and info["cost"] is None
+    assert info["memory"] is None and info["peak_bytes"] is None
+
+
+# ----------------------------------------------------------- comm model
+
+def test_comm_volume_model_grid():
+    d = Dist(P=2, Q=2)
+    cv = comm_volume_model("potrf", 512, 512, 1, 64, 64, 8, d)
+    assert cv["op_class"] == "potrf"
+    dm, sm = cv["dag_model"], cv["spmd_model"]
+    assert dm["messages"] > 0
+    assert dm["bytes_total"] == dm["messages"] * cv["tile_bytes"]
+    assert set(dm["messages_by_flow"]) == {"Lkk", "panel"}
+    assert sm["bytes_total"] > 0 and sm["steps"] == 8
+    # single device: everything is rank-local
+    cv1 = comm_volume_model("potrf", 512, 512, 1, 64, 64, 8, Dist())
+    assert cv1["dag_model"]["bytes_total"] == 0.0
+    assert cv1["spmd_model"]["bytes_total"] == 0.0
+
+
+def test_comm_volume_model_classes_and_unknown():
+    d = Dist(P=2, Q=4)
+    for op in ("getrf_1d", "geqrf", "gemm", "heev"):
+        cv = comm_volume_model(op, 256, 256, 256, 32, 32, 4, d)
+        assert cv["op_class"] is not None
+        assert cv["spmd_model"] is None or \
+            cv["spmd_model"]["bytes_total"] > 0
+        if cv["dag_model"] is not None:
+            assert cv["dag_model"]["messages"] > 0
+    cv = comm_volume_model("print", 64, 64, 1, 32, 32, 4, d)
+    assert cv["op_class"] is None and cv["dag_model"] is None
+
+
+def test_comm_model_supertile_owner_counting():
+    # kp=2 halves the distinct row owners a short column span sees
+    from dplasma_tpu.observability.comm import _owners
+    assert _owners(0, 0, 4, 1, 0) == {0}
+    assert _owners(0, 3, 4, 1, 0) == {0, 1, 2, 3}
+    assert _owners(0, 3, 4, 2, 0) == {0, 1}
+    assert _owners(2, 5, 4, 2, 1) == {2, 3}      # offset shifts owners
+    assert _owners(3, 1, 4, 1, 0) == set()       # empty range
+
+
+# ---------------------------------------------------------- DAG stats
+
+def test_dag_stats_potrf():
+    from dplasma_tpu.ops import potrf as potrf_mod
+    A = TileMatrix.zeros(16, 16, 4, 4, dist=Dist(P=2, Q=2))
+    rec = profiling.DagRecorder(enabled=True)
+    potrf_mod.dag(A, "L", rec)
+    st = dag_stats(rec)
+    NT = 4
+    assert st["tasks"] == len(rec.tasks)
+    assert st["task_counts"]["potrf"] == NT
+    # right-looking Cholesky critical path: potrf/trsm/herk per panel
+    assert st["critical_path"] == 3 * (NT - 1) + 1
+    assert st["max_width"] >= NT - 1
+    assert st["parallelism_ceiling"] == pytest.approx(
+        st["tasks"] / st["critical_path"])
+    assert sum(st["wavefronts"]) == st["tasks"]
+    from dplasma_tpu.observability.dag import format_dag_stats
+    txt = format_dag_stats(st, "potrf")
+    assert "critical path" in txt and "wavefront" in txt
+
+
+def test_dag_stats_empty_and_cycle():
+    rec = profiling.DagRecorder(enabled=True)
+    assert dag_stats(rec)["tasks"] == 0
+    rec.task("a", 0)
+    rec.task("b", 0)
+    rec.edge(0, 1)
+    rec.edge(1, 0)
+    with pytest.raises(ValueError):
+        dag_stats(rec)
+
+
+def test_recorder_clear_and_recording_scope():
+    rec = profiling.DagRecorder(enabled=True)
+    rec.task("t", 0)
+    rec.edge(0, 0)
+    rec.clear()
+    assert not rec.tasks and not rec.edges
+    assert rec.task("t", 1) == 0        # name table cleared too
+    g = profiling.recorder
+    g.clear()
+    assert not g.enabled
+    with profiling.recording() as r:
+        assert r is g and r.enabled
+        r.task("x", 0)
+    assert not g.enabled and len(g.tasks) == 1
+    with profiling.recording() as r:    # scoped: cleared on entry
+        assert not r.tasks
+    g.clear()
+
+
+# --------------------------------------------------------- printlog fix
+
+def test_printlog_reads_env_at_call_time(monkeypatch, capsys):
+    monkeypatch.delenv("DPLASMA_TRACE_KERNELS", raising=False)
+    profiling.printlog("hidden %d", 1)
+    assert capsys.readouterr().out == ""
+    # set AFTER import: must take effect (was frozen at import before)
+    monkeypatch.setenv("DPLASMA_TRACE_KERNELS", "1")
+    profiling.printlog("shown %d", 2)
+    assert "shown 2" in capsys.readouterr().out
+    monkeypatch.setenv("DPLASMA_TRACE_KERNELS", "0")
+    profiling.printlog("hidden again")
+    assert capsys.readouterr().out == ""
+    profiling.set_trace_kernels(True)   # programmatic override wins
+    try:
+        profiling.printlog("forced")
+        assert "forced" in capsys.readouterr().out
+    finally:
+        profiling.set_trace_kernels(None)
+
+
+# ------------------------------------------------------- Chrome traces
+
+def test_profile_to_chrome_document():
+    events = [("enq:op", 1000, 3000, 0.0, 0),
+              ("run[0]:op", 3000, 9000, 1e9, 1)]
+    doc = profile_to_chrome(events, {"rank": "2", "SCHED": "wavefront"})
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["tid"] for e in spans] == [0, 1]
+    assert all(e["pid"] == 2 for e in spans)
+    assert spans[0]["ts"] == 0.0 and spans[0]["dur"] == 2.0   # µs
+    assert spans[1]["args"]["flops"] == 1e9
+    assert doc["otherData"]["SCHED"] == "wavefront"
+    names = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in names)
+    assert json.loads(json.dumps(doc)) == doc
+
+
+def test_tracecat_cli_roundtrip(tmp_path):
+    prof = profiling.Profile(rank=1)
+    with prof.span("enq:x"):
+        pass
+    with prof.span("run[0]:x", flops=5e6, track=1):
+        pass
+    src = str(tmp_path / "x.prof")
+    out = str(tmp_path / "x.trace.json")
+    prof.write(src)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "tracecat.py"),
+         src, "-o", out],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    doc = json.load(open(out))
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in spans} == {"enq:x", "run[0]:x"}
+    assert {e["tid"] for e in spans} == {0, 1}
+
+
+# --------------------------------------- driver end-to-end (acceptance)
+
+def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
+    """The ISSUE acceptance path: testing_dpotrf -N 512 --report
+    --profile produces (a) a run-report with timings, GFlop/s, XLA
+    cost/memory (or explicit nulls), comm model and DAG stats, and
+    (b) a DTPUPROF1 trace that tracecat converts to Chrome trace-event
+    JSON that json.loads cleanly — all on CPU."""
+    from dplasma_tpu.drivers import main
+    rj = str(tmp_path / "r.json")
+    rp = str(tmp_path / "r.prof")
+    rc = main(["-N", "512", f"--report={rj}", f"--profile={rp}",
+               "--nruns", "2"], prog="testing_dpotrf")
+    capsys.readouterr()
+    assert rc == 0
+    doc = load_report(rj)
+    assert doc["schema"] == 1
+    assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
+    (op,) = doc["ops"]
+    t = op["timings"]
+    assert t["enq_s"] > 0 and t["warmup_s"] > 0
+    assert len(t["runs_s"]) == 2 and t["best_s"] == min(t["runs_s"])
+    for k in ("min_s", "median_s", "max_s", "mean_s", "stddev_s"):
+        assert t[k] is not None
+    assert op["gflops"] > 0 and op["model_flops"] > 0
+    # XLA analysis present or explicit nulls — never missing keys
+    assert "flops" in op["xla"] and "memory" in op["xla"]
+    assert op["comm"]["op_class"] == "potrf"
+    assert op["comm"]["dag_model"]["bytes_total"] == 0.0  # 1x1 grid
+    assert op["dag"]["tasks"] > 0 and op["dag"]["critical_path"] > 0
+    assert doc["metrics"]
+    # (b) binary trace -> chrome trace-event JSON
+    events, info = __import__(
+        "dplasma_tpu.native", fromlist=["native"]).read_trace(rp)
+    assert any(e[0].startswith("enq:") for e in events)
+    from tools.tracecat import convert
+    chrome = convert(rp)
+    text = json.dumps(chrome)
+    back = json.loads(text)
+    spans = [e for e in back["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == len(events)
+    assert float(info["GFLOPS:testing_dpotrf"]) == \
+        pytest.approx(op["gflops"])
+
+
+def test_driver_dag_stats_at_v3(capsys):
+    from dplasma_tpu.drivers import main
+    rc = main(["-N", "64", "-t", "16", "-v=3"], prog="testing_dpotrf")
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "#+ DAG[testing_dpotrf]:" in out
+    assert "parallelism ceiling" in out and "wavefront widths" in out
+
+
+def test_qr_dag_cross_panel_dependence():
+    """tsmqr(m,n,k) -> tsmqr(m,n,k+1): successive panels' updates of
+    the same trailing tile must be ordered (write-after-write on
+    A(m,n)); the linearization must respect it."""
+    from dplasma_tpu.ops import qr
+    A = TileMatrix.zeros(24, 24, 8, 8, dist=Dist(P=2, Q=2))
+    rec = profiling.DagRecorder(enabled=True)
+    qr.dag(A, rec)
+    by = {(t.cls, t.index): t.tid for t in rec.tasks}
+    edges = {(s, d) for s, d, _ in rec.edges}
+    assert (by[("tsmqr", (2, 2, 0))], by[("tsmqr", (2, 2, 1))]) in edges
+    order = rec.order()              # acyclic and schedulable
+    pos = {int(v): i for i, v in enumerate(order)}
+    for s, d, _ in rec.edges:
+        assert pos[s] < pos[d]
+
+
+def test_comm_model_dag_walk_cap():
+    """Absurd K (gemm) skips the Python dependence walk — explicit
+    null, not a multi-minute stall; the closed-form fields remain."""
+    cv = comm_volume_model("gemm", 1024, 1024, 1 << 22, 64, 64, 4,
+                           Dist(P=2, Q=2))
+    assert cv["op_class"] == "gemm" and cv["dag_model"] is None

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""tracecat: DTPUPROF1 binary trace -> Chrome trace-event JSON.
+
+The TPU-world analogue of PaRSEC's profile converters: a driver run
+with ``--profile=run.prof`` writes the binary trace; this converts it
+to the Chrome trace-event schema for Perfetto / chrome://tracing::
+
+    python tools/tracecat.py run.prof -o run.trace.json
+    python tools/tracecat.py run.prof            # stdout
+    python tools/tracecat.py --info run.prof     # metadata kv only
+
+Truncated traces (a run killed mid-write) convert with ``--lax``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def convert(path: str, strict: bool = True) -> dict:
+    from dplasma_tpu.observability.chrome import profile_to_chrome
+    from dplasma_tpu.utils.profiling import decode_wire_events
+
+    from dplasma_tpu import native
+    raw, info = native.read_trace(path, strict=strict)
+    return profile_to_chrome(decode_wire_events(raw), info,
+                             name=os.path.basename(path))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tracecat", description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="DTPUPROF1 file (driver --profile=)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output JSON path (default: stdout)")
+    ap.add_argument("--lax", action="store_true",
+                    help="tolerate a truncated final record")
+    ap.add_argument("--info", action="store_true",
+                    help="print the metadata kv pairs only")
+    ns = ap.parse_args(argv)
+    try:
+        doc = convert(ns.trace, strict=not ns.lax)
+    except (OSError, ValueError, EOFError) as exc:
+        sys.stderr.write(f"tracecat: {exc}\n")
+        return 1
+    if ns.info:
+        out = json.dumps(doc["otherData"], indent=1, sort_keys=True)
+    else:
+        out = json.dumps(doc)
+    if ns.output:
+        with open(ns.output, "w") as f:
+            f.write(out + "\n")
+    else:
+        sys.stdout.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
