@@ -88,6 +88,11 @@ class IParam:
     profile: Optional[str] = None    # DTPUPROF1 binary trace
     report: Optional[str] = None     # versioned JSON run-report
     jaxtrace: Optional[str] = None   # JAX/XLA profiler logdir
+    # resilience (--abft/--inject/--max-retries/--run-timeout)
+    abft: bool = False               # checksum-carried op variants
+    inject: Optional[str] = None     # fault plan KIND@STAGE[:RATE[:COUNT]]
+    max_retries: int = 2             # remediation-ladder rung budget
+    run_timeout: float = 0.0         # watchdog on the timed loop (s)
     extra: list = field(default_factory=list)   # args after `--` (MCA-style)
 
     @property
@@ -136,9 +141,22 @@ Optional arguments:
                      model, DAG analytics; default file: report.json)
  --jaxtrace[=dir]  : capture a device-side JAX/XLA profiler trace into
                      dir (default: jax_trace)
+ --abft            : checksum-carried (ABFT) op variants where
+                     available (gemm/potrf/getrf): detect + locate a
+                     corrupted tile in O(n^2), correct it for GEMM
+ --inject=SPEC     : deterministic fault injection,
+                     SPEC = KIND@STAGE[:RATE[:COUNT]] with KIND in
+                     bitflip|nan|inf|zero and STAGE a kernel stage
+                     (gemm/trsm/potrf/getrf/any); seeded by --seed
+ --max-retries     : retry-rung budget of the remediation ladder
+                     (default: 2; the kernel/algorithm fallback rungs
+                     are one-shot and not counted)
+ --run-timeout     : watchdog limit (seconds) on the timed loop;
+                     overruns classify as timeout for the ladder
  -h --help         : this message
 ENVIRONMENT
   [SDCZ]<FUNCTION> : per-precision priority limit (recorded, trace-time)
+  DPLASMA_INJECT   : default fault plan when --inject is not given
 """
 
 
@@ -170,6 +188,9 @@ _LONG = {
     "scheduler": ("scheduler", str), "vpmap": ("_vpmap", str),
     "thread_multi": ("thread_multi", None),
     "ht": ("_ht", _int),
+    "abft": ("abft", None), "inject": ("inject", str),
+    "max-retries": ("max_retries", _int),
+    "run-timeout": ("run_timeout", float),
 }
 
 _SHORT = {
@@ -323,6 +344,11 @@ class Driver:
         self.ip = ip
         self.name = name
         self.mesh = None
+        # resilience bookkeeping: which fn produced the last progress()
+        # output (primary name or a ladder fallback label), and how many
+        # -x verifications failed (run_driver turns that into exit 1)
+        self.winner = name
+        self.check_failures = 0
         # observability: one profile + one run-report per driver run
         # (written at close() when --profile/--report asked for them)
         self.prof = Profile(rank=ip.rank)
@@ -393,8 +419,42 @@ class Driver:
         except Exception:
             return None
 
+    def _lower_compile(self, fn, args, name):
+        """Trace+compile with the device-chore host fallback
+        (the reference's multi-chore body selection,
+        zpotrf_L.jdf:540-555): some ops lack an accelerator lowering
+        for this dtype (e.g. f64 LuDecomposition on TPU) — rerun the
+        whole taskpool on the host backend. (Catch is broad: backend
+        compile errors surface as several exception types; a genuine
+        trace bug reproduces identically on the host and is re-raised
+        there.) Returns (lowered, compiled, args)."""
+        import jax
+        ip = self.ip
+        jfn = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
+        try:
+            lowered = jfn.lower(*args)
+            return lowered, lowered.compile(), args
+        except Exception:
+            cpu = getattr(self, "_cpu", None)
+            if cpu is None or jax.default_backend() == "cpu":
+                raise
+            if ip.rank == 0 and ip.loud >= 1:
+                print("#+ no accelerator chore for this op/dtype; "
+                      "falling back to the host backend")
+            # the accelerator trace is abandoned: faults injected into
+            # it never ran — reset the plan so the host re-trace gets
+            # the same campaign (budget unconsumed, no ghost records)
+            from dplasma_tpu.resilience import inject as _rinject
+            _rinject.rearm()
+            with jax.default_device(cpu):
+                args = jax.device_put(args, cpu)
+                jfn = jax.jit(fn)
+                lowered = jfn.lower(*args)
+                return lowered, lowered.compile(), args
+
     def progress(self, fn: Callable, args: tuple, flops: float,
-                 label: Optional[str] = None, dag_fn: Callable = None):
+                 label: Optional[str] = None, dag_fn: Callable = None,
+                 verify_fn: Callable = None, fallbacks=()):
         """Compile, run nruns times, print the reference-format perf line.
 
         ENQ = trace+compile (the taskpool-construction analog),
@@ -402,100 +462,173 @@ class Driver:
         Every phase lands in ``self.prof`` (DTPUPROF1 spans) and an op
         entry in ``self.report`` (per-run stats, XLA cost/memory
         analysis, comm model, DAG analytics). Returns (output, gflops).
-        """
-        import jax
 
+        Resilience (``--inject/--abft/--run-timeout``, see
+        :mod:`dplasma_tpu.resilience`): the armed fault plan corrupts
+        the first attempt's trace; after the timed loop a health scan
+        (plus ``verify_fn``, the op's ABFT post-verification, which may
+        return a corrected output) gates the result, and on failure the
+        remediation ladder walks retry → kernel fallback → the driver
+        body's ``fallbacks`` alternates, re-tracing each rung. Stats
+        and the perf line come from the final (surviving) attempt;
+        ``self.winner`` names the fn that produced the output.
+        """
         from dplasma_tpu.observability.xla import capture_compiled
+        from dplasma_tpu.resilience import guard
+        from dplasma_tpu.resilience import inject as rinject
         from dplasma_tpu.utils import profiling
         ip, name = self.ip, label or self.name
-        jfn = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
-        t0 = time.perf_counter()
-        with self.prof.span(f"enq:{name}"):
-            try:
-                lowered = jfn.lower(*args)
-                compiled = lowered.compile()
-            except Exception:
-                # Device-chore fallback (the reference's multi-chore body
-                # selection, zpotrf_L.jdf:540-555): some ops lack an
-                # accelerator lowering for this dtype (e.g. f64
-                # LuDecomposition on TPU) — rerun the whole taskpool on
-                # the host backend. (Catch is broad: backend compile
-                # errors surface as several exception types; a genuine
-                # trace bug reproduces identically on the host and is
-                # re-raised there.)
-                cpu = getattr(self, "_cpu", None)
-                if cpu is None or jax.default_backend() == "cpu":
-                    raise
-                if ip.rank == 0 and ip.loud >= 1:
-                    print("#+ no accelerator chore for this op/dtype; "
-                          "falling back to the host backend")
-                with jax.default_device(cpu):
-                    args = jax.device_put(args, cpu)
-                    jfn = jax.jit(fn)
-                    lowered = jfn.lower(*args)
-                    compiled = lowered.compile()
-        enq = time.perf_counter() - t0
-        # XLA-side capture + comm model only when something consumes
-        # them (--report): the un-instrumented driver path stays as
-        # cheap as before this layer existed
-        xla_info = capture_compiled(compiled) if ip.report else None
-        dag_info = None
-        # analytic DAG construction is cubic-ish in tile count; the
-        # implicit consumers (--report, -v>=3) cap it, the explicit
-        # --dot opt-in always honors the request. K tiles count too:
-        # the GEMM DAG is MT*NT*KT tasks.
-        tiles = max(-(-ip.M // max(ip.MB, 1)), 1) * \
-            max(-(-ip.N // max(ip.NB, 1)), 1) * \
-            max(-(-ip.K // max(ip.NB, 1)), 1)
-        want_dag = dag_fn is not None and (
-            ip.dot or ((ip.report or ip.loud >= 3)
-                       and tiles <= _DAG_TILE_CAP))
-        if want_dag:
-            from dplasma_tpu.observability.dag import (dag_stats,
-                                                       format_dag_stats)
-            # scoped recording on the module-global recorder: cleared
-            # per run, restored after (no cross-run accumulation)
-            with profiling.recording() as rec:
-                dag_fn(rec)
-                if ip.dot:
-                    with open(ip.dot, "w") as f:
-                        f.write(rec.to_dot(name or "dag"))
-                dag_info = dag_stats(rec)
-            if ip.rank == 0 and ip.loud >= 3:
-                print(format_dag_stats(dag_info, name))
-        elif ip.dot:
-            # no analytic tile-DAG builder for this op: fall back to
-            # the lowered XLA program text (tests/common.c:406-431)
-            with open(ip.dot, "w") as f:
-                f.write(lowered.as_text())
-        if ip.dot and ip.rank == 0 and ip.loud >= 1:
-            print(f"#+ traced DAG written to {ip.dot}")
+        resil = guard.enabled(ip)
+        ladder = guard.Ladder(ip, name, fallbacks) if resil else None
+        plan = None
+        if resil and getattr(ip, "inject", None):
+            plan = rinject.parse_plan(ip.inject, seed=ip.seed)
+        injection = {"plan": plan.spec(), "faults": []} if plan else None
+
+        cur_fn, cur_label = fn, name
+        action = guard.ACTION_PRIMARY
+        first_compile = True
         out = None
         warm = None
-        if getattr(ip, "warmup", True):
-            # rank-local warm run EXCLUDED from stats (the reference
-            # drivers' warmup pattern, ref tests/testing_zpotrf.c:
-            # 138-202: a CPU-then-each-device warm pass before timing;
-            # here one untimed execution absorbs first-run effects —
-            # autotuning, allocator growth — that ENQ's compile split
-            # does not cover)
+        times: list = []
+        enq = 0.0
+        dag_info = None
+        while True:
             t0 = time.perf_counter()
-            with self.prof.span(f"warmup:{name}"):
-                self._sync(compiled(*args))
-            warm = time.perf_counter() - t0
-        # --jaxtrace: device-side op/kernel capture around the timed
-        # loop only (not compile/warmup)
-        trace_cm = _jaxtrace_guard(ip.jaxtrace) if ip.jaxtrace \
-            else contextlib.nullcontext()
-        times = []
-        with trace_cm:
-            for i in range(max(ip.nruns, 1)):
+            armed = plan is not None and action == guard.ACTION_PRIMARY
+            if armed:
+                rinject.arm(plan)  # faults corrupt the primary trace only
+            try:
+                with self.prof.span(f"enq:{name}"):
+                    lowered, compiled, args = self._lower_compile(
+                        cur_fn, args, name)
+            except Exception as exc:
+                if armed:
+                    # the trace died before compiling: its faults never
+                    # ran — disarm but do NOT report them as injected
+                    rinject.disarm()
+                if ladder is None:
+                    raise
+                ladder.record(action, cur_label, ok=False,
+                              classification=guard.CLASS_COMPILE,
+                              error=repr(exc),
+                              elapsed_s=time.perf_counter() - t0)
+                nxt = ladder.next_action(guard.CLASS_COMPILE)
+                if nxt is None:
+                    self._finish_resilience(ladder, injection)
+                    raise
+                action, cur_label, nfn = nxt
+                if nfn is not None:
+                    cur_fn = nfn
+                if action == guard.ACTION_KERNEL_FALLBACK:
+                    guard.kernel_fallback()
+                continue
+            if armed:
+                # harvest only from a trace that actually compiled:
+                # these faults are baked into the executable the timed
+                # loop will run
+                injection["faults"].extend(rinject.disarm())
+            enq = time.perf_counter() - t0
+            if first_compile:
+                first_compile = False
+                # analytic DAG construction is cubic-ish in tile count;
+                # the implicit consumers (--report, -v>=3) cap it, the
+                # explicit --dot opt-in always honors the request. K
+                # tiles count too: the GEMM DAG is MT*NT*KT tasks.
+                tiles = max(-(-ip.M // max(ip.MB, 1)), 1) * \
+                    max(-(-ip.N // max(ip.NB, 1)), 1) * \
+                    max(-(-ip.K // max(ip.NB, 1)), 1)
+                want_dag = dag_fn is not None and (
+                    ip.dot or ((ip.report or ip.loud >= 3)
+                               and tiles <= _DAG_TILE_CAP))
+                if want_dag:
+                    from dplasma_tpu.observability.dag import (
+                        dag_stats, format_dag_stats)
+                    # scoped recording on the module-global recorder:
+                    # cleared per run, restored after (no cross-run
+                    # accumulation)
+                    with profiling.recording() as rec:
+                        dag_fn(rec)
+                        if ip.dot:
+                            with open(ip.dot, "w") as f:
+                                f.write(rec.to_dot(name or "dag"))
+                        dag_info = dag_stats(rec)
+                    if ip.rank == 0 and ip.loud >= 3:
+                        print(format_dag_stats(dag_info, name))
+                elif ip.dot:
+                    # no analytic tile-DAG builder for this op: fall
+                    # back to the lowered XLA program text
+                    # (tests/common.c:406-431)
+                    with open(ip.dot, "w") as f:
+                        f.write(lowered.as_text())
+                if ip.dot and ip.rank == 0 and ip.loud >= 1:
+                    print(f"#+ traced DAG written to {ip.dot}")
+            if getattr(ip, "warmup", True):
+                # rank-local warm run EXCLUDED from stats (the
+                # reference drivers' warmup pattern, ref
+                # tests/testing_zpotrf.c:138-202: a CPU-then-each-
+                # device warm pass before timing; here one untimed
+                # execution absorbs first-run effects — autotuning,
+                # allocator growth — that ENQ's compile split does not
+                # cover)
                 t0 = time.perf_counter()
-                with self.prof.span(f"run[{i}]:{name}", flops=flops,
-                                    track=self.prof.TRACK_RUN):
-                    out = compiled(*args)
-                    self._sync(out)
-                times.append(time.perf_counter() - t0)
+                with self.prof.span(f"warmup:{name}"):
+                    self._sync(compiled(*args))
+                warm = time.perf_counter() - t0
+            # --jaxtrace: device-side op/kernel capture around the
+            # timed loop only (not compile/warmup)
+            trace_cm = _jaxtrace_guard(ip.jaxtrace) if ip.jaxtrace \
+                else contextlib.nullcontext()
+            wd = guard.Watchdog(getattr(ip, "run_timeout", 0.0), name) \
+                if resil else None
+            times = []
+            with trace_cm, (wd or contextlib.nullcontext()):
+                for i in range(max(ip.nruns, 1)):
+                    t0 = time.perf_counter()
+                    with self.prof.span(f"run[{i}]:{name}", flops=flops,
+                                        track=self.prof.TRACK_RUN):
+                        out = compiled(*args)
+                        self._sync(out)
+                    times.append(time.perf_counter() - t0)
+            if not resil:
+                break
+            # post-run gate: non-finite census + the op's ABFT verify
+            # (which may hand back a corrected / de-augmented output)
+            health = guard.health_scan(out)
+            ok = health["ok"]
+            verify_rep = None
+            # the ABFT verifier understands the PRIMARY fn's
+            # (checksum-augmented) output contract; algo-fallback
+            # alternates return their own plain contract
+            if verify_fn is not None \
+                    and action != guard.ACTION_ALGO_FALLBACK:
+                out, verify_rep = verify_fn(out)
+                ok = ok and verify_rep.get("ok", True)
+            timed_out = wd.timed_out
+            ok = ok and not timed_out
+            if ok:
+                ladder.record(action, cur_label, True, health=health,
+                              abft=verify_rep, elapsed_s=sum(times))
+                ladder.winner = cur_label
+                break
+            cls = ladder.classify(health, verify_rep, timed_out)
+            ladder.record(action, cur_label, False, classification=cls,
+                          health=health, abft=verify_rep,
+                          elapsed_s=sum(times))
+            nxt = ladder.next_action(cls)
+            if nxt is None:
+                # ladder exhausted: keep the last output (the -x check
+                # and exit code report the failure downstream)
+                ladder.winner = cur_label
+                break
+            action, cur_label, nfn = nxt
+            if nfn is not None:
+                cur_fn = nfn
+            if action == guard.ACTION_KERNEL_FALLBACK:
+                guard.kernel_fallback()
+        if resil:
+            self._finish_resilience(ladder, injection)
+        xla_info = capture_compiled(compiled) if ip.report else None
         best = min(times)
         t0 = time.perf_counter()
         dest = time.perf_counter() - t0
@@ -550,12 +683,46 @@ class Driver:
             sys.stdout.flush()
         return out, gflops
 
+    def _finish_resilience(self, ladder, injection):
+        """Fold one progress() call's ladder walk into the run-report
+        (``"resilience"`` section), metrics, and the -v>=2 prints."""
+        from dplasma_tpu.resilience import guard
+        summary = ladder.summary(injection)
+        self.winner = ladder.winner
+        self.report.add_resilience(summary)
+        reg = self.report.metrics
+        lbl = dict(op=ladder.name, prec=self.ip.prec)
+        reg.counter("resilience_attempts_total", **lbl).inc(
+            len(ladder.attempts))
+        reg.counter("resilience_faults_total", **lbl).inc(
+            summary["faults_detected"])
+        if injection:
+            reg.counter("resilience_injected_total", **lbl).inc(
+                len(injection["faults"]))
+        ip = self.ip
+        noteworthy = summary["outcome"] != "clean" \
+            or summary["faults_detected"] \
+            or (injection and injection["faults"])
+        if ip.rank == 0 and (ip.loud >= 3
+                             or (ip.loud >= 2 and noteworthy)):
+            for line in guard.format_lines(summary):
+                print(line)
+            sys.stdout.flush()
+
     def report_check(self, what: str, residual, ok) -> int:
         res = float(np.asarray(residual))
-        status = "SUCCESS" if bool(ok) else "FAILED"
+        passed = bool(ok)
+        status = "SUCCESS" if passed else "FAILED"
+        # every -x verification is tracked on the driver AND recorded
+        # in the run-report, so a failed check can never exit 0 even if
+        # a body forgets to propagate the return value (run_driver
+        # enforces it from self.check_failures)
+        self.report.add_check(what, res, passed)
+        if not passed:
+            self.check_failures += 1
         if self.ip.rank == 0:
             print(f"[{status}] {what} residual = {res:e}")
-        return 0 if bool(ok) else 1
+        return 0 if passed else 1
 
 
 def run_driver(name: str, body: Callable[[Driver], int],
@@ -588,9 +755,24 @@ def run_driver(name: str, body: Callable[[Driver], int],
         jax.config.update("jax_platforms", plats)
     if ip.prec in ("d", "z"):
         jax.config.update("jax_enable_x64", True)
+    if ip.inject is None:
+        # env tier of the fault-injection plan (like the [SDCZ]<FUNC>
+        # priority-limit tier: ambient, CLI wins)
+        ip.inject = os.environ.get("DPLASMA_INJECT") or None
+    if ip.inject:
+        from dplasma_tpu.resilience import inject as _rinject
+        try:
+            _rinject.parse_plan(ip.inject, seed=ip.seed)
+        except ValueError as exc:
+            sys.stderr.write(f"bad --inject spec: {exc}\n")
+            return 2
     drv = Driver(ip, base)
     try:
         ret = body(drv) or 0
     finally:
         drv.close()
+    if ret == 0 and drv.check_failures:
+        # structural guarantee: a failed -x/--check verification exits
+        # nonzero even when a driver body drops the check's return value
+        ret = 1
     return ret

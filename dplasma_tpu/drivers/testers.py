@@ -57,10 +57,18 @@ def gemm(drv: Driver):
     B = _put(drv, _gen(drv, ip.K, ip.N, 1))
     C = _put(drv, _gen(drv, ip.M, ip.N, 2))
     alpha, beta = (0.51, -0.42)
+    fn = lambda a, b, c: blas3.gemm(alpha, a, b, beta, c)  # noqa: E731
+    verify = None
+    if ip.abft:
+        from dplasma_tpu.resilience import abft as _abft
+        fn = lambda a, b, c: _abft.gemm_checksummed(  # noqa: E731
+            alpha, a, b, beta, c)
+        verify = lambda out: _abft.gemm_verify(  # noqa: E731
+            out, alpha, A, B, beta, C)
     out, _ = drv.progress(
-        lambda a, b, c: blas3.gemm(alpha, a, b, beta, c),
-        (A, B, C), lawn41.gemm(ip.M, ip.N, ip.K, cplx),
-        dag_fn=lambda rec: gemm_ops.dag(C, A, B, rec))
+        fn, (A, B, C), lawn41.gemm(ip.M, ip.N, ip.K, cplx),
+        dag_fn=lambda rec: gemm_ops.dag(C, A, B, rec),
+        verify_fn=verify)
     if ip.check:
         ref = alpha * (A.to_dense() @ B.to_dense()) + beta * C.to_dense()
         got = out.to_dense()
@@ -170,9 +178,16 @@ def potrf(drv: Driver):
     A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
     A = _put(drv, A0)
     hnb = max(ip.HNB, 0)  # -z/--HNB: recursive diagonal-tile variant
-    L, _ = drv.progress(lambda a: potrf_mod.potrf_rec(a, "L", hnb), (A,),
+    fn = lambda a: potrf_mod.potrf_rec(a, "L", hnb)  # noqa: E731
+    verify = None
+    if ip.abft:
+        from dplasma_tpu.resilience import abft as _abft
+        fn = lambda a: _abft.potrf_checksummed(a, "L", hnb)  # noqa: E731
+        verify = lambda out: _abft.potrf_verify(out, A0, "L")  # noqa: E731
+    L, _ = drv.progress(fn, (A,),
                         lawn41.potrf(ip.N, _is_complex(ip.prec_dtype)),
-                        dag_fn=lambda rec: potrf_mod.dag(A, "L", rec))
+                        dag_fn=lambda rec: potrf_mod.dag(A, "L", rec),
+                        verify_fn=verify)
     ret = 0
     if ip.check:
         r, ok = checks.check_potrf(A0, L, "L")
@@ -433,10 +448,47 @@ def _lu_flops(ip):
 def getrf_nopiv(drv: Driver):
     ip = drv.ip
     A0 = _gen(drv, ip.N, ip.N, 0, kind="he")   # diag-dominant-ish, safe
-    LU, _ = drv.progress(lu.getrf_nopiv, (_put(drv, A0),), _lu_flops(ip),
-                         dag_fn=lambda rec: lu.dag(A0, rec))
+    depth = max(ip.butterfly_level, 2)
+    crit = CRITERIA.get(ip.criteria, "higham_sum")
+    qalpha = ip.alpha if ip.alpha > 0 else 100.0
+    fn = lu.getrf_nopiv
+    verify = None
+    if ip.abft:
+        from dplasma_tpu.resilience import abft as _abft
+        fn = _abft.getrf_nopiv_checksummed
+        verify = lambda out: _abft.getrf_nopiv_verify(out, A0)  # noqa: E731
+    # the remediation ladder's algorithm escalation (ISSUE: nopiv →
+    # RBT-preconditioned nopiv → LU/QR hybrid via --criteria): each
+    # alternate's output contract is dispatched below on drv.winner
+    fallbacks = [
+        ("getrf_rbt", lambda a: lu.getrf_nopiv(
+            rbt.hebut(a, seed=ip.seed, depth=depth))),
+        ("getrf_qrf", lambda a: lu.getrf_qrf(
+            a, criterion=crit, alpha=qalpha)),
+    ]
+    out, _ = drv.progress(fn, (_put(drv, A0),), _lu_flops(ip),
+                          dag_fn=lambda rec: lu.dag(A0, rec),
+                          verify_fn=verify, fallbacks=fallbacks)
     if ip.check:
         B = _gen(drv, ip.N, ip.K, 1)
+        if drv.winner == "getrf_qrf":
+            LU, Tm, lu_tab = out
+            X = lu.getrs_qrf(LU, Tm, lu_tab, _put(drv, B))
+            return drv.report_check("GETRF_QRF |b-Ax|",
+                                    *checks.check_axmb(A0, B, X))
+        if drv.winner == "getrf_rbt":
+            # factor is of the butterflied Ã = U^T A U:
+            # x = U Ã^{-1} U^T b
+            F = out
+            Y = rbt.gebmm(_put(drv, B), seed=ip.seed, depth=depth,
+                          trans="T")
+            Y = blas3.trsm(1.0, F, Y, side="L", uplo="L", trans="N",
+                           diag="U")
+            Y = blas3.trsm(1.0, F, Y, side="L", uplo="U", trans="N")
+            X = rbt.gebmm(Y, seed=ip.seed, depth=depth, trans="N")
+            return drv.report_check("GETRF_RBT |b-Ax|",
+                                    *checks.check_axmb(A0, B, X))
+        LU = out
         Y = blas3.trsm(1.0, LU, _put(drv, B), side="L", uplo="L",
                        trans="N", diag="U")
         X = blas3.trsm(1.0, LU, Y, side="L", uplo="U", trans="N")
@@ -449,9 +501,15 @@ def getrf_1d(drv: Driver):
     ip = drv.ip
     A0 = _gen(drv, ip.N, ip.N)
     hnb = max(ip.HNB, 0)  # -z/--HNB: recursive-panel variant
-    out, _ = drv.progress(lambda a: lu.getrf_rec(a, hnb),
-                          (_put(drv, A0),), _lu_flops(ip),
-                          dag_fn=lambda rec: lu.dag(A0, rec))
+    fn = lambda a: lu.getrf_rec(a, hnb)  # noqa: E731
+    verify = None
+    if ip.abft:
+        from dplasma_tpu.resilience import abft as _abft
+        fn = lambda a: _abft.getrf_checksummed(a, hnb)  # noqa: E731
+        verify = lambda out: _abft.getrf_verify(out, A0)  # noqa: E731
+    out, _ = drv.progress(fn, (_put(drv, A0),), _lu_flops(ip),
+                          dag_fn=lambda rec: lu.dag(A0, rec),
+                          verify_fn=verify)
     if ip.check:
         LU, perm = out
         B = _gen(drv, ip.N, ip.K, 1)

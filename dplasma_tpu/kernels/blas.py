@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dplasma_tpu.resilience import inject as _inject
+
 # Global matmul precision for f32 inputs on TPU. "highest" = full f32
 # accumulate via multi-pass bf16 (correctness first; benches may lower it).
 _PRECISION = lax.Precision.HIGHEST
@@ -90,13 +92,14 @@ def dot(a, b, ta: bool = False, tb: bool = False, conj_a: bool = False,
     if tb:
         b = b.T
     if _dd_active(res_dtype):
-        return _dd_dot(a, b)
+        return _inject.tap("gemm", _dd_dot(a, b))
     from dplasma_tpu.kernels import pallas_kernels as _pk
     if _pk.eligible(a, b):
-        return _pk.matmul(a, b, precision=_PRECISION).astype(res_dtype)
+        return _inject.tap(
+            "gemm", _pk.matmul(a, b, precision=_PRECISION).astype(res_dtype))
     out = jnp.matmul(a, b, precision=_PRECISION,
                      preferred_element_type=_acc_type(res_dtype))
-    return out.astype(res_dtype)
+    return _inject.tap("gemm", out.astype(res_dtype))
 
 
 def gemm(alpha, a, b, beta, c, ta=False, tb=False, conj_a=False, conj_b=False):
@@ -111,8 +114,9 @@ def gemm(alpha, a, b, beta, c, ta=False, tb=False, conj_a=False, conj_b=False):
         aa = a.T if ta else a
         bb = b.T if tb else b
         if _pk.eligible(aa, bb, c):
-            return _pk.gemm(aa, bb, c, alpha=float(alpha), beta=float(beta),
-                            precision=_PRECISION)
+            return _inject.tap(
+                "gemm", _pk.gemm(aa, bb, c, alpha=float(alpha),
+                                 beta=float(beta), precision=_PRECISION))
     return alpha * dot(a, b, ta, tb, conj_a, conj_b) + beta * c
 
 
@@ -134,12 +138,15 @@ def potrf(a, lower: bool = True):
     with the opposite triangle zeroed."""
     if _dd_active(a.dtype):
         from dplasma_tpu.kernels import dd as _dd
-        return _dd.potrf_f64(a, lower=lower)
+        return _inject.tap("potrf", _dd.potrf_f64(a, lower=lower))
     if lower:
-        return lax.linalg.cholesky(a, symmetrize_input=False)
+        return _inject.tap(
+            "potrf", lax.linalg.cholesky(a, symmetrize_input=False))
     # upper storage: the Hermitian matrix's lower representation is a^H;
     # A = U^H U with U = chol(a^H)^H
-    return lax.linalg.cholesky(a.conj().T, symmetrize_input=False).conj().T
+    return _inject.tap(
+        "potrf",
+        lax.linalg.cholesky(a.conj().T, symmetrize_input=False).conj().T)
 
 
 def _inv_trsm_active() -> bool:
@@ -163,8 +170,9 @@ def trsm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
     X op(A) = alpha B (side=R). CORE_ztrsm semantics."""
     if _dd_active(jnp.result_type(a.dtype, b.dtype)):
         from dplasma_tpu.kernels import dd as _dd
-        return _dd.trsm_f64(a, b, side=side, lower=lower, trans=trans,
-                            unit=unit, alpha=alpha)
+        return _inject.tap(
+            "trsm", _dd.trsm_f64(a, b, side=side, lower=lower, trans=trans,
+                                 unit=unit, alpha=alpha))
     transpose = trans in ("T", "C")
     conj = trans == "C"
     if _inv_trsm_active():
@@ -174,8 +182,8 @@ def trsm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
             left_side=True, lower=lower, transpose_a=transpose,
             conjugate_a=conj, unit_diagonal=unit)
         if side == "L":
-            return dot(inv_op, alpha * b)
-        return dot(alpha * b, inv_op)
+            return _inject.tap("trsm", dot(inv_op, alpha * b))
+        return _inject.tap("trsm", dot(alpha * b, inv_op))
     x = lax.linalg.triangular_solve(
         a, alpha * b,
         left_side=(side == "L"),
@@ -184,7 +192,7 @@ def trsm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
         conjugate_a=conj,
         unit_diagonal=unit,
     )
-    return x
+    return _inject.tap("trsm", x)
 
 
 def trmm(a, b, *, side="L", lower=True, trans="N", unit=False, alpha=1.0):
@@ -233,7 +241,7 @@ def getrf_nopiv(a):
         m = m.at[:, k].set(jnp.where(jnp.arange(m.shape[0]) > k, l, m[:, k]))
         return m
 
-    return lax.fori_loop(0, min(a.shape), body, a)
+    return _inject.tap("getrf", lax.fori_loop(0, min(a.shape), body, a))
 
 
 def getrf_nopiv_blocked(a, base: int = 32):

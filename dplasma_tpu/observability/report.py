@@ -9,7 +9,7 @@ lines from a report rather than scraping stdout.
 
 Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
 
-    {"schema": 1, "name": ..., "created_unix_ns": ...,
+    {"schema": 2, "name": ..., "created_unix_ns": ...,
      "iparam": {...},              # the parsed driver parameter block
      "env": {"backend": ..., "jax": ..., "device_count": ...},
      "ops": [{"label": ..., "prec": ...,
@@ -21,7 +21,18 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
               "comm": {...} | null, # observability.comm model
               "dag": {...} | null}],# observability.dag.dag_stats
      "metrics": [...],             # MetricsRegistry.snapshot()
+     "checks": [{"what", "residual", "ok"}],   # -x verifications (v2)
+     "resilience": [{"op", "enabled", "injection": {...} | null,
+                     "attempts": [{"attempt", "action", "label", "ok",
+                                   "classification", "health", "abft",
+                                   "elapsed_s", "error"}],
+                     "outcome": "clean|remediated|failed",
+                     "winner": ..., "faults_detected": ...}],  # (v2)
      "extra": {...}}               # free-form (bench ladder, peaks)
+
+Schema history: 2 adds the ``"checks"`` and ``"resilience"``
+sections (additive — v1 readers of the other keys are unaffected;
+this reader accepts <= 2).
 """
 from __future__ import annotations
 
@@ -33,7 +44,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 1
+REPORT_SCHEMA = 2
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -59,6 +70,8 @@ class RunReport:
         self.metrics = MetricsRegistry()
         self.ops: List[dict] = []
         self.entries: List[dict] = []   # free-form (bench ladder)
+        self.checks: List[dict] = []    # -x verification outcomes
+        self.resilience: List[dict] = []  # per-op ladder summaries
         self.extra: dict = {}
         self._t0 = time.time_ns()
 
@@ -77,6 +90,20 @@ class RunReport:
         self.ops.append(entry)
         return entry
 
+    def add_check(self, what: str, residual: float, ok: bool) -> dict:
+        """Record one -x verification outcome (schema v2)."""
+        entry = {"what": what, "residual": float(residual),
+                 "ok": bool(ok)}
+        self.checks.append(entry)
+        return entry
+
+    def add_resilience(self, summary: dict) -> dict:
+        """Record one progress() call's resilience summary — the
+        injection, every attempt's classification/action, and the
+        outcome (schema v2; see resilience.guard.Ladder.summary)."""
+        self.resilience.append(summary)
+        return summary
+
     def snapshot(self) -> dict:
         env = {}
         try:
@@ -94,6 +121,10 @@ class RunReport:
         doc = {"schema": REPORT_SCHEMA, "name": self.name,
                "created_unix_ns": self._t0, "iparam": ipd, "env": env,
                "ops": self.ops, "metrics": self.metrics.snapshot()}
+        if self.checks:
+            doc["checks"] = self.checks
+        if self.resilience:
+            doc["resilience"] = self.resilience
         if self.entries:
             doc["entries"] = self.entries
         if self.extra:

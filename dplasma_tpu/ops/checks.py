@@ -22,6 +22,15 @@ def _eps(dtype):
     return float(jnp.finfo(dtype).eps)
 
 
+def _tiny(dtype):
+    """Smallest normal of the input's REAL dtype — the denominator
+    clamp. (A float32 tiny under f64 inputs over-clamps by ~270 orders
+    of magnitude; a f64 tiny under f32 would underflow to 0.)"""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
+        return float(jnp.finfo(jnp.finfo(dtype).dtype).tiny)
+    return float(jnp.finfo(dtype).tiny)
+
+
 def check_potrf(A0: TileMatrix, LL: TileMatrix, uplo: str = "L"):
     """||A - L L^H|| / (N ||A|| eps) — check_zpotrf semantics."""
     N = A0.desc.N
@@ -35,7 +44,9 @@ def check_potrf(A0: TileMatrix, LL: TileMatrix, uplo: str = "L"):
         rec = blas.dot(t, t, ta=True, conj_a=True)
     res = jnp.max(jnp.abs(a - rec))
     anorm = jnp.max(jnp.abs(a))
-    r = res / (anorm * _eps(A0.dtype) * N)
+    # zero-norm A0 (e.g. an all-zero generator) must give a finite
+    # residual, not 0/0 = NaN
+    r = res / jnp.maximum(anorm * _eps(A0.dtype) * N, _tiny(A0.dtype))
     return float(r), bool(r < THRESHOLD)
 
 
@@ -53,7 +64,7 @@ def check_axmb(A0: TileMatrix, b: TileMatrix, x: TileMatrix,
     r = bd - blas.dot(a, xd)
     num = jnp.max(jnp.abs(r))
     den = (jnp.max(jnp.abs(a)) * jnp.max(jnp.abs(xd)) * _eps(A0.dtype) * N)
-    val = num / jnp.maximum(den, jnp.finfo(jnp.float32).tiny)
+    val = num / jnp.maximum(den, _tiny(A0.dtype))
     return float(val), bool(val < THRESHOLD)
 
 
@@ -71,9 +82,11 @@ def check_qr(A0: TileMatrix, Q, R):
     """||A - Q R|| / (||A|| max(M,N) eps)."""
     a = A0.to_dense()
     rec = blas.dot(Q, R)
-    r = jnp.max(jnp.abs(a - rec)) / (
+    # the max(.., tiny) clamp keeps a zero-norm A0 finite even if the
+    # 1.0 floor is ever scaled away
+    r = jnp.max(jnp.abs(a - rec)) / jnp.maximum(
         jnp.maximum(jnp.max(jnp.abs(a)), 1.0)
-        * _eps(A0.dtype) * max(A0.desc.M, A0.desc.N))
+        * _eps(A0.dtype) * max(A0.desc.M, A0.desc.N), _tiny(A0.dtype))
     return float(r), bool(r < THRESHOLD)
 
 
@@ -93,5 +106,5 @@ def check_inverse(A0: TileMatrix, Ainv: TileMatrix, uplo: str | None = None):
     ai = norms._sym_full(Ainv, uplo, conj=True) if uplo else Ainv.to_dense()
     r = jnp.max(jnp.abs(jnp.eye(N, dtype=a.dtype) - blas.dot(a, ai)))
     den = jnp.max(jnp.abs(a)) * jnp.max(jnp.abs(ai)) * _eps(A0.dtype) * N
-    val = r / jnp.maximum(den, jnp.finfo(jnp.float32).tiny)
+    val = r / jnp.maximum(den, _tiny(A0.dtype))
     return float(val), bool(val < THRESHOLD)

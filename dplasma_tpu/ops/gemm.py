@@ -65,10 +65,10 @@ def device_memory_bytes(default_gb: float = 16.0) -> int:
     reference reads from the CUDA device module."""
     try:
         stats = jax.devices()[0].memory_stats() or {}
-        if "bytes_limit" in stats:
-            return int(stats["bytes_limit"])
     except Exception:
-        pass
+        stats = {}  # backend without memory introspection: use default
+    if "bytes_limit" in stats:
+        return int(stats["bytes_limit"])
     return int(default_gb * 2**30)
 
 

@@ -29,6 +29,7 @@ the comm layer (src/dplasmajdf.h:33-38).
 from __future__ import annotations
 
 import os
+import sys
 from typing import Optional
 
 import jax
@@ -79,8 +80,10 @@ def fini() -> None:
     if _initialized:
         try:
             jax.distributed.shutdown()
-        except Exception:
-            pass  # single-process init() never started the service
+        except Exception as exc:
+            # single-process init() never started the service; anything
+            # else is worth a note on the way down, never a crash
+            sys.stderr.write(f"#! distributed shutdown: {exc}\n")
         _initialized = False
 
 

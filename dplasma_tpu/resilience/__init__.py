@@ -1,0 +1,31 @@
+"""Resilience subsystem: ABFT checksums, deterministic fault
+injection, and the driver-side remediation ladder.
+
+The reference lineage treats soft errors as first-class: ABFT carries
+checksum rows/columns through dense factorizations so a corrupted tile
+is detected and located in O(n^2) instead of recomputed in O(n^3)
+(Huang & Abraham 1984; Bouteiller et al., ABFT for dense matrix
+factorizations on the PaRSEC/DPLASMA stack). This package is the
+TPU-native realization, in three pillars:
+
+- :mod:`~dplasma_tpu.resilience.inject` — seeded, deterministic fault
+  injection (``--inject=KIND@STAGE:RATE``, env ``DPLASMA_INJECT``) as
+  pure trace-time transforms, so every robustness claim is testable in
+  CI on any backend;
+- :mod:`~dplasma_tpu.resilience.abft` — checksum-augmented GEMM /
+  POTRF / LU variants (``--abft``): checksum tiles appended to the
+  ``TileMatrix`` and carried through the same compiled program, with
+  post-verification that detects and locates a corrupted tile (and
+  corrects it for GEMM by an O(mb·nb·K) tile recompute);
+- :mod:`~dplasma_tpu.resilience.guard` — the remediation ladder wired
+  into ``drivers/common.py``: health scan → classify (numerical /
+  compile / timeout / silent) → retry with backoff → Pallas→XLA kernel
+  fallback → algorithm escalation (LU nopiv → RBT → hybrid pivoting),
+  every attempt recorded in the run-report's ``"resilience"`` section.
+
+Submodules are imported directly (``from dplasma_tpu.resilience import
+inject``); this ``__init__`` stays import-light because
+``kernels.blas`` consults :mod:`inject` from the hot kernel layer.
+"""
+
+__all__ = ["abft", "guard", "inject"]

@@ -1,0 +1,242 @@
+"""Run guard: health scan, fault classification, watchdog, and the
+remediation ladder the driver walks after a failed attempt.
+
+The reference's only failure subsystem is INFO propagation
+(``ops/info.py`` — detect and report). This module adds *remediation*:
+after each driver run a cheap health scan (non-finite census over the
+output tree, plus the op's ABFT verification when ``--abft`` is on)
+gates the result; a failure is classified and the ladder walks, in
+order and within the ``--max-retries`` budget:
+
+1. **retry** (with exponential backoff) — soft errors are transient;
+   an armed fault plan stays :func:`inject.suppressed` on retries, so
+   an injected fault heals exactly like a real one recomputes clean;
+2. **kernel fallback** — disable the Pallas kernel paths and re-trace
+   on pure-XLA kernels (``kernels.pallas_kernels.enable(False)`` +
+   MCA ``lu.pallas_panel=off``) — the Pallas→XLA chore demotion;
+3. **algorithm escalation** — the driver body's ``fallbacks`` list
+   (e.g. LU nopiv → RBT-preconditioned nopiv → LU/QR hybrid pivoting
+   via the existing ``--criteria`` machinery).
+
+Classification picks the entry rung: ``numerical``/``silent`` failures
+start at retry; ``compile`` and ``timeout`` skip it (an identical
+re-trace fails or stalls identically). Every attempt, classification
+and action lands in the run-report's ``"resilience"`` section.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+CLASS_NUMERICAL = "numerical"
+CLASS_SILENT = "silent"          # finite but ABFT-flagged wrong answer
+CLASS_COMPILE = "compile"
+CLASS_TIMEOUT = "timeout"
+
+ACTION_PRIMARY = "primary"
+ACTION_RETRY = "retry"
+ACTION_KERNEL_FALLBACK = "kernel_fallback"
+ACTION_ALGO_FALLBACK = "algo_fallback"
+
+#: base backoff before a retry rung (doubles per attempt)
+_BACKOFF_S = 0.05
+
+
+def enabled(ip) -> bool:
+    """Is the resilience guard active for this run? Zero overhead when
+    no resilience flag is set (the un-guarded path stays as cheap as
+    before this layer existed)."""
+    return bool(getattr(ip, "inject", None) or getattr(ip, "abft", False)
+                or getattr(ip, "run_timeout", 0.0) > 0)
+
+
+def health_scan(out) -> dict:
+    """Non-finite census over the output tree (the cheap post-run
+    gate; one fused reduction per floating leaf)."""
+    import jax
+    import jax.numpy as jnp
+    nan = inf = 0
+    leaves = 0
+    for leaf in jax.tree_util.tree_leaves(out):
+        if not hasattr(leaf, "dtype") or not jnp.issubdtype(
+                jnp.dtype(leaf.dtype), jnp.inexact):
+            continue
+        leaves += 1
+        nan += int(jnp.isnan(leaf).sum())
+        inf += int(jnp.isinf(leaf).sum())
+    return {"nan": nan, "inf": inf, "leaves": leaves,
+            "ok": (nan + inf) == 0}
+
+
+class Watchdog:
+    """Watchdog on the timed loop: a timer thread flags (and logs) the
+    overrun as it happens; the ladder classifies the attempt as
+    ``timeout`` afterwards. XLA dispatch cannot be preempted mid-run,
+    so the watchdog observes rather than kills — the remediation is a
+    re-trace on a different rung, not a SIGKILL."""
+
+    def __init__(self, limit_s: float, label: str = ""):
+        self.limit_s = float(limit_s or 0.0)
+        self.label = label
+        self.fired = False
+        self.elapsed_s = 0.0
+        self._t0 = 0.0
+        self._timer: Optional[threading.Timer] = None
+
+    def _fire(self):
+        self.fired = True
+        sys.stderr.write(
+            f"#! watchdog: {self.label or 'run'} exceeded "
+            f"{self.limit_s:g}s\n")
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        if self.limit_s > 0:
+            self._timer = threading.Timer(self.limit_s, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+        return self
+
+    def __exit__(self, *exc):
+        if self._timer is not None:
+            self._timer.cancel()
+        self.elapsed_s = time.perf_counter() - self._t0
+        return False
+
+    @property
+    def timed_out(self) -> bool:
+        return self.limit_s > 0 and (self.fired
+                                     or self.elapsed_s > self.limit_s)
+
+
+def kernel_fallback() -> dict:
+    """Demote Pallas kernel paths to pure XLA for the rest of the
+    process (post-fault conservatism — the reference's chore demotion
+    drops a failing device body the same way). Returns what changed."""
+    from dplasma_tpu.kernels import pallas_kernels
+    from dplasma_tpu.utils import config
+    was = pallas_kernels.enabled()
+    pallas_kernels.enable(False)
+    config.mca_set("lu.pallas_panel", "off")
+    return {"pallas_was_enabled": bool(was),
+            "mca": {"lu.pallas_panel": "off"}}
+
+
+class Ladder:
+    """Remediation state machine for one ``Driver.progress`` call.
+
+    ``fallbacks`` is an ordered list of ``(label, fn)`` alternates
+    provided by the driver body; each must accept the same args as the
+    primary fn (its output contract may differ — the body dispatches on
+    :attr:`winner`).
+    """
+
+    def __init__(self, ip, name: str,
+                 fallbacks: Sequence[Tuple[str, Callable]] = ()):
+        self.name = name
+        self.max_retries = max(int(getattr(ip, "max_retries", 2)), 0)
+        self.attempts: List[dict] = []
+        self._fallbacks = list(fallbacks)
+        self._retries_used = 0
+        self._tried_kernel = False
+        self.winner = name
+        self.outcome = "clean"
+
+    @property
+    def nattempts(self) -> int:
+        return len(self.attempts)
+
+    def record(self, action: str, label: str, ok: bool,
+               classification: Optional[str] = None,
+               health: Optional[dict] = None,
+               abft: Optional[dict] = None,
+               elapsed_s: Optional[float] = None,
+               error: Optional[str] = None) -> dict:
+        entry = {"attempt": len(self.attempts), "action": action,
+                 "label": label, "ok": bool(ok),
+                 "classification": classification, "health": health,
+                 "abft": abft, "elapsed_s": elapsed_s, "error": error}
+        self.attempts.append(entry)
+        return entry
+
+    def classify(self, health: Optional[dict], abft: Optional[dict],
+                 timed_out: bool) -> str:
+        if timed_out:
+            return CLASS_TIMEOUT
+        if health is not None and not health["ok"]:
+            return CLASS_NUMERICAL
+        return CLASS_SILENT
+
+    def next_action(self, classification: str):
+        """Pick the next untried rung for this failure class.
+        ``--max-retries`` budgets the plain-retry rung; the fallback
+        rungs are each one-shot (bounded by construction), so a
+        deterministic failure still reaches the algorithm escalation.
+        Returns ``(action, label, fn|None)`` or ``None`` when the
+        ladder is exhausted."""
+        skip_retry = classification in (CLASS_COMPILE, CLASS_TIMEOUT)
+        if not skip_retry and self._retries_used < self.max_retries:
+            self._retries_used += 1
+            time.sleep(_BACKOFF_S * (2 ** (self._retries_used - 1)))
+            return (ACTION_RETRY, self.name, None)
+        if not self._tried_kernel:
+            self._tried_kernel = True
+            return (ACTION_KERNEL_FALLBACK, self.name, None)
+        if self._fallbacks:
+            label, fn = self._fallbacks.pop(0)
+            return (ACTION_ALGO_FALLBACK, label, fn)
+        return None
+
+    def summary(self, injection: Optional[dict]) -> dict:
+        ok_last = bool(self.attempts) and self.attempts[-1]["ok"]
+        abft_fixed = bool(
+            ok_last and (self.attempts[-1].get("abft") or {}).get(
+                "corrected"))
+        if not self.attempts:
+            self.outcome = "clean"
+        elif ok_last:
+            self.outcome = "remediated" \
+                if (len(self.attempts) > 1 or abft_fixed) else "clean"
+        else:
+            self.outcome = "failed"
+        return {"op": self.name, "enabled": True, "injection": injection,
+                "attempts": self.attempts, "outcome": self.outcome,
+                "winner": self.winner,
+                "faults_detected": sum(
+                    1 for a in self.attempts
+                    if not a["ok"] or (a.get("abft")
+                                       or {}).get("detected"))}
+
+
+def format_lines(summary: dict) -> List[str]:
+    """Human form of the resilience summary (``#+`` driver lines)."""
+    lines = []
+    inj = summary.get("injection")
+    if inj and inj.get("faults"):
+        for f in inj["faults"]:
+            lines.append(f"#+ resilience: injected {f['kind']} at "
+                         f"{f['stage']} site {f['site']} "
+                         f"index {tuple(f['index'])}")
+    for a in summary.get("attempts", ()):
+        if a["ok"]:
+            lines.append(f"#+ resilience: attempt {a['attempt']} "
+                         f"({a['action']}:{a['label']}) ok")
+        else:
+            h = a.get("health") or {}
+            extra = ""
+            if h and not h.get("ok", True):
+                extra = f" ({h['nan']} nan / {h['inf']} inf)"
+            ab = a.get("abft")
+            if ab and ab.get("detected"):
+                extra += (f" [abft located "
+                          f"{ab.get('located')}"
+                          + (" corrected" if ab.get("corrected") else "")
+                          + "]")
+            lines.append(f"#+ resilience: attempt {a['attempt']} "
+                         f"({a['action']}:{a['label']}) failed "
+                         f"[{a['classification']}]{extra}")
+    lines.append(f"#+ resilience: outcome {summary['outcome']} "
+                 f"after {len(summary.get('attempts', ()))} attempt(s)")
+    return lines

@@ -1,0 +1,62 @@
+"""Repo lint: failures must not be swallowed outside the resilience
+classifier (tools/lint_excepts.py) — bare ``except:`` and silent
+``except Exception: pass`` are rejected across ``dplasma_tpu/``."""
+import pathlib
+import sys
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_excepts  # noqa: E402
+
+
+def test_package_has_no_swallowed_excepts():
+    bad = lint_excepts.lint_tree(REPO / "dplasma_tpu")
+    assert not bad, "\n".join(f"{p}:{ln}: {m}" for p, ln, m in bad)
+
+
+def test_lint_flags_bare_except(tmp_path):
+    f = tmp_path / "bad1.py"
+    f.write_text(textwrap.dedent("""\
+        try:
+            x = 1
+        except:
+            x = 2
+    """))
+    msgs = lint_excepts.lint_file(f)
+    assert len(msgs) == 1 and "bare" in msgs[0][1]
+
+
+def test_lint_flags_silent_broad_pass(tmp_path):
+    f = tmp_path / "bad2.py"
+    f.write_text(textwrap.dedent("""\
+        try:
+            x = 1
+        except Exception:
+            pass
+    """))
+    msgs = lint_excepts.lint_file(f)
+    assert len(msgs) == 1 and "silent" in msgs[0][1]
+
+
+def test_lint_accepts_meaningful_broad_handler(tmp_path):
+    f = tmp_path / "ok.py"
+    f.write_text(textwrap.dedent("""\
+        try:
+            x = 1
+        except Exception:
+            x = 2          # fallback value: handled, not swallowed
+        except ValueError:
+            pass           # narrow catch may pass
+    """))
+    assert lint_excepts.lint_file(f) == []
+
+
+def test_lint_cli_exit_codes(tmp_path):
+    good = tmp_path / "g.py"
+    good.write_text("x = 1\n")
+    assert lint_excepts.main([str(good)]) == 0
+    bad = tmp_path / "b.py"
+    bad.write_text("try:\n    x = 1\nexcept:\n    pass\n")
+    assert lint_excepts.main([str(bad)]) == 1

@@ -1,0 +1,387 @@
+"""Resilience subsystem: deterministic fault injection, ABFT
+detect/locate/correct, the remediation ladder, watchdog, and the
+driver/report round-trip (the CI smoke: inject → detect → remediate →
+report, all on CPU)."""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.drivers import main
+from dplasma_tpu.drivers import common as dc
+from dplasma_tpu.kernels import blas as k
+from dplasma_tpu.ops import generators, lu, rbt
+from dplasma_tpu.resilience import abft, guard, inject
+
+
+# ------------------------------------------------------------ inject
+
+class TestFaultPlan:
+    def test_parse_grammar(self):
+        p = inject.parse_plan("nan@trsm:1", seed=7)
+        assert (p.kind, p.stage, p.rate, p.max_faults, p.seed) == \
+            ("nan", "trsm", 1.0, 1, 7)
+        p = inject.parse_plan("bitflip@gemm:0.25:0")
+        assert (p.kind, p.rate, p.max_faults) == ("bitflip", 0.25, 0)
+        p = inject.parse_plan("ZERO@any")
+        assert (p.kind, p.stage, p.rate) == ("zero", "any", 1.0)
+
+    def test_parse_rejects_bad_specs(self):
+        for bad in ("nan", "nan@", "gremlin@trsm:1", "nan@trsm:0",
+                    "nan@gem:1"):   # typo'd stage must not arm a no-op
+            with pytest.raises(ValueError):
+                inject.parse_plan(bad)
+
+
+def _trsm_args():
+    a = jnp.tril(jnp.ones((8, 8), jnp.float32) + jnp.eye(8, dtype=jnp.float32))
+    b = jnp.ones((8, 4), jnp.float32)
+    return a, b
+
+
+def test_injection_deterministic_jit_and_eager():
+    """Same seed + same plan => bit-identical corruption across runs,
+    on both the jit and non-jit paths."""
+    plan = inject.parse_plan("nan@trsm:1", seed=7)
+    a, b = _trsm_args()
+    with inject.active(plan) as f1:
+        eager = k.trsm(a, b, side="L", lower=True)
+    with inject.active(plan) as f2:
+        jitted = jax.jit(
+            lambda a, b: k.trsm(a, b, side="L", lower=True))(a, b)
+    with inject.active(plan) as f3:
+        again = k.trsm(a, b, side="L", lower=True)
+    assert f1 == f2 == f3 and len(f1) == 1
+    e, j, g = (np.asarray(x) for x in (eager, jitted, again))
+    assert np.array_equal(e, j, equal_nan=True)
+    assert np.array_equal(e, g, equal_nan=True)
+    assert int(np.isnan(e).sum()) == 1
+
+
+def test_bitflip_deterministic_and_significant():
+    plan = inject.parse_plan("bitflip@gemm:1", seed=11)
+    a, b = _trsm_args()
+    clean = np.asarray(k.dot(a, b))
+    with inject.active(plan) as f1:
+        y1 = np.asarray(k.dot(a, b))
+    with inject.active(plan):
+        y2 = np.asarray(jax.jit(k.dot)(a, b))
+    assert np.array_equal(y1, y2)
+    assert not np.array_equal(y1, clean)
+    (i, j) = f1[0]["index"]
+    assert (y1 != clean).sum() == 1 and y1[i, j] != clean[i, j]
+
+
+def test_zero_tile_and_inf_kinds():
+    a, b = _trsm_args()
+    with inject.active(inject.parse_plan("zero@gemm:1", seed=3)):
+        z = np.asarray(k.dot(a, b))
+    assert (z == 0).all()
+    with inject.active(inject.parse_plan("inf@gemm:1", seed=3)):
+        y = np.asarray(k.dot(a, b))
+    assert np.isinf(y).sum() == 1
+
+
+def test_suppression_and_disarm_are_clean():
+    plan = inject.parse_plan("nan@trsm:1:0", seed=5)
+    a, b = _trsm_args()
+    with inject.active(plan):
+        with inject.suppressed():
+            clean = k.trsm(a, b, side="L", lower=True)
+        assert not np.isnan(np.asarray(clean)).any()
+    after = k.trsm(a, b, side="L", lower=True)
+    assert not np.isnan(np.asarray(after)).any()
+
+
+def test_rate_and_count_semantics():
+    a, b = _trsm_args()
+    # unbounded count at rate 1: every site faults
+    with inject.active(inject.parse_plan("nan@gemm:1:0", seed=5)) as f:
+        k.dot(a, b)
+        k.dot(a, b)
+    assert len(f) == 2
+    # default count=1: only the first matching site
+    with inject.active(inject.parse_plan("nan@gemm:1", seed=5)) as f:
+        k.dot(a, b)
+        k.dot(a, b)
+    assert len(f) == 1 and f[0]["site"] == 0
+
+
+# -------------------------------------------------------------- ABFT
+
+def _gemm_operands(dtype=jnp.float64):
+    rng = np.random.default_rng(0)
+    M, N, K, t = 48, 40, 32, 16
+    A = TileMatrix.from_dense(rng.standard_normal((M, K)).astype(dtype), t, t)
+    B = TileMatrix.from_dense(rng.standard_normal((K, N)).astype(dtype), t, t)
+    C = TileMatrix.from_dense(rng.standard_normal((M, N)).astype(dtype), t, t)
+    return A, B, C
+
+
+@pytest.mark.parametrize("kind", ["nan", "bitflip"])
+def test_abft_gemm_detect_locate_correct(kind):
+    A, B, C = _gemm_operands()
+    ref = 0.5 * (A.to_dense() @ B.to_dense()) - 0.3 * C.to_dense()
+    with inject.active(inject.parse_plan(f"{kind}@gemm:1", seed=5)) as f:
+        out = abft.gemm_checksummed(0.5, A, B, -0.3, C)
+    assert len(f) == 1
+    plain, rep = abft.gemm_verify(out, 0.5, A, B, -0.3, C)
+    assert rep["detected"] and rep["corrected"] and rep["ok"]
+    assert len(rep["located"]) == 1
+    # corrected output is the true product again
+    assert float(jnp.max(jnp.abs(plain.to_dense() - ref))) < 1e-8
+
+
+def test_abft_gemm_clean_zero_faults():
+    A, B, C = _gemm_operands()
+    out = abft.gemm_checksummed(0.5, A, B, -0.3, C)
+    plain, rep = abft.gemm_verify(out, 0.5, A, B, -0.3, C)
+    assert not rep["detected"] and rep["ok"] and rep["located"] == []
+    ref = 0.5 * (A.to_dense() @ B.to_dense()) - 0.3 * C.to_dense()
+    assert float(jnp.max(jnp.abs(plain.to_dense() - ref))) < 1e-8
+
+
+def test_abft_potrf_detects_and_locates():
+    n, t = 64, 16
+    A0 = generators.plghe(float(n), n, t, seed=42, dtype=jnp.float64)
+    # clean: factor matches the plain path, zero faults
+    from dplasma_tpu.ops import potrf as potrf_mod
+    Lp, rep = abft.potrf_verify(abft.potrf_checksummed(A0, "L"), A0, "L")
+    assert not rep["detected"] and rep["ok"]
+    Lref = potrf_mod.potrf(A0, "L")
+    assert float(jnp.max(jnp.abs(Lp.to_dense() - Lref.to_dense()))) < 1e-8
+    # injected: detected, and the corrupted tile is in the located set
+    with inject.active(inject.parse_plan("nan@trsm:1", seed=1)) as f:
+        Laug = abft.potrf_checksummed(A0, "L")
+    assert len(f) == 1
+    _, rep = abft.potrf_verify(Laug, A0, "L")
+    assert rep["detected"] and not rep["ok"] and rep["located"]
+    # fault hit the first panel trsm (site 0, rows below the diagonal
+    # tile): its tile row must be among the located tiles
+    row_block = (f[0]["index"][0] + t) // t
+    assert any(loc[0] == row_block for loc in rep["located"])
+
+
+@pytest.mark.parametrize("pivoted", [False, True])
+def test_abft_getrf_detects_and_locates(pivoted):
+    n, t = 64, 16
+    A0 = generators.plghe(float(n), n, t, seed=43, dtype=jnp.float64)
+    if pivoted:
+        out, rep = abft.getrf_verify(abft.getrf_checksummed(A0), A0)
+        F, perm = out
+        assert perm.shape[0] == A0.desc.Mp
+    else:
+        F, rep = abft.getrf_nopiv_verify(
+            abft.getrf_nopiv_checksummed(A0), A0)
+    assert not rep["detected"] and rep["ok"]
+    assert F.desc == A0.desc
+    with inject.active(inject.parse_plan("bitflip@trsm:1", seed=1)) as f:
+        aug = abft.getrf_checksummed(A0) if pivoted \
+            else abft.getrf_nopiv_checksummed(A0)
+    assert len(f) == 1
+    if pivoted:
+        _, rep = abft.getrf_verify(aug, A0)
+    else:
+        _, rep = abft.getrf_nopiv_verify(aug, A0)
+    assert rep["detected"] and not rep["ok"] and rep["located"]
+
+
+# ----------------------------------------------------- guard / ladder
+
+def test_watchdog_timeout_classification():
+    import time
+    with guard.Watchdog(0.01, "probe") as wd:
+        time.sleep(0.05)
+    assert wd.timed_out and wd.fired
+    with guard.Watchdog(0.0, "probe") as wd:
+        pass
+    assert not wd.timed_out
+    with guard.Watchdog(30.0, "probe") as wd:
+        pass
+    assert not wd.timed_out
+
+
+def test_ladder_rung_order_and_budget():
+    # --max-retries budgets the retry rung: 2 retries, then the
+    # one-shot fallback rungs
+    ip = dc.IParam(max_retries=2)
+    lad = guard.Ladder(ip, "op", fallbacks=[("alt", lambda: None)])
+    lad.record("primary", "op", False, classification=guard.CLASS_NUMERICAL)
+    acts = [lad.next_action(guard.CLASS_NUMERICAL) for _ in range(5)]
+    assert [a[0] for a in acts[:4]] == [
+        guard.ACTION_RETRY, guard.ACTION_RETRY,
+        guard.ACTION_KERNEL_FALLBACK, guard.ACTION_ALGO_FALLBACK]
+    assert acts[3][1] == "alt" and acts[4] is None
+    # compile/timeout failures skip the plain retry rung
+    lad = guard.Ladder(ip, "op")
+    assert lad.next_action(guard.CLASS_COMPILE)[0] == \
+        guard.ACTION_KERNEL_FALLBACK
+    # --max-retries=0 disables the retry rung but not the fallbacks
+    lad = guard.Ladder(dc.IParam(max_retries=0), "op")
+    assert lad.next_action(guard.CLASS_NUMERICAL)[0] == \
+        guard.ACTION_KERNEL_FALLBACK
+
+
+def test_ladder_escalates_to_algorithm_fallback(capsys):
+    """Deterministic numerical failure (zero leading pivot kills
+    unpivoted LU every attempt) walks retry -> kernel fallback -> RBT
+    and ends remediated."""
+    rng = np.random.default_rng(5)
+    n, t = 64, 16
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    a[0, 0] = 0.0
+    A = TileMatrix.from_dense(a, t, t)
+    ip = dc.parse_arguments(["-N", str(n), "-t", str(t),
+                             "--max-retries", "1"])
+    ip.run_timeout = 3600.0   # enables the guard; never fires
+    drv = dc.Driver(ip, "nopiv_probe")
+    out, _ = drv.progress(
+        lu.getrf_nopiv, (A,), 1.0,
+        fallbacks=[("getrf_rbt", lambda x: lu.getrf_nopiv(
+            rbt.hebut(x, seed=3872, depth=2)))])
+    capsys.readouterr()
+    drv.close()
+    assert drv.winner == "getrf_rbt"
+    summary = drv.report.resilience[0]
+    assert summary["outcome"] == "remediated"
+    actions = [x["action"] for x in summary["attempts"]]
+    assert actions == ["primary", "retry", "kernel_fallback",
+                       "algo_fallback"]
+    assert bool(jnp.isfinite(out.data).all())
+
+
+# ------------------------------------------- driver/report round-trip
+
+def test_driver_inject_detect_remediate_report(tmp_path, capsys):
+    """The CI smoke: inject -> detect -> remediate -> verified answer
+    -> resilience section, end-to-end on CPU."""
+    rep = tmp_path / "resilience.json"
+    rc = main(["-N", "96", "-t", "32", "-x", "-v", "--abft",
+               "--inject=nan@trsm:1", f"--report={rep}"],
+              prog="testing_dpotrf")
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "SUCCESS" in out and "FAILED" not in out
+    assert "#+ resilience: injected nan at trsm" in out
+    assert "outcome remediated" in out
+    doc = json.load(open(rep))
+    assert doc["schema"] == 2
+    r = doc["resilience"][0]
+    assert r["injection"]["plan"].startswith("nan@trsm")
+    assert len(r["injection"]["faults"]) == 1
+    assert r["outcome"] == "remediated"
+    att = r["attempts"]
+    assert att[0]["ok"] is False
+    assert att[0]["classification"] == "numerical"
+    assert att[0]["abft"]["detected"] is True
+    assert att[-1]["ok"] is True
+    assert doc["checks"] and all(c["ok"] for c in doc["checks"])
+
+
+def test_driver_clean_run_reports_zero_faults(tmp_path, capsys):
+    """Same flags minus the injection: zero faults, one attempt, and
+    the classic stdout shape (perf line + SUCCESS checks)."""
+    rep = tmp_path / "clean.json"
+    rc = main(["-N", "96", "-t", "32", "-x", "--abft",
+               f"--report={rep}"], prog="testing_dpotrf")
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "TIME(s)" in out and "FAILED" not in out
+    assert "resilience" not in out   # quiet at default verbosity
+    doc = json.load(open(rep))
+    r = doc["resilience"][0]
+    assert r["outcome"] == "clean" and r["faults_detected"] == 0
+    assert len(r["attempts"]) == 1 and r["injection"] is None
+
+
+def test_driver_gemm_abft_corrects_inline(tmp_path, capsys):
+    """GEMM's ABFT corrects the located tile without a retry: one
+    attempt, outcome remediated, -x passes."""
+    rep = tmp_path / "gemm.json"
+    rc = main(["-N", "96", "-M", "80", "-K", "64", "-t", "32", "-x",
+               "--abft", "--inject=bitflip@gemm:1", f"--report={rep}"],
+              prog="testing_sgemm")
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "FAILED" not in out
+    doc = json.load(open(rep))
+    r = doc["resilience"][0]
+    assert r["outcome"] == "remediated"
+    assert len(r["attempts"]) == 1
+    ab = r["attempts"][0]["abft"]
+    assert ab["detected"] and ab["corrected"] and len(ab["located"]) == 1
+
+
+def test_driver_env_inject_default(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("DPLASMA_INJECT", "nan@trsm:1")
+    rep = tmp_path / "env.json"
+    rc = main(["-N", "64", "-t", "16", "-x", f"--report={rep}"],
+              prog="testing_dpotrf")
+    capsys.readouterr()
+    assert rc == 0
+    doc = json.load(open(rep))
+    assert doc["resilience"][0]["injection"]["plan"].startswith("nan@trsm")
+
+
+def test_failed_check_exits_nonzero(capsys, monkeypatch):
+    """A failed -x verification exits nonzero even if a body dropped
+    the return value (structural guarantee via Driver.check_failures)."""
+    from dplasma_tpu.ops import checks
+    monkeypatch.setattr(checks, "THRESHOLD", -1.0)
+    rc = main(["-N", "64", "-t", "16", "-x"], prog="testing_dpotrf")
+    out = capsys.readouterr().out
+    assert "FAILED" in out
+    assert rc != 0
+    # and the structural net itself: a body that swallows the code
+    ip = dc.parse_arguments(["-N", "8"])
+    drv = dc.Driver(ip, "probe")
+    drv.report_check("probe", 1.0, False)
+    capsys.readouterr()
+    drv.close()
+    assert drv.check_failures == 1
+
+
+def test_resilience_flags_parse():
+    ip = dc.parse_arguments(["-N", "8"])
+    assert not ip.abft and ip.inject is None
+    assert ip.max_retries == 2 and ip.run_timeout == 0.0
+    ip = dc.parse_arguments(["-N", "8", "--abft", "--inject=nan@trsm:1",
+                             "--max-retries", "5",
+                             "--run-timeout=2.5"])
+    assert ip.abft and ip.inject == "nan@trsm:1"
+    assert ip.max_retries == 5 and ip.run_timeout == 2.5
+
+
+# ------------------------------------------------------- checks fixes
+
+def test_check_axmb_tiny_clamp_uses_input_dtype():
+    """The denominator clamp must use the input's real dtype: with the
+    old f32 tiny, a denormal-scale f64 system inflated the residual."""
+    from dplasma_tpu.ops import checks
+    n, t = 8, 4
+    scale = 1e-60   # f64-representable, far below f32 tiny
+    a = np.eye(n) * scale
+    b = np.full((n, 1), scale)
+    x = np.ones((n, 1))
+    A = TileMatrix.from_dense(a, t, t)
+    B = TileMatrix.from_dense(b, t, t)
+    X = TileMatrix.from_dense(x, t, t)
+    r, ok = checks.check_axmb(A, B, X)
+    assert ok, r   # exact solve: residual must be ~0, not clamped huge
+    r, ok = checks.check_inverse(A, TileMatrix.from_dense(
+        np.eye(n) / scale, t, t))
+    assert ok, r
+
+
+def test_check_potrf_zero_norm_is_finite():
+    from dplasma_tpu.ops import checks
+    n, t = 8, 4
+    Z = TileMatrix.from_dense(np.zeros((n, n)), t, t)
+    r, ok = checks.check_potrf(Z, Z, "L")
+    assert np.isfinite(r) and ok
+    r, ok = checks.check_qr(Z, np.eye(n), np.zeros((n, n)))
+    assert np.isfinite(r) and ok

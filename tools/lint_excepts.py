@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Repo lint: no swallowed failures in ``dplasma_tpu/``.
+
+The resilience subsystem owns failure classification
+(``resilience/guard.py``); everywhere else an exception must either be
+handled meaningfully or propagate. Two patterns defeat that and are
+rejected:
+
+- bare ``except:`` — catches ``KeyboardInterrupt``/``SystemExit`` too;
+- ``except Exception:`` (or ``BaseException``) whose handler body is
+  only ``pass``/``...`` — a silently swallowed failure no classifier,
+  log, or ladder will ever see.
+
+A broad catch with a *meaningful* body (fallback assignment, log line,
+re-raise) is fine — broadness is sometimes the contract (e.g. backend
+compile errors surface as several exception types).
+
+Usage: ``python tools/lint_excepts.py [root ...]`` — exits nonzero and
+prints ``file:line: message`` per violation. Wired into CI via
+``tests/test_lint.py``.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(stmt, ast.Pass)
+               or (isinstance(stmt, ast.Expr)
+                   and isinstance(stmt.value, ast.Constant)
+                   and stmt.value.value is Ellipsis)
+               for stmt in handler.body)
+
+
+def lint_file(path: pathlib.Path) -> list:
+    """Return [(line, message)] violations for one Python file."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as exc:
+        return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append((node.lineno,
+                        "bare 'except:' (catches KeyboardInterrupt; "
+                        "name the exception)"))
+        elif _is_broad(node) and _is_silent(node):
+            out.append((node.lineno,
+                        "silent 'except Exception: pass' swallows "
+                        "failures outside the resilience classifier"))
+    return out
+
+
+def lint_tree(root: pathlib.Path) -> list:
+    """Return [(path, line, message)] for every .py under ``root``."""
+    out = []
+    for path in sorted(root.rglob("*.py")):
+        for line, msg in lint_file(path):
+            out.append((path, line, msg))
+    return out
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        args = [str(pathlib.Path(__file__).resolve().parent.parent
+                    / "dplasma_tpu")]
+    bad = []
+    for root in args:
+        p = pathlib.Path(root)
+        bad.extend(lint_tree(p) if p.is_dir() else
+                   [(p, ln, m) for ln, m in lint_file(p)])
+    for path, line, msg in bad:
+        sys.stderr.write(f"{path}:{line}: {msg}\n")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
