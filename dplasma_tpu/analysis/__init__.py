@@ -1,0 +1,28 @@
+"""Static analysis subsystem: prove properties before running them.
+
+The reference's JDF compiler statically checks every algorithm's
+parameterized task graph — a task never reads a tile no predecessor
+produced and never races another writer (SURVEY §3.3). This package is
+the reproduction's equivalent, split into the two layers where silent
+wrongness can enter:
+
+* :mod:`.dagcheck` — the tile-DAG dataflow verifier: acyclicity /
+  deadlock-freedom, def-before-use flow coverage, WAW/WAR race
+  detection via reachability, owner-computes rank consistency, and
+  reconciliation of cross-rank flow edges against the analytic
+  comm-volume model (:mod:`dplasma_tpu.observability.comm`). Driven by
+  ``--dagcheck`` on every driver and by ``tools/lint_all.py``.
+* :mod:`.jaxlint` — an AST linter for the repo-specific JAX/TPU
+  trace-safety rules (no concretization or Python branching on traced
+  values inside jitted bodies, tracer tests only via
+  :func:`dplasma_tpu.utils.is_concrete`, no mutable defaults, no
+  numpy on traced values in jit, no bare ``jnp.float64`` outside the
+  dd-emulation modules, no nondeterminism in kernels).
+"""
+from dplasma_tpu.analysis.dagcheck import (DagCheckError, check_dag,
+                                           rank_of_dist)
+from dplasma_tpu.analysis.jaxlint import lint_file as jaxlint_file
+from dplasma_tpu.analysis.jaxlint import lint_tree as jaxlint_tree
+
+__all__ = ["DagCheckError", "check_dag", "rank_of_dist",
+           "jaxlint_file", "jaxlint_tree"]

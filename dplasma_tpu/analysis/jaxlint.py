@@ -1,0 +1,324 @@
+"""JAX trace-safety linter: the repo-specific TPU/JAX rules as an AST pass.
+
+Tracing bugs on TPU are silent: a ``float()`` on a tracer aborts the
+trace with a cryptic error at best, a Python branch on a traced value
+bakes one side into the executable at worst, and a stray numpy call
+inside a jitted body forces a host round-trip that never shows up in
+profiles as anything but missing throughput. These rules encode the
+pitfalls this codebase has actually hit (plus the conventions that
+keep them out), enforced from ``tools/lint_all.py`` and tier-1.
+
+Rules:
+
+* **J001 concretize-in-jit** — ``float()/int()/bool()`` on a value
+  derived from a traced parameter inside a jit/shard_map body (aborts
+  tracing; hoist to the host or keep it symbolic).
+* **J002 tracer-isinstance** — ``isinstance(.., Tracer)`` anywhere
+  except the one allowlisted choke point,
+  :func:`dplasma_tpu.utils.is_concrete`.
+* **J003 mutable-default** — list/dict/set (literal or constructor)
+  default arguments.
+* **J004 numpy-in-jit** — ``np.*``/``numpy.*`` calls on traced values
+  inside jit/shard_map bodies (host round-trip / trace abort).
+* **J005 float64-literal** — ``jnp.float64`` passed as a call argument
+  (an array-creating dtype) outside the dd-emulation modules, which
+  are the config-guarded f64 route (``kernels._dd_active`` +
+  ``jax_enable_x64``). Dtype *comparisons* are fine anywhere.
+* **J006 nondeterminism-in-kernel** — ``time``/``random`` imports (or
+  ``np.random`` use) in kernel modules; kernels must be replayable.
+* **J007 traced-branch** — Python ``if``/``while`` on a value derived
+  from a traced parameter inside a jit/shard_map body (the branch is
+  resolved at trace time — recompilation hazard or wrong side baked
+  in).
+
+Traced-ness is a static approximation: the parameters of a
+jit/shard_map-decorated function (minus ``static_argnums`` /
+``static_argnames``) are traced; reference through static metadata
+attributes (``.shape``/``.dtype``/``.ndim``/...) launders the taint.
+Functions passed by name to a ``jit(..)``/``shard_map(..)`` call are
+treated as fully-traced bodies. Suppress a finding with a trailing
+``# jaxlint: ok`` (or ``# jaxlint: ok=J00x``) comment.
+
+Usage: ``python -m dplasma_tpu.analysis.jaxlint [root ...]`` — exits
+nonzero and prints ``file:line: CODE message`` per violation.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from typing import List, Optional, Set, Tuple
+
+#: attribute accesses on a traced value that yield static metadata
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "desc",
+                "dist", "sharding", "aval", "weak_type"}
+
+#: module (repo-relative, posix) allowed to spell isinstance(.., Tracer)
+TRACER_ALLOWLIST = {"dplasma_tpu/utils/__init__.py"}
+
+#: the config-guarded f64 route (active only under _dd_active /
+#: jax_enable_x64) where jnp.float64 construction is the whole point
+FLOAT64_ALLOWLIST = {"dplasma_tpu/kernels/dd.py",
+                     "dplasma_tpu/kernels/pallas_dd.py"}
+
+#: modules that must stay deterministic/replayable
+KERNEL_DIRS = ("dplasma_tpu/kernels",)
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*ok(?:=(\w+))?")
+
+Violation = Tuple[int, str, str]          # (line, code, message)
+
+
+def _suppressions(src: str) -> dict:
+    """line -> suppressed code ('' = all) from `# jaxlint: ok` comments."""
+    out = {}
+    for ln, text in enumerate(src.splitlines(), 1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out[ln] = m.group(1) or ""
+    return out
+
+
+def _dotted(node) -> str:
+    """'jax.jit' for Attribute/Name chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _jit_decoration(fn) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(static positions, static names) when ``fn`` is jit/shard_map-
+    decorated, else None. partial(jax.jit, static_argnums=..) and bare
+    jax.jit both count."""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dn = _dotted(target)
+        names = {dn, dn.rsplit(".", 1)[-1]}
+        if names & {"jit", "shard_map"}:
+            pass
+        elif isinstance(dec, ast.Call) and names & {"partial"}:
+            inner = dec.args[0] if dec.args else None
+            if _dotted(inner).rsplit(".", 1)[-1] not in ("jit",
+                                                         "shard_map"):
+                continue
+        else:
+            continue
+        spos: Set[int] = set()
+        snames: Set[str] = set()
+        if isinstance(dec, ast.Call):
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnums", "static_argnames"):
+                    try:
+                        v = ast.literal_eval(kw.value)
+                    except ValueError:
+                        continue
+                    vals = v if isinstance(v, (tuple, list)) else (v,)
+                    if kw.arg == "static_argnames":
+                        snames |= {str(x) for x in vals}
+                    elif kw.arg == "static_argnums":
+                        spos |= {int(x) for x in vals}
+        return spos, snames
+    return None
+
+
+class _Taint(ast.NodeVisitor):
+    """Does this expression reference a traced name other than through
+    static metadata attributes?"""
+
+    def __init__(self, traced: Set[str]):
+        self.traced = traced
+        self.hit = False
+
+    def visit_Attribute(self, node):
+        if node.attr in STATIC_ATTRS:
+            return                       # .shape/.dtype/... is static
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if node.id in self.traced:
+            self.hit = True
+
+
+def _tainted(expr, traced: Set[str]) -> bool:
+    t = _Taint(traced)
+    t.visit(expr)
+    return t.hit
+
+
+def _numpy_call(node: ast.Call) -> Optional[str]:
+    dn = _dotted(node.func)
+    if dn.startswith("np.") or dn.startswith("numpy."):
+        return dn
+    return None
+
+
+def _check_jit_body(fn, traced: Set[str], out: List[Violation]) -> None:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if (isinstance(f, ast.Name) and f.id in ("float", "int",
+                                                     "bool")
+                    and sub.args and _tainted(sub.args[0], traced)):
+                out.append((sub.lineno, "J001",
+                            f"{f.id}() concretizes a traced value "
+                            f"inside a jitted body of {fn.name}"))
+            dn = _numpy_call(sub)
+            if dn and any(_tainted(a, traced) for a in
+                          list(sub.args) +
+                          [k.value for k in sub.keywords]):
+                out.append((sub.lineno, "J004",
+                            f"numpy call {dn}() on a traced value "
+                            f"inside a jitted body of {fn.name}"))
+        elif isinstance(sub, (ast.If, ast.While)):
+            test = sub.test
+            if isinstance(test, ast.Compare) and any(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops):
+                continue                 # `x is None` guards are static
+            if _tainted(test, traced):
+                kw = "while" if isinstance(sub, ast.While) else "if"
+                out.append((sub.lineno, "J007",
+                            f"Python {kw}-branch on a traced value "
+                            f"inside a jitted body of {fn.name} "
+                            f"(resolved at trace time)"))
+
+
+def lint_source(src: str, rel: str) -> List[Violation]:
+    """Lint one module's source; ``rel`` is its repo-relative posix
+    path (drives the per-module allowlists)."""
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as exc:
+        return [(exc.lineno or 0, "J000", f"syntax error: {exc.msg}")]
+    out: List[Violation] = []
+    in_kernels = any(rel.startswith(d + "/") for d in KERNEL_DIRS)
+
+    # names passed by reference into a jit(..)/shard_map(..) call are
+    # traced bodies too (the `f = shard_map(body, mesh=...)` idiom)
+    wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            nm = _dotted(node.func).rsplit(".", 1)[-1]
+            if nm in ("jit", "shard_map") and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                wrapped.add(node.args[0].id)
+
+    for node in ast.walk(tree):
+        # J003: mutable defaults, every def in the package
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) + \
+                    [x for x in node.args.kw_defaults if x]:
+                mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) \
+                    or (isinstance(d, ast.Call)
+                        and isinstance(d.func, ast.Name)
+                        and d.func.id in ("list", "dict", "set"))
+                if mutable:
+                    out.append((d.lineno, "J003",
+                                f"mutable default argument in "
+                                f"{node.name} (shared across calls)"))
+        # J001/J004/J007: jit bodies
+        if isinstance(node, ast.FunctionDef):
+            dec = _jit_decoration(node)
+            params = [a.arg for a in node.args.args]
+            if dec is not None:
+                spos, snames = dec
+                traced = {a for i, a in enumerate(params)
+                          if i not in spos and a not in snames}
+                _check_jit_body(node, traced, out)
+            elif node.name in wrapped:
+                _check_jit_body(node, set(params), out)
+        # J002: tracer isinstance outside utils.is_concrete
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "isinstance" and len(node.args) == 2:
+            cls_arg = node.args[1]
+            names = cls_arg.elts if isinstance(cls_arg, ast.Tuple) \
+                else [cls_arg]
+            if any(_dotted(c).rsplit(".", 1)[-1] == "Tracer"
+                   for c in names) and rel not in TRACER_ALLOWLIST:
+                out.append((node.lineno, "J002",
+                            "isinstance(.., Tracer) outside "
+                            "utils.is_concrete() — use the shared "
+                            "choke point"))
+        # J005: jnp.float64 constructing an array — as the callee
+        # (jnp.float64(x)) or as a dtype argument — outside the dd
+        # modules; dtype *comparisons* stay legal everywhere
+        if isinstance(node, ast.Call) and rel not in FLOAT64_ALLOWLIST:
+            for a in [node.func] + list(node.args) + \
+                    [k.value for k in node.keywords]:
+                if isinstance(a, ast.Attribute) and \
+                        a.attr == "float64" and \
+                        _dotted(a) == "jnp.float64":
+                    out.append((a.lineno, "J005",
+                                "bare jnp.float64 literal outside the "
+                                "config-guarded dd modules (TPU has "
+                                "no native f64; route through "
+                                "kernels.dd or compare dtypes "
+                                "instead)"))
+        # J006: nondeterminism in kernels
+        if in_kernels:
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    if al.name.split(".")[0] in ("time", "random"):
+                        out.append((node.lineno, "J006",
+                                    f"nondeterministic module "
+                                    f"'{al.name}' imported in a "
+                                    f"kernel (kernels must replay "
+                                    f"bit-identically)"))
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] in ("time",
+                                                         "random"):
+                    out.append((node.lineno, "J006",
+                                f"nondeterministic import from "
+                                f"'{node.module}' in a kernel"))
+            elif isinstance(node, ast.Attribute):
+                if _dotted(node) in ("np.random", "numpy.random"):
+                    out.append((node.lineno, "J006",
+                                "np.random in a kernel (use keyed "
+                                "jax.random)"))
+
+    sup = _suppressions(src)
+    return [(ln, code, msg) for ln, code, msg in out
+            if sup.get(ln) is None or sup[ln] not in ("", code)]
+
+
+def lint_file(path, rel: Optional[str] = None) -> List[Violation]:
+    p = pathlib.Path(path)
+    if rel is None:
+        s = p.as_posix()
+        i = s.rfind("dplasma_tpu/")
+        rel = s[i:] if i >= 0 else p.name
+    return lint_source(p.read_text(), rel)
+
+
+def lint_tree(root) -> List[Tuple[pathlib.Path, int, str, str]]:
+    """[(path, line, code, message)] for every .py under ``root``."""
+    out = []
+    for path in sorted(pathlib.Path(root).rglob("*.py")):
+        for ln, code, msg in lint_file(path):
+            out.append((path, ln, code, msg))
+    return out
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        args = [str(pathlib.Path(__file__).resolve().parents[1])]
+    bad = []
+    for root in args:
+        p = pathlib.Path(root)
+        bad.extend(lint_tree(p) if p.is_dir() else
+                   [(p, ln, c, m) for ln, c, m in lint_file(p)])
+    for path, ln, code, msg in bad:
+        sys.stderr.write(f"{path}:{ln}: {code} {msg}\n")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

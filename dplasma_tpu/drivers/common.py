@@ -84,6 +84,7 @@ class IParam:
     scheduler: str = "LFQ"
     thread_multi: bool = False
     dot: Optional[str] = None
+    dagcheck: bool = False           # static dataflow verification
     # observability outputs (--profile/--report/--jaxtrace)
     profile: Optional[str] = None    # DTPUPROF1 binary trace
     report: Optional[str] = None     # versioned JSON run-report
@@ -134,6 +135,11 @@ Optional arguments:
  -c --cores -g --gpus -o --scheduler -V --vpmap -m : accepted for
                      compatibility (scheduling is compiled into XLA)
  --dot[=file]      : dump the trace-time tile DAG as graphviz
+ --dagcheck        : statically verify the analytic tile DAG before
+                     executing (acyclicity, def-before-use flow
+                     coverage, WAW/WAR races, owner-computes ranks,
+                     comm-model reconciliation); violations abort the
+                     run and the result lands in the run-report (v3)
  --profile[=file]  : write the binary DTPUPROF1 run trace (convert with
                      tools/tracecat.py; default file: run.prof)
  --report[=file]   : write the versioned JSON run-report (timings,
@@ -189,6 +195,7 @@ _LONG = {
     "thread_multi": ("thread_multi", None),
     "ht": ("_ht", _int),
     "abft": ("abft", None), "inject": ("inject", str),
+    "dagcheck": ("dagcheck", None),
     "max-retries": ("max_retries", _int),
     "run-timeout": ("run_timeout", float),
 }
@@ -419,6 +426,34 @@ class Driver:
         except Exception:
             return None
 
+    def _dagcheck(self, rec, name):
+        """--dagcheck: statically verify the recorded tile DAG
+        (analysis.dagcheck) before the timed loop runs — acyclicity,
+        def-before-use flow coverage, WAW/WAR races, owner-computes
+        rank consistency, and reconciliation of the cross-rank flow
+        edges against the analytic comm model. The summary lands in
+        the run-report (schema v3 ``"dagcheck"`` section); violations
+        raise DagCheckError so a wrong DAG never executes."""
+        from dplasma_tpu.analysis import dagcheck as dc
+        from dplasma_tpu.descriptors import Dist
+        ip = self.ip
+        dist = Dist(P=ip.P, Q=ip.Q, kp=ip.kp, kq=ip.kq)
+        res = dc.check_dag(rec, rank_of=dc.rank_of_dist(dist))
+        dc.check_comm(rec, _algo_of(self.name), ip.M, ip.N, ip.K,
+                      ip.MB, ip.NB, dist, res)
+        self.report.add_dagcheck(name, res.summary())
+        lbl = dict(op=name, prec=ip.prec)
+        reg = self.report.metrics
+        reg.counter("dagcheck_tasks_total", **lbl).inc(res.tasks)
+        reg.counter("dagcheck_diagnostics_total", **lbl).inc(
+            len(res.diagnostics))
+        if ip.rank == 0 and (ip.loud >= 2 or not res.ok):
+            print(res.format(name))
+            sys.stdout.flush()
+        if not res.ok:
+            raise dc.DagCheckError(res)
+        return res
+
     def _lower_compile(self, fn, args, name):
         """Trace+compile with the device-chore host fallback
         (the reference's multi-chore body selection,
@@ -539,8 +574,9 @@ class Driver:
                     max(-(-ip.N // max(ip.NB, 1)), 1) * \
                     max(-(-ip.K // max(ip.NB, 1)), 1)
                 want_dag = dag_fn is not None and (
-                    ip.dot or ((ip.report or ip.loud >= 3)
-                               and tiles <= _DAG_TILE_CAP))
+                    ip.dot or ip.dagcheck
+                    or ((ip.report or ip.loud >= 3)
+                        and tiles <= _DAG_TILE_CAP))
                 if want_dag:
                     from dplasma_tpu.observability.dag import (
                         dag_stats, format_dag_stats)
@@ -552,10 +588,18 @@ class Driver:
                         if ip.dot:
                             with open(ip.dot, "w") as f:
                                 f.write(rec.to_dot(name or "dag"))
+                        if ip.dagcheck:
+                            # verify before execute: a dataflow
+                            # violation aborts the run here, before
+                            # the timed loop ever dispatches
+                            self._dagcheck(rec, name)
                         dag_info = dag_stats(rec)
                     if ip.rank == 0 and ip.loud >= 3:
                         print(format_dag_stats(dag_info, name))
-                elif ip.dot:
+                elif ip.dagcheck and ip.rank == 0 and ip.loud >= 1:
+                    print(f"#+ dagcheck[{name}]: no analytic tile-DAG "
+                          f"builder for this op; skipped")
+                if not want_dag and ip.dot:
                     # no analytic tile-DAG builder for this op: fall
                     # back to the lowered XLA program text
                     # (tests/common.c:406-431)

@@ -48,6 +48,21 @@ def _put(drv: Driver, A: TileMatrix) -> TileMatrix:
     return A.like(pmesh.device_put2d(A.data, drv.mesh))
 
 
+def _dagm(drv: Driver, A: TileMatrix) -> TileMatrix:
+    """Layout view for the analytic DAG builders: the descriptor
+    re-dressed with the CLI grid. GSPMD owns actual placement (descs
+    stay 1x1), but the DAG's owner ranks — --dot coloring, the
+    --dagcheck owner-computes check, the comm reconciliation — model
+    the logical block-cyclic distribution ``-p/-q/--kp/--kq`` asks
+    for, the same layout the comm-volume model prices."""
+    import dataclasses
+
+    from dplasma_tpu.descriptors import Dist
+    ip = drv.ip
+    d = Dist(P=ip.P, Q=ip.Q, kp=ip.kp, kq=ip.kq)
+    return TileMatrix(A.data, dataclasses.replace(A.desc, dist=d))
+
+
 # ---------------------------------------------------------------- BLAS-3
 
 def gemm(drv: Driver):
@@ -67,7 +82,7 @@ def gemm(drv: Driver):
             out, alpha, A, B, beta, C)
     out, _ = drv.progress(
         fn, (A, B, C), lawn41.gemm(ip.M, ip.N, ip.K, cplx),
-        dag_fn=lambda rec: gemm_ops.dag(C, A, B, rec),
+        dag_fn=lambda rec: gemm_ops.dag(_dagm(drv, C), A, B, rec),
         verify_fn=verify)
     if ip.check:
         ref = alpha * (A.to_dense() @ B.to_dense()) + beta * C.to_dense()
@@ -186,7 +201,7 @@ def potrf(drv: Driver):
         verify = lambda out: _abft.potrf_verify(out, A0, "L")  # noqa: E731
     L, _ = drv.progress(fn, (A,),
                         lawn41.potrf(ip.N, _is_complex(ip.prec_dtype)),
-                        dag_fn=lambda rec: potrf_mod.dag(A, "L", rec),
+                        dag_fn=lambda rec: potrf_mod.dag(_dagm(drv, A), "L", rec),
                         verify_fn=verify)
     ret = 0
     if ip.check:
@@ -280,7 +295,7 @@ def geqrf(drv: Driver):
                           (_put(drv, A0),),
                           lawn41.geqrf(ip.M, ip.N,
                                        _is_complex(ip.prec_dtype)),
-                          dag_fn=lambda rec: qr.dag(A0, rec))
+                          dag_fn=lambda rec: qr.dag(_dagm(drv, A0), rec))
     if ip.check:
         Af, Tf = out
         Q = qr.ungqr(Af, Tf).to_dense()
@@ -467,7 +482,7 @@ def getrf_nopiv(drv: Driver):
             a, criterion=crit, alpha=qalpha)),
     ]
     out, _ = drv.progress(fn, (_put(drv, A0),), _lu_flops(ip),
-                          dag_fn=lambda rec: lu.dag(A0, rec),
+                          dag_fn=lambda rec: lu.dag(_dagm(drv, A0), rec),
                           verify_fn=verify, fallbacks=fallbacks)
     if ip.check:
         B = _gen(drv, ip.N, ip.K, 1)
@@ -508,7 +523,7 @@ def getrf_1d(drv: Driver):
         fn = lambda a: _abft.getrf_checksummed(a, hnb)  # noqa: E731
         verify = lambda out: _abft.getrf_verify(out, A0)  # noqa: E731
     out, _ = drv.progress(fn, (_put(drv, A0),), _lu_flops(ip),
-                          dag_fn=lambda rec: lu.dag(A0, rec),
+                          dag_fn=lambda rec: lu.dag(_dagm(drv, A0), rec),
                           verify_fn=verify)
     if ip.check:
         LU, perm = out

@@ -32,6 +32,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from dplasma_tpu import utils
+
 # Digit width for int8 limbs: |d| <= 2^7 - 1 = 127.
 W8 = 7
 
@@ -805,7 +807,7 @@ def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
     nt = N // nb
     if nt <= 1:
         return _potrf_tile_ir(A, refine=refine, need_inverse=False)[0]
-    if not isinstance(A, jax.core.Tracer):
+    if utils.is_concrete(A):
         # eager callers ride the shape-cached executables: same math,
         # one panel compile reused across all nt panels (the unrolled
         # graph costs ~20s AOT per panel at N=8192 — VERDICT r4 item 2)

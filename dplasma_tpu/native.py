@@ -88,6 +88,20 @@ def available() -> bool:
 # Block-cyclic owner maps
 # ---------------------------------------------------------------------
 
+def rank_of(dist, i: int, j: int) -> int:
+    """Owner rank of one tile — the single-tile form of
+    :func:`rank_grid`, through the same native entry point
+    (``dtpu_rank_of``) when built so checkers compare against the
+    exact source the builders used."""
+    lib = load()
+    if lib is not None:
+        d = _Dist(dist.P, dist.Q, dist.kp, dist.kq, dist.ip, dist.jq)
+        return int(lib.dtpu_rank_of(ctypes.byref(d), i, j))
+    pr = (i // dist.kp + dist.ip) % dist.P
+    pc = (j // dist.kq + dist.jq) % dist.Q
+    return int(pr * dist.Q + pc)
+
+
 def rank_grid(dist, MT: int, NT: int) -> np.ndarray:
     """Owner rank of every tile: (MT, NT) int32 array.
 

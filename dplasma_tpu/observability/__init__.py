@@ -9,7 +9,7 @@ skeleton in :mod:`dplasma_tpu.utils.profiling`:
 
 * :mod:`.metrics` — a labelled counter/gauge/histogram registry whose
   snapshot embeds in the versioned JSON run-report;
-* :mod:`.report` — the run-report itself (``"schema": 1``), assembled by
+* :mod:`.report` — the versioned run-report itself, assembled by
   :class:`dplasma_tpu.drivers.common.Driver` and consumed by ``bench.py``;
 * :mod:`.xla` — post-``compile()`` capture of XLA's
   ``cost_analysis()`` / ``memory_analysis()`` (model-flops vs XLA-flops

@@ -58,15 +58,17 @@ class _DagCounter:
     """Counts remote tile messages per flow over a P x Q k-cyclic grid."""
 
     def __init__(self, dist):
+        self.dist = dist
         self.P, self.Q = dist.P, dist.Q
         self.kp, self.kq = dist.kp, dist.kq
         self.ip, self.jq = dist.ip, dist.jq
         self.flows = {}
 
     def rank(self, i: int, j: int) -> int:
-        pr = (i // self.kp + self.ip) % self.P
-        pc = (j // self.kq + self.jq) % self.Q
-        return pr * self.Q + pc
+        # the one shared owner map (native.rank_of) — the DAG builders,
+        # the dagcheck owner/comm checks, and this model must agree
+        from dplasma_tpu import native
+        return native.rank_of(self.dist, i, j)
 
     def send(self, flow: str, src_tile, col_consumers=None,
              row_consumers=None) -> None:

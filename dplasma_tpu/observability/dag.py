@@ -14,7 +14,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 
-def dag_stats(rec, max_profile: int = 256) -> dict:
+def dag_stats(rec, max_profile: int = 256, verify: bool = False) -> dict:
     """Analytics of a recorded tile DAG.
 
     Returns task/edge counts, per-class task counts, the critical-path
@@ -23,7 +23,16 @@ def dag_stats(rec, max_profile: int = 256) -> dict:
     level, truncated to ``max_profile`` entries), and the parallelism
     ceiling ``tasks / critical_path``. Works on any DagRecorder-shaped
     object with ``tasks`` and ``edges``.
+
+    ``verify=True`` runs the static dataflow verifier
+    (:func:`dplasma_tpu.analysis.dagcheck.verify_dag`) as a
+    precondition — analytics over a DAG with races or uncovered reads
+    are garbage, so a violation raises ``DagCheckError`` instead of
+    returning numbers.
     """
+    if verify:
+        from dplasma_tpu.analysis.dagcheck import verify_dag
+        verify_dag(rec)
     n = len(rec.tasks)
     if n == 0:
         return {"tasks": 0, "edges": 0, "task_counts": {},

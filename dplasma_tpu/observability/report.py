@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 1``).
+"""The versioned JSON run-report (``"schema": 3``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -9,7 +9,7 @@ lines from a report rather than scraping stdout.
 
 Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
 
-    {"schema": 2, "name": ..., "created_unix_ns": ...,
+    {"schema": 3, "name": ..., "created_unix_ns": ...,
      "iparam": {...},              # the parsed driver parameter block
      "env": {"backend": ..., "jax": ..., "device_count": ...},
      "ops": [{"label": ..., "prec": ...,
@@ -28,11 +28,17 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                                    "elapsed_s", "error"}],
                      "outcome": "clean|remediated|failed",
                      "winner": ..., "faults_detected": ...}],  # (v2)
+     "dagcheck": [{"op", "ok", "tasks", "edges", "declared",
+                   "checked_reads", "checked_pairs", "skipped",
+                   "comm": {...} | null, "counts": {kind: n},
+                   "diagnostics": [{"kind", "message", "tasks",
+                                    "tile"}]}],            # (v3)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
-sections (additive — v1 readers of the other keys are unaffected;
-this reader accepts <= 2).
+sections; 3 adds ``"dagcheck"`` (--dagcheck static dataflow
+verification, analysis.dagcheck). All additive — v1 readers of the
+other keys are unaffected; this reader accepts <= 3.
 """
 from __future__ import annotations
 
@@ -44,7 +50,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 2
+REPORT_SCHEMA = 3
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -72,6 +78,7 @@ class RunReport:
         self.entries: List[dict] = []   # free-form (bench ladder)
         self.checks: List[dict] = []    # -x verification outcomes
         self.resilience: List[dict] = []  # per-op ladder summaries
+        self.dagcheck: List[dict] = []  # --dagcheck verification (v3)
         self.extra: dict = {}
         self._t0 = time.time_ns()
 
@@ -104,6 +111,13 @@ class RunReport:
         self.resilience.append(summary)
         return summary
 
+    def add_dagcheck(self, op: str, summary: dict) -> dict:
+        """Record one --dagcheck verification outcome (schema v3; see
+        analysis.dagcheck.CheckResult.summary)."""
+        entry = {"op": op, **summary}
+        self.dagcheck.append(entry)
+        return entry
+
     def snapshot(self) -> dict:
         env = {}
         try:
@@ -125,6 +139,8 @@ class RunReport:
             doc["checks"] = self.checks
         if self.resilience:
             doc["resilience"] = self.resilience
+        if self.dagcheck:
+            doc["dagcheck"] = self.dagcheck
         if self.entries:
             doc["entries"] = self.entries
         if self.extra:

@@ -296,7 +296,10 @@ def dag(C: TileMatrix, A: TileMatrix, B: TileMatrix, recorder=None):
             prev = None
             for kk in range(KT):
                 g = rec.task("gemm", m, n, kk, priority=kk,
-                             rank=int(ranks[m, n]))
+                             rank=int(ranks[m, n]),
+                             reads=[("A", m, kk), ("B", kk, n),
+                                    ("C", m, n)],
+                             writes=[("C", m, n)])
                 if prev is not None:
                     rec.edge(prev, g, "C")
                 prev = g

@@ -36,6 +36,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from dplasma_tpu import utils
 from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.kernels import blas as k
 from dplasma_tpu.kernels import householder as hh
@@ -381,7 +382,7 @@ def getrf_1d(A: TileMatrix):
     # N > 4096 at nb=512); below that the traced executable is ~3x
     # faster than the per-step dispatch chain (427 vs 136 GF/s at
     # 4096, measured r4)
-    if (use_dd and not isinstance(X, _jax.core.Tracer)
+    if (use_dd and utils.is_concrete(X)
             and min(X.shape) // A.desc.nb > 8):
         full, final_ids = _lu_sweep_dd_eager(X, A.desc.nb)
     else:
@@ -803,20 +804,25 @@ def dag(A: TileMatrix, recorder=None):
 
     def getrf_t(k):
         return rec.task("getrf", k, priority=pri("potrf", nt, k),
-                        rank=int(ranks[k, k]))
+                        rank=int(ranks[k, k]),
+                        reads=[(k, k)], writes=[(k, k)])
 
     def trsm_l_t(m, k):
         return rec.task("trsm_l", m, k, priority=pri("trsm", nt, k, m),
-                        rank=int(ranks[m, k]))
+                        rank=int(ranks[m, k]),
+                        reads=[(k, k), (m, k)], writes=[(m, k)])
 
     def trsm_u_t(k, n):
         return rec.task("trsm_u", k, n, priority=pri("trsm", nt, k, n),
-                        rank=int(ranks[k, n]))
+                        rank=int(ranks[k, n]),
+                        reads=[(k, k), (k, n)], writes=[(k, n)])
 
     def gemm_t(m, n, k):
         return rec.task("gemm", m, n, k,
                         priority=pri("gemm", nt, k, m, n),
-                        rank=int(ranks[m, n]))
+                        rank=int(ranks[m, n]),
+                        reads=[(m, k), (k, n), (m, n)],
+                        writes=[(m, n)])
 
     for k in range(KT):
         gk = getrf_t(k)

@@ -153,24 +153,34 @@ def dag(A: TileMatrix, uplo: str = "L", recorder=None):
     ranks = native.rank_grid(A.desc.dist, nt, nt)
     pri = native.potrf_priority
 
-    def rank_at(i, j):
-        return int(ranks[i, j] if lower else ranks[j, i])
+    def tile_t(i, j):
+        # uplo='U' transposes the tile each task lives on
+        return (i, j) if lower else (j, i)
 
-    def task(cls, ix, k, m, n, tile):
+    def rank_at(i, j):
+        return int(ranks[tile_t(i, j)])
+
+    def task(cls, ix, k, m, n, tile, reads, writes):
         return rec.task(cls, *ix, priority=pri(cls, nt, k, m, n),
-                        rank=rank_at(*tile))
+                        rank=rank_at(*tile),
+                        reads=[tile_t(*t) for t in reads],
+                        writes=[tile_t(*t) for t in writes])
 
     def potrf_t(k):
-        return task("potrf", (k,), k, 0, 0, (k, k))
+        return task("potrf", (k,), k, 0, 0, (k, k),
+                    reads=[(k, k)], writes=[(k, k)])
 
     def trsm_t(m, k):
-        return task("trsm", (m, k), k, m, 0, (m, k))
+        return task("trsm", (m, k), k, m, 0, (m, k),
+                    reads=[(k, k), (m, k)], writes=[(m, k)])
 
     def herk_t(k, m):
-        return task("herk", (k, m), k, m, 0, (m, m))
+        return task("herk", (k, m), k, m, 0, (m, m),
+                    reads=[(m, k), (m, m)], writes=[(m, m)])
 
     def gemm_t(m, n, k):
-        return task("gemm", (m, n, k), k, m, n, (m, n))
+        return task("gemm", (m, n, k), k, m, n, (m, n),
+                    reads=[(m, k), (n, k), (m, n)], writes=[(m, n)])
 
     for k in range(nt):
         pk = potrf_t(k)

@@ -26,6 +26,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from dplasma_tpu import utils
 from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.kernels import blas as k
 from dplasma_tpu.kernels import householder as hh
@@ -173,7 +174,7 @@ def geqrf(A: TileMatrix, *, panel_kernel=None) -> tuple[TileMatrix,
         from dplasma_tpu.kernels import dd as _dd
 
     if (use_dd and panel_kernel is None and KT > 1
-            and not isinstance(rest, jax.core.Tracer)):
+            and utils.is_concrete(rest)):
         # eager callers: per-step fused executables, persistent-cached
         # — the monolithic trace OOM-kills the compile helper > 2048
         panels, packs, rrows = _dd_sweep_eager(rest, nb, KT, NT)
@@ -405,6 +406,13 @@ def dag(A: TileMatrix, recorder=None):
     Pure index algebra like :func:`dplasma_tpu.ops.potrf.dag`.
     Priorities grow with the panel index (later panels sit deeper on
     the critical path).
+
+    Tile declarations split the panel-k diagonal tile into its ``V``
+    (reflectors, below the diagonal) and ``R`` regions: tsqrt(m,k)
+    updates only R while unmqr(k,n) reads only V — at whole-tile
+    granularity that pair would be a false write-after-read race, but
+    the regions are disjoint (the JDF expresses the same split through
+    per-region flows).
     """
     from dplasma_tpu import native
     from dplasma_tpu.utils import profiling
@@ -414,8 +422,21 @@ def dag(A: TileMatrix, recorder=None):
     ranks = native.rank_grid(A.desc.dist, MT, NT)
 
     def t(cls, *ix, tile):
+        if cls == "geqrt":
+            (k,) = ix
+            rd, wr = [(k, k)], [(k, k, "V"), (k, k, "R")]
+        elif cls == "unmqr":
+            k, n = ix
+            rd, wr = [(k, k, "V"), (k, n)], [(k, n)]
+        elif cls == "tsqrt":
+            m, k = ix
+            rd, wr = [(k, k, "R"), (m, k)], [(m, k), (k, k, "R")]
+        else:  # tsmqr(m, n, k) updates the [A(k,n); A(m,n)] couple
+            m, n, k = ix
+            rd, wr = [(m, k), (k, n), (m, n)], [(m, n), (k, n)]
         return rec.task(cls, *ix, priority=ix[-1],
-                        rank=int(ranks[tile[0], tile[1]]))
+                        rank=int(ranks[tile[0], tile[1]]),
+                        reads=rd, writes=wr)
 
     for k in range(KT):
         ge = t("geqrt", k, tile=(k, k))

@@ -1,3 +1,17 @@
 from dplasma_tpu.utils import config, flops
 
-__all__ = ["config", "flops"]
+__all__ = ["config", "flops", "is_concrete"]
+
+
+def is_concrete(x) -> bool:
+    """True when ``x`` is a concrete (non-traced) value.
+
+    The ONE sanctioned tracer test in the package: eager fast paths
+    (shape-cached executables, persistent compile caches) branch on it,
+    and the jaxlint rule J002 (:mod:`dplasma_tpu.analysis.jaxlint`)
+    rejects any other ``isinstance(.., Tracer)`` spelled outside this
+    module — a single choke point keeps trace-dependent control flow
+    auditable instead of scattered across kernels and ops.
+    """
+    import jax
+    return not isinstance(x, jax.core.Tracer)  # jaxlint: ok=J002

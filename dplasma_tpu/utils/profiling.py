@@ -183,6 +183,19 @@ class _Task:
     priority: int = 0
     rank: int = -1
     flops: float = 0.0
+    #: declared tile accesses for the static dataflow verifier
+    #: (analysis.dagcheck): tuples (i, j) | (mat, i, j) |
+    #: (mat, i, j, region); empty = undeclared (dataflow checks skip)
+    reads: Tuple[tuple, ...] = ()
+    writes: Tuple[tuple, ...] = ()
+
+    @property
+    def name(self) -> str:
+        return f"{self.cls}({','.join(map(str, self.index))})"
+
+
+def _norm_tiles(tiles) -> Tuple[tuple, ...]:
+    return tuple(tuple(t) for t in tiles) if tiles else ()
 
 
 @dataclass
@@ -193,16 +206,30 @@ class DagRecorder:
     each flow dependence. ``enabled`` gates all recording so the hooks
     are free when off (the default), like the reference's ``--dot``
     plumbing (ref tests/common.c:406-431).
+
+    Tasks may declare the tile sets they read/write (``reads=``/
+    ``writes=``; first write = the task's home tile under owner-
+    computes) — the static dataflow verifier
+    (:mod:`dplasma_tpu.analysis.dagcheck`) proves def-before-use and
+    race/deadlock freedom against them.
+
+    Re-registering a task (same class + index tuple) is a lookup; a
+    lookup whose explicit ``priority``/``rank``/``reads``/``writes``
+    CONFLICT with the first registration raises ``ValueError`` (the
+    recorder previously kept the stale first-registration metadata
+    silently). Set ``on_conflict="warn"`` to downgrade to a warning.
     """
 
     enabled: bool = False
     tasks: List[_Task] = field(default_factory=list)
     edges: List[Tuple[int, int, str]] = field(default_factory=list)
+    on_conflict: str = "raise"
     _names: Dict[Tuple[str, Tuple[int, ...]], int] = field(
         default_factory=dict)
 
     def task(self, cls: str, *index: int, priority: int = 0,
-             rank: int = -1, flops: float = 0.0) -> int:
+             rank: int = -1, flops: float = 0.0,
+             reads=None, writes=None) -> int:
         """Register (or look up) task instance cls(*index); returns id."""
         if not self.enabled:
             return -1
@@ -211,7 +238,30 @@ class DagRecorder:
         if tid is None:
             tid = len(self.tasks)
             self._names[key] = tid
-            self.tasks.append(_Task(tid, cls, key[1], priority, rank, flops))
+            self.tasks.append(_Task(tid, cls, key[1], priority, rank,
+                                    flops, _norm_tiles(reads),
+                                    _norm_tiles(writes)))
+            return tid
+        t = self.tasks[tid]
+        # conflict detection: defaults mean "lookup, don't care";
+        # explicit values must agree with the first registration
+        bad = []
+        if priority != 0 and priority != t.priority:
+            bad.append(f"priority {t.priority} vs {priority}")
+        if rank != -1 and rank != t.rank:
+            bad.append(f"rank {t.rank} vs {rank}")
+        if reads is not None and _norm_tiles(reads) != t.reads:
+            bad.append(f"reads {t.reads} vs {_norm_tiles(reads)}")
+        if writes is not None and _norm_tiles(writes) != t.writes:
+            bad.append(f"writes {t.writes} vs {_norm_tiles(writes)}")
+        if bad:
+            msg = (f"task {t.name} re-registered with conflicting "
+                   f"metadata: {'; '.join(bad)}")
+            if self.on_conflict == "warn":
+                import warnings
+                warnings.warn(msg, stacklevel=2)
+            else:
+                raise ValueError(msg)
         return tid
 
     def edge(self, src: int, dst: int, label: str = "") -> None:

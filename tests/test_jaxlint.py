@@ -1,0 +1,211 @@
+"""JAX trace-safety linter (analysis.jaxlint): every rule fires on a
+minimal fixture, the package itself is clean, and the sanctioned
+escapes (utils.is_concrete, the dd modules, suppression comments) are
+honored."""
+import pathlib
+import textwrap
+
+import pytest
+
+from dplasma_tpu.analysis import jaxlint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _codes(src, rel="dplasma_tpu/ops/x.py"):
+    return [c for _, c, _ in jaxlint.lint_source(
+        textwrap.dedent(src), rel)]
+
+
+def test_package_is_clean():
+    bad = jaxlint.lint_tree(REPO / "dplasma_tpu")
+    assert not bad, "\n".join(
+        f"{p}:{ln}: {c} {m}" for p, ln, c, m in bad)
+
+
+def test_j001_concretize_in_jit():
+    assert _codes("""\
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x)
+    """) == ["J001"]
+    # static metadata access launders the taint
+    assert _codes("""\
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x.shape[0])
+    """) == []
+    # static_argnums parameters are not traced
+    assert _codes("""\
+        import jax
+        from functools import partial
+        @partial(jax.jit, static_argnums=(1,))
+        def f(x, n):
+            return int(n)
+    """) == []
+
+
+def test_j002_tracer_isinstance_chokepoint():
+    src = """\
+        import jax
+        def f(x):
+            return not isinstance(x, jax.core.Tracer)
+    """
+    assert _codes(src) == ["J002"]
+    # the one allowlisted definition site
+    assert jaxlint.lint_source(textwrap.dedent(src),
+                               "dplasma_tpu/utils/__init__.py") == []
+
+
+def test_j003_mutable_default():
+    assert _codes("def f(x, y=[]):\n    return y\n") == ["J003"]
+    assert _codes("def f(x, *, y={}):\n    return y\n") == ["J003"]
+    assert _codes("def f(x, y=dict()):\n    return y\n") == ["J003"]
+    assert _codes("def f(x, y=()):\n    return y\n") == []
+
+
+def test_j004_numpy_in_jit():
+    assert _codes("""\
+        import jax
+        import numpy as np
+        @jax.jit
+        def f(x):
+            return np.asarray(x)
+    """) == ["J004"]
+    # numpy on static (trace-time) values is fine
+    assert _codes("""\
+        import jax
+        import numpy as np
+        @jax.jit
+        def f(x):
+            idx = np.arange(x.shape[0])
+            return x + idx.size
+    """) == []
+
+
+def test_j005_float64_literal():
+    src = """\
+        import jax.numpy as jnp
+        def f(x):
+            return jnp.zeros((2,), jnp.float64)
+    """
+    assert _codes(src) == ["J005"]
+    # the direct constructor spelling is construction too
+    assert _codes("""\
+        import jax.numpy as jnp
+        def f(x):
+            return jnp.float64(x)
+    """) == ["J005"]
+    # dtype comparison is not construction
+    assert _codes("""\
+        import jax.numpy as jnp
+        def f(x):
+            return x.dtype == jnp.float64
+    """) == []
+    # the dd-emulation modules are the guarded f64 route
+    assert jaxlint.lint_source(textwrap.dedent(src),
+                               "dplasma_tpu/kernels/dd.py") == []
+
+
+def test_j006_nondeterminism_in_kernels():
+    src = "import time\n"
+    assert _codes(src, "dplasma_tpu/kernels/k.py") == ["J006"]
+    assert _codes(src, "dplasma_tpu/ops/k.py") == []  # utils may time
+    assert _codes("from random import random\n",
+                  "dplasma_tpu/kernels/k.py") == ["J006"]
+
+
+def test_j007_traced_branch():
+    assert _codes("""\
+        import jax
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """) == ["J007"]
+    # shape branches and is-None guards are static
+    assert _codes("""\
+        import jax
+        @jax.jit
+        def f(x, y=None):
+            if x.shape[0] > 2:
+                x = x + 1
+            if y is None:
+                return x
+            return x + y
+    """) == []
+
+
+def test_wrapped_inner_body_is_traced():
+    assert _codes("""\
+        import jax
+        def outer(mesh):
+            def body(local):
+                return float(local)
+            return jax.shard_map(body, mesh=mesh)
+    """) == ["J001"]
+
+
+def test_suppression_comment():
+    assert _codes("""\
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x)  # jaxlint: ok
+    """) == []
+    assert _codes("""\
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x)  # jaxlint: ok=J001
+    """) == []
+    # a mismatched code does not suppress
+    assert _codes("""\
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x)  # jaxlint: ok=J004
+    """) == ["J001"]
+
+
+def test_cli_exit_codes(tmp_path):
+    good = tmp_path / "g.py"
+    good.write_text("x = 1\n")
+    assert jaxlint.main([str(good)]) == 0
+    bad = tmp_path / "b.py"
+    bad.write_text("def f(y=[]):\n    return y\n")
+    assert jaxlint.main([str(bad)]) == 1
+
+
+def test_is_concrete_helper():
+    """The shared choke point the three former ad-hoc tracer tests now
+    route through (kernels/dd, ops/lu, ops/qr)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dplasma_tpu import utils
+    assert utils.is_concrete(jnp.ones(()))
+    assert utils.is_concrete(1.0)
+    seen = []
+
+    def f(x):
+        seen.append(utils.is_concrete(x))
+        return x * 2
+    jax.jit(f)(jnp.ones(()))
+    assert seen == [False]
+
+
+def test_former_escape_sites_use_is_concrete():
+    """The three ad-hoc isinstance(.., Tracer) escapes are gone; only
+    utils.is_concrete spells the tracer test."""
+    offenders = []
+    for p in sorted((REPO / "dplasma_tpu").rglob("*.py")):
+        rel = p.relative_to(REPO).as_posix()
+        if rel == "dplasma_tpu/utils/__init__.py":
+            continue
+        if "core.Tracer" in p.read_text():
+            offenders.append(rel)
+    assert offenders == []

@@ -1,6 +1,11 @@
-"""Repo lint: failures must not be swallowed outside the resilience
-classifier (tools/lint_excepts.py) — bare ``except:`` and silent
-``except Exception: pass`` are rejected across ``dplasma_tpu/``."""
+"""Repo lint gates, enforced from tier-1:
+
+* tools/lint_excepts.py — bare ``except:`` and silent
+  ``except Exception: pass`` are rejected across ``dplasma_tpu/``;
+* tools/lint_all.py — the aggregate runner (lint_excepts + the
+  analysis.jaxlint trace-safety rules + a dagcheck smoke pass over
+  tiny DAGs of all four ops) must exit 0 on the repo.
+"""
 import pathlib
 import sys
 import textwrap
@@ -60,3 +65,15 @@ def test_lint_cli_exit_codes(tmp_path):
     bad = tmp_path / "b.py"
     bad.write_text("try:\n    x = 1\nexcept:\n    pass\n")
     assert lint_excepts.main([str(bad)]) == 1
+
+
+def test_lint_all_aggregate_is_clean(capsys):
+    """tools/lint_all.py gates every rule with one exit code: excepts,
+    jaxlint, and the dagcheck smoke pass must all be clean on the
+    repo."""
+    import lint_all
+    rc = lint_all.main([])
+    out = capsys.readouterr()
+    assert rc == 0, out.err
+    for gate in ("lint_excepts", "jaxlint", "dagcheck-smoke"):
+        assert f"# {gate}: OK" in out.out

@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""Aggregate static-analysis runner: every repo gate with one exit code.
+
+Three passes, in increasing cost order:
+
+1. ``tools/lint_excepts.py`` — no swallowed failures in
+   ``dplasma_tpu/``;
+2. ``dplasma_tpu.analysis.jaxlint`` — the JAX/TPU trace-safety rules
+   (tracer concretization, mutable defaults, numpy-in-jit, float64
+   literals, kernel nondeterminism);
+3. a ``dplasma_tpu.analysis.dagcheck`` smoke pass — the analytic tile
+   DAGs of all four ops (potrf/lu/qr/gemm) at 3x3 tiles on 1x1 and
+   2x2 grids must verify clean, with the comm-model reconciliation
+   exact for the owner-computes classes.
+
+Usage: ``python tools/lint_all.py`` — prints ``file:line: message``
+per violation / one line per failed smoke DAG, exits nonzero on any.
+Wired into tier-1 via ``tests/test_lint.py``.
+"""
+from __future__ import annotations
+
+import pathlib
+import sys
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_ROOT))
+sys.path.insert(0, str(_ROOT / "tools"))
+
+
+def run_excepts(pkg: pathlib.Path) -> int:
+    import lint_excepts
+    bad = lint_excepts.lint_tree(pkg)
+    for path, line, msg in bad:
+        sys.stderr.write(f"{path}:{line}: {msg}\n")
+    return len(bad)
+
+
+def run_jaxlint(pkg: pathlib.Path) -> int:
+    from dplasma_tpu.analysis import jaxlint
+    bad = jaxlint.lint_tree(pkg)
+    for path, line, code, msg in bad:
+        sys.stderr.write(f"{path}:{line}: {code} {msg}\n")
+    return len(bad)
+
+
+def run_dagcheck_smoke() -> int:
+    """Tiny-DAG verification sweep (the lint-speed subset of the
+    tests/test_dagcheck.py golden fixtures)."""
+    from dplasma_tpu.analysis.dagcheck import (check_comm, check_dag,
+                                               rank_of_dist)
+    from dplasma_tpu.descriptors import Dist, TileMatrix
+    from dplasma_tpu.ops import gemm, lu, potrf, qr
+    from dplasma_tpu.utils.profiling import DagRecorder
+
+    nb, nt = 4, 3
+    bad = 0
+    for dist in (Dist(), Dist(P=2, Q=2)):
+        N = nt * nb
+        A = TileMatrix.zeros(N, N, nb, nb, dist=dist)
+        cases = [
+            ("potrf", lambda r: potrf.dag(A, "L", r), "potrf", 1),
+            ("lu", lambda r: lu.dag(A, r), "getrf", 1),
+            ("qr", lambda r: qr.dag(A, r), "geqrf", 1),
+        ]
+        for label, build, op, K in cases:
+            rec = DagRecorder(enabled=True)
+            build(rec)
+            res = check_dag(rec, rank_of=rank_of_dist(dist))
+            check_comm(rec, op, N, N, K, nb, nb, dist, res)
+            if not res.ok:
+                sys.stderr.write(res.format(
+                    f"{label} {dist.P}x{dist.Q}") + "\n")
+                bad += len(res.diagnostics)
+        C = TileMatrix.zeros(N, N, nb, nb, dist=dist)
+        Am = TileMatrix.zeros(N, 2 * nb, nb, nb, dist=dist)
+        Bm = TileMatrix.zeros(2 * nb, N, nb, nb, dist=dist)
+        rec = DagRecorder(enabled=True)
+        gemm.dag(C, Am, Bm, rec)
+        res = check_dag(rec, rank_of=rank_of_dist(dist))
+        check_comm(rec, "gemm", N, N, 2 * nb, nb, nb, dist, res)
+        if not res.ok:
+            sys.stderr.write(res.format(
+                f"gemm {dist.P}x{dist.Q}") + "\n")
+            bad += len(res.diagnostics)
+    return bad
+
+
+def main(argv=None) -> int:
+    pkg = _ROOT / "dplasma_tpu"
+    bad = 0
+    for name, fn in (("lint_excepts", lambda: run_excepts(pkg)),
+                     ("jaxlint", lambda: run_jaxlint(pkg)),
+                     ("dagcheck-smoke", run_dagcheck_smoke)):
+        n = fn()
+        print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")
+        bad += n
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
