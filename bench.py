@@ -225,6 +225,12 @@ def main():
     ladder = report.entries
     peaks = report.extra.setdefault("peaks", {})
     report.extra["budget_s"] = budget_s
+    # active pipeline shape of the factorization sweeps (schema v4):
+    # the ladder's getrf/geqrf/potrf entries run with THIS config
+    from dplasma_tpu.ops._sweep import sweep_params
+    la, agg = sweep_params()
+    pipeline = {"sweep.lookahead": la, "qr.agg_depth": agg}
+    report.pipeline = pipeline
 
     def remaining():
         return deadline - time.monotonic()
@@ -251,6 +257,7 @@ def main():
             "elapsed_s": round(budget_s - remaining(), 1),
             "ladder": ladder,
             "peaks": peaks,
+            "pipeline": pipeline,
         }
         report.extra["headline"] = {
             k: doc[k] for k in ("metric", "value", "unit",

@@ -31,6 +31,8 @@ PRECISIONS = {"s": "float32", "d": "float64", "c": "complex64",
 
 SCHEDULERS = ("LFQ", "LTQ", "AP", "LHQ", "GD", "PBQ", "IP", "RND")
 
+_UNSET = object()   # sentinel for the scoped --lookahead MCA override
+
 # Implicit DAG-analytics cap (--report / -v>=3): the analytic tile-DAG
 # builders materialize O(tiles^1.5) tasks in Python, so past this many
 # tiles the run-report carries an explicit null instead (an explicit
@@ -76,6 +78,8 @@ class IParam:
     # LU/QR hybrid (--criteria/-a)
     criteria: int = 0
     alpha: float = -1.0
+    # pipelined-sweep lookahead (--lookahead; -1 = MCA sweep.lookahead)
+    lookahead: int = -1
     # butterfly (-y)
     butterfly_level: int = 0
     # accepted-for-compat knobs (scheduling/threads are XLA's job on TPU)
@@ -127,6 +131,11 @@ Optional arguments:
  -d --domino -r --tsrr : HQR domino / TS round-robin toggles
  --treel --treeh   : HQR low/high level tree (0 flat 1 greedy 2 fibonacci 3 binary 4 greedy1p)
  --criteria -a --alpha : LU/QR switch criteria and threshold
+ --lookahead       : pipelined-sweep lookahead depth (panels updated
+                     ahead of the wide trailing update; 0 = the
+                     serialized baseline; default: MCA sweep.lookahead,
+                     1). QR far-update aggregation rides MCA
+                     qr.agg_depth.
  --seed --mtx      : generator seed / matrix kind
  -y --butlvl       : butterfly level
  --nruns           : number of timed runs
@@ -187,6 +196,7 @@ _LONG = {
     "treel": ("lowlvl_tree", _int), "treeh": ("highlvl_tree", _int),
     "domino": ("qr_domino", _int), "tsrr": ("qr_tsrr", _int),
     "criteria": ("criteria", _int), "alpha": ("alpha", float),
+    "lookahead": ("lookahead", _int),
     "seed": ("seed", _int), "mtx": ("mtx", _int),
     "butlvl": ("butterfly_level", _int),
     "nruns": ("nruns", _int),
@@ -348,9 +358,22 @@ class Driver:
 
         from dplasma_tpu.parallel import mesh as pmesh
 
+        from dplasma_tpu.ops._sweep import sweep_params
+        from dplasma_tpu.utils import config as _cfg
+
         self.ip = ip
         self.name = name
         self.mesh = None
+        # resolve the pipeline shape WITHOUT touching global state yet
+        # (the MCA override is applied at the very end of __init__,
+        # after everything that can raise — a failed construction must
+        # not leak the process-global knob)
+        wants_la = getattr(ip, "lookahead", -1) >= 0
+        la, agg = sweep_params(
+            lookahead=ip.lookahead if wants_la else None)
+        self.pipeline = {"sweep.lookahead": la, "qr.agg_depth": agg}
+        self._mca_prev_la = _UNSET
+        self._la_override_active = False
         # resilience bookkeeping: which fn produced the last progress()
         # output (primary name or a ladder fallback label), and how many
         # -x verifications failed (run_driver turns that into exit 1)
@@ -362,6 +385,7 @@ class Driver:
         self.prof.save_info("driver", name)
         self.prof.save_info("prec", getattr(ip, "prec", "d"))
         self.report = RunReport(name, ip)
+        self.report.pipeline = dict(self.pipeline)   # schema v4
         try:
             # cache now: the lookup can fail after a backend error
             self._cpu = jax.devices("cpu")[0]
@@ -378,8 +402,23 @@ class Driver:
         self._cm = pmesh.use_grid(self.mesh) if self.mesh else None
         if self._cm:
             self._cm.__enter__()
+        if wants_la:
+            # --lookahead: scoped MCA override (restored at close() so
+            # back-to-back Drivers in one process never leak the knob);
+            # applied last — nothing below this line raises
+            self._mca_prev_la = _cfg._MCA_OVERRIDES.get(
+                "sweep.lookahead", _UNSET)
+            _cfg.mca_set("sweep.lookahead", ip.lookahead)
+            self._la_override_active = True
 
     def close(self):
+        from dplasma_tpu.utils import config as _cfg
+        if getattr(self, "_la_override_active", False):
+            if self._mca_prev_la is _UNSET:
+                _cfg.mca_unset("sweep.lookahead")
+            else:
+                _cfg.mca_set("sweep.lookahead", self._mca_prev_la)
+            self._la_override_active = False
         ip = self.ip
         if getattr(ip, "profile", None):
             try:
@@ -566,6 +605,13 @@ class Driver:
             enq = time.perf_counter() - t0
             if first_compile:
                 first_compile = False
+                if ip.rank == 0 and ip.loud >= 2 and \
+                        not getattr(self, "_pipe_printed", False):
+                    self._pipe_printed = True
+                    print("#+ pipeline: sweep.lookahead=%d "
+                          "qr.agg_depth=%d"
+                          % (self.pipeline["sweep.lookahead"],
+                             self.pipeline["qr.agg_depth"]))
                 # analytic DAG construction is cubic-ish in tile count;
                 # the implicit consumers (--report, -v>=3) cap it, the
                 # explicit --dot opt-in always honors the request. K

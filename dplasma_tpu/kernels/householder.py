@@ -221,6 +221,47 @@ def apply_q_right(v, t, c, *, trans: str = "N"):
     return c - k.dot(k.dot(w, tt), v, tb=True, conj_b=True)
 
 
+def wy_merge(v1, t1, v2, t2):
+    """Compact-WY of the product Q1 Q2 (``v2`` already embedded in
+    ``v1``'s row frame): with Q_i = I - V_i T_i V_i^H,
+
+        Q1 Q2 = I - [V1 V2] [[T1, T12], [0, T2]] [V1 V2]^H,
+        T12 = -T1 (V1^H V2) T2
+
+    — the standard block-T accumulation (CORE_zlarft's block column
+    recurrence at panel granularity). Returns (V, T) of the merged
+    reflector block."""
+    s = k.dot(v1, v2, ta=True, conj_a=True)
+    t12 = k.dot(-k.dot(t1, s), t2)
+    w1, w2 = t1.shape[0], t2.shape[0]
+    T = jnp.concatenate([
+        jnp.concatenate([t1, t12], axis=1),
+        jnp.concatenate([jnp.zeros((w2, w1), v1.dtype), t2], axis=1)],
+        axis=0)
+    return jnp.concatenate([v1, v2], axis=1), T
+
+
+def wy_stack(panels):
+    """Aggregate consecutive sweep panels ``[(V_0, T_0), (V_1, T_1),
+    ...]`` — each V_i living in its own shrinking window frame (height
+    decreasing by the panel width per step) — into ONE compact-WY pair
+    in the frame of the first panel: each V_i is zero-padded at the
+    top by its frame offset (reflector i never touches rows above its
+    panel) and merged by :func:`wy_merge`. The result applies d skinny
+    panel reflectors as one rank-``sum(nb_i)`` block reflector — the
+    update-aggregation kernel of the pipelined QR sweep (one MXU
+    product pair over the far trailing matrix instead of d)."""
+    v, T = panels[0]
+    h = v.shape[0]
+    for vi, ti in panels[1:]:
+        off = h - vi.shape[0]
+        vf = jnp.concatenate(
+            [jnp.zeros((off, vi.shape[1]), vi.dtype), vi], axis=0) \
+            if off else vi
+        v, T = wy_merge(v, T, vf, ti)
+    return v, T
+
+
 def stacked_qr(top, bot):
     """QR of the vertical couple [top; bot] — the generic TS/TT kernel
     (CORE_ztsqrt / CORE_zttqrt analog; both reduce to one dense QR of

@@ -33,12 +33,17 @@ def dag_stats(rec, max_profile: int = 256, verify: bool = False) -> dict:
     if verify:
         from dplasma_tpu.analysis.dagcheck import verify_dag
         verify_dag(rec)
+    # builder-stamped pipeline shape (lookahead/aggregation of the
+    # pipelined sweeps): carried with the critical-path stats so a
+    # report reader can attribute a shorter critical path to the
+    # pipeline config that produced it
+    pipeline = getattr(rec, "meta", {}).get("pipeline")
     n = len(rec.tasks)
     if n == 0:
         return {"tasks": 0, "edges": 0, "task_counts": {},
                 "critical_path": 0, "critical_path_classes": {},
                 "wavefronts": [], "max_width": 0, "avg_width": None,
-                "parallelism_ceiling": None}
+                "parallelism_ceiling": None, "pipeline": pipeline}
     counts: Dict[str, int] = {}
     for t in rec.tasks:
         counts[t.cls] = counts.get(t.cls, 0) + 1
@@ -92,6 +97,7 @@ def dag_stats(rec, max_profile: int = 256, verify: bool = False) -> dict:
         "max_width": max(widths),
         "avg_width": n / depth,
         "parallelism_ceiling": n / depth,
+        "pipeline": pipeline,
     }
 
 
@@ -108,6 +114,12 @@ def format_dag_stats(stats: dict, name: str = "dag") -> str:
         f" max wavefront {stats['max_width']},"
         f" parallelism ceiling {stats['parallelism_ceiling']:.2f}x",
     ]
+    pipe = stats.get("pipeline")
+    if pipe:
+        lines.append(
+            f"#+ DAG[{name}]: pipelined sweep (lookahead="
+            f"{pipe.get('lookahead')}, agg_depth="
+            f"{pipe.get('agg_depth')})")
     prof = stats["wavefronts"]
     if prof:
         shown = ",".join(str(w) for w in prof[:32])

@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 3``).
+"""The versioned JSON run-report (``"schema": 4``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -33,12 +33,16 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                    "comm": {...} | null, "counts": {kind: n},
                    "diagnostics": [{"kind", "message", "tasks",
                                     "tile"}]}],            # (v3)
+     "pipeline": {"sweep.lookahead": n,
+                  "qr.agg_depth": d} | absent,             # (v4)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
 sections; 3 adds ``"dagcheck"`` (--dagcheck static dataflow
-verification, analysis.dagcheck). All additive — v1 readers of the
-other keys are unaffected; this reader accepts <= 3.
+verification, analysis.dagcheck); 4 adds ``"pipeline"`` (the active
+lookahead/aggregation shape of the pipelined factorization sweeps).
+All additive — v1 readers of the other keys are unaffected; this
+reader accepts <= 4.
 """
 from __future__ import annotations
 
@@ -50,7 +54,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 3
+REPORT_SCHEMA = 4
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -79,6 +83,7 @@ class RunReport:
         self.checks: List[dict] = []    # -x verification outcomes
         self.resilience: List[dict] = []  # per-op ladder summaries
         self.dagcheck: List[dict] = []  # --dagcheck verification (v3)
+        self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
         self.extra: dict = {}
         self._t0 = time.time_ns()
 
@@ -141,6 +146,8 @@ class RunReport:
             doc["resilience"] = self.resilience
         if self.dagcheck:
             doc["dagcheck"] = self.dagcheck
+        if self.pipeline is not None:
+            doc["pipeline"] = self.pipeline
         if self.entries:
             doc["entries"] = self.entries
         if self.extra:

@@ -1,13 +1,130 @@
-"""Shared assembly for shrinking-window factorization sweeps.
+"""Shared assembly + pipelined engine for shrinking-window
+factorization sweeps.
 
 The right-looking geqrf/getrf sweeps keep the trailing submatrix as a
 fresh value per step (no dynamic-update-slice rematerialization of the
 full matrix) and stitch the global packed factor back together at the
 end — the dual of the reference's in-place tile writes (zpotrf_L.jdf /
-zgetrf_1d.jdf write tiles through the PaRSEC data copies)."""
+zgetrf_1d.jdf write tiles through the PaRSEC data copies).
+
+:func:`pipelined_sweep` is the *lookahead* engine (Kurzak & Dongarra's
+tiled LU/QR lookahead, HPL's panel pipelining; the reference gets the
+same effect structurally from PaRSEC's dataflow scheduler, which runs
+step k+1's panel tasks as soon as their block-column of the step-k
+update lands): at step k the trailing update is SPLIT so the next
+panel's block-column is updated first with a narrow apply, then the
+remainder of the trailing matrix gets the wide MXU-bound update —
+shortening the serialized dependence chain from
+``panel_k -> full_update_k -> panel_{k+1}`` to
+``panel_k -> column_update -> panel_{k+1}`` and leaving the wide
+remainder update dataflow-independent of the next panel so the
+compiler/runtime can overlap it with the latency-bound panel chain.
+``agg_depth`` additionally *aggregates* far updates: the remainder is
+left untouched for d consecutive panels and then updated once by the
+caller's ``agg_apply`` (for QR: one compact-WY rank-``d*nb`` apply,
+:func:`dplasma_tpu.kernels.householder.wy_stack`), which both
+saturates the MXU with a fatter product and streams the far trailing
+matrix through HBM once instead of d times.
+
+``lookahead=0, agg_depth=1`` reproduces the serialized sweep's exact
+op order (bit-identical trace); MCA ``sweep.lookahead`` /
+``qr.agg_depth`` (CLI ``--lookahead``) select the pipeline shape.
+"""
 from __future__ import annotations
 
 import jax.numpy as jnp
+
+
+def sweep_params(lookahead=None, agg_depth=None):
+    """Resolve the pipeline shape: explicit args win, else MCA
+    ``sweep.lookahead`` / ``qr.agg_depth``. Returns (lookahead >= 0,
+    agg_depth >= 1)."""
+    from dplasma_tpu.utils import config as _cfg
+    la = _cfg.mca_get_int("sweep.lookahead", 1) \
+        if lookahead is None else int(lookahead)
+    d = _cfg.mca_get_int("qr.agg_depth", 1) \
+        if agg_depth is None else int(agg_depth)
+    return max(la, 0), max(d, 1)
+
+
+def pipelined_sweep(rest, bw: int, KT: int, NT: int, panel, apply_block,
+                    *, lookahead: int = 1, agg_depth: int = 1,
+                    agg_apply=None):
+    """Drive a right-looking shrinking-window sweep with lookahead
+    column peeling and (optionally) aggregated far updates.
+
+    ``panel(col) -> (pack, state)`` factors one ``bw``-wide column
+    block (full current window height); ``apply_block(state, blk) ->
+    (top, rest)`` applies one panel's transform to a column block,
+    returning the finished top ``bw`` rows and the updated remainder
+    (window shrinks by ``bw`` rows); ``agg_apply(states, far) ->
+    (tops, far')`` applies ``len(states)`` consecutive panels to the
+    far block in ONE flush, returning the finished ``bw``-row slab per
+    state and the remainder — either a genuinely aggregated product
+    (QR's rank-d·nb compact-WY) or the per-step sequence fused into
+    one executable (the eager LU route's dispatch fusion). Without
+    ``agg_apply``, ``agg_depth`` is forced to 1 (per-step far
+    updates).
+
+    Bookkeeping invariants: columns in the lookahead window are
+    current through every factored panel (narrow per-step applies);
+    the far block is current through the last flush; a column peeled
+    from far mid-window is caught up by replaying the pending states.
+    Returns ``(packs, urows)`` in :func:`assemble_sweep` layout.
+    """
+    la = max(int(lookahead), 0)
+    d = max(int(agg_depth), 1) if agg_apply is not None else 1
+    packs = []
+    pieces: list[dict] = [dict() for _ in range(KT)]
+    pending: list[tuple] = []          # [(step, state)] not yet on far
+    ahead: list[list] = []             # [[col index, block], ...]
+    far = rest
+    far_col = 0                        # first column-block index in far
+
+    def peel():
+        nonlocal far, far_col
+        w = min(bw, far.shape[1])
+        blk = far[:, :w]
+        far = far[:, w:]
+        idx = far_col
+        far_col += 1
+        for s, st in pending:          # catch up to the window
+            top, blk = apply_block(st, blk)
+            pieces[s][idx] = top
+        return [idx, blk]
+
+    for _ in range(min(1 + la, NT)):   # window: panel + la columns
+        ahead.append(peel())
+
+    for kk in range(KT):
+        _, c = ahead.pop(0)
+        pack, st = panel(c)
+        packs.append(pack)
+        pending.append((kk, st))
+        for slot in ahead:             # narrow lookahead-column updates
+            top, slot[1] = apply_block(st, slot[1])
+            pieces[kk][slot[0]] = top
+        if len(pending) >= d or kk == KT - 1:   # far flush
+            if far.shape[1]:
+                if agg_apply is not None and len(pending) > 1:
+                    tops, far = agg_apply([s for _, s in pending], far)
+                    for (s, _), top in zip(pending, tops):
+                        pieces[s][far_col] = top
+                else:
+                    for s, st in pending:
+                        top, far = apply_block(st, far)
+                        pieces[s][far_col] = top
+            pending.clear()
+        while len(ahead) < 1 + la and far.shape[1] > 0:
+            ahead.append(peel())       # refill the window
+
+    urows = []
+    for kk in range(KT):
+        ps = [pieces[kk][i] for i in sorted(pieces[kk])]
+        urows.append(ps[0] if len(ps) == 1 else
+                     jnp.concatenate(ps, axis=1) if ps else
+                     packs[kk][:bw, :0])
+    return packs, urows
 
 
 def assemble_sweep(packs, urows, KT: int, NT: int, nb: int,
@@ -32,3 +149,174 @@ def assemble_sweep(packs, urows, KT: int, NT: int, nb: int,
         outcols.append(pieces[0] if len(pieces) == 1
                        else jnp.concatenate(pieces, axis=0))
     return jnp.concatenate(outcols, axis=1)
+
+
+# ---------------------------------------------------------------------
+# Analytic DAG of the pipelined engine (split-column task structure)
+# ---------------------------------------------------------------------
+
+def dag_pipelined(A, kind: str, recorder=None, lookahead=None,
+                  agg_depth=None, uplo: str = "L"):
+    """Record the pipelined sweep's realized task structure — task
+    classes ``panel(k)`` (factor column k), ``upd_col(k, j)`` (narrow
+    lookahead update of column j by panel k), ``upd_far(k0[, d])``
+    (wide remainder update; with aggregation one task applies ``d``
+    consecutive panels) — with column-block tile declarations so
+    :mod:`dplasma_tpu.analysis.dagcheck` proves the reordered DAG
+    race-free, flow-covered and owner-consistent.
+
+    ``kind``: ``getrf``/``geqrf`` (right-looking engine; ``geqrf``
+    honors ``agg_depth``) or ``potrf`` (the left-looking column sweep
+    with its lookahead window of fresh panels kept off the aggregated
+    wide update). Mirrors :func:`pipelined_sweep`'s control flow
+    exactly; the pipeline shape is stamped on ``recorder.meta`` for
+    the run-report / DAG analytics."""
+    from dplasma_tpu import native
+    from dplasma_tpu.utils import profiling
+    rec = recorder if recorder is not None else profiling.recorder
+    la, agg = sweep_params(lookahead, agg_depth)
+    if kind != "geqrf":
+        agg = 1
+    MT, NT = A.desc.MT, A.desc.NT
+    KT = min(MT, NT)
+    lower = uplo.upper() == "L"
+    ranks = native.rank_grid(A.desc.dist, MT, NT)
+    if getattr(rec, "meta", None) is not None:
+        rec.meta["pipeline"] = {"kind": kind, "lookahead": la,
+                                "agg_depth": agg}
+
+    def tile_t(i, j):
+        return (i, j) if lower else (j, i)
+
+    def col_tiles(c, r0):
+        return [tile_t(i, c) for i in range(r0, MT)]
+
+    def rank_at(i, j):
+        return int(ranks[tile_t(i, j)])
+
+    def panel_t(k):
+        return rec.task("panel", k, priority=3 * (KT - k),
+                        rank=rank_at(k, k),
+                        reads=col_tiles(k, k), writes=col_tiles(k, k))
+
+    def upd_col_t(s, c):
+        return rec.task("upd_col", s, c, priority=2 * (KT - s),
+                        rank=rank_at(s, c),
+                        reads=col_tiles(s, s) + col_tiles(c, s),
+                        writes=col_tiles(c, s))
+
+    last: dict = {}          # column block -> last writing task id
+    panel_ids: dict = {}
+
+    def link_col(c, t):
+        if last.get(c) is not None:
+            rec.edge(last[c], t, "C")
+        last[c] = t
+
+    if kind == "potrf":
+        # left-looking: column kk accumulates panels 0..kk-1; the la
+        # freshest stay individual narrow updates (the lookahead
+        # window), older ones fold into one aggregated wide product
+        for kk in range(KT):
+            fresh_from = max(kk - la, 0) if la > 0 else 0
+            if fresh_from > 0:
+                reads = [t for j in range(fresh_from)
+                         for t in col_tiles(j, kk)] + col_tiles(kk, kk)
+                t = rec.task("upd_agg", kk, priority=KT - kk,
+                             rank=rank_at(kk, kk), reads=reads,
+                             writes=col_tiles(kk, kk))
+                for j in range(fresh_from):
+                    rec.edge(panel_ids[j], t, "panel")
+                link_col(kk, t)
+            for j in range(fresh_from, kk):
+                t = rec.task("upd_col", j, kk,
+                             priority=2 * (KT - j),
+                             rank=rank_at(kk, kk),
+                             reads=col_tiles(j, kk) + col_tiles(kk, kk),
+                             writes=col_tiles(kk, kk))
+                rec.edge(panel_ids[j], t, "panel")
+                link_col(kk, t)
+            pt = rec.task("panel", kk, priority=3 * (KT - kk),
+                          rank=rank_at(kk, kk),
+                          reads=col_tiles(kk, kk),
+                          writes=col_tiles(kk, kk))
+            if last.get(kk) is not None:
+                rec.edge(last[kk], pt, "Akk")
+            panel_ids[kk] = pt
+            last[kk] = pt
+        return rec
+
+    # right-looking engine simulation (mirrors pipelined_sweep)
+    pending: list = []
+    ahead: list = []
+    farq = list(range(NT))
+
+    def peel():
+        c = farq.pop(0)
+        for s in pending:
+            t = upd_col_t(s, c)
+            rec.edge(panel_ids[s], t, "panel")
+            link_col(c, t)
+        return c
+
+    for _ in range(min(1 + la, NT)):
+        ahead.append(peel())
+
+    for kk in range(KT):
+        c = ahead.pop(0)
+        pt = panel_t(kk)
+        if last.get(c) is not None:
+            # the column-update -> panel edge that makes the pipeline
+            # correct (dropping it is the canonical mutation test)
+            rec.edge(last[c], pt, "Akk")
+        panel_ids[kk] = pt
+        last[c] = pt
+        pending.append(kk)
+        for c2 in ahead:
+            t = upd_col_t(kk, c2)
+            rec.edge(pt, t, "panel")
+            link_col(c2, t)
+        if len(pending) >= agg or kk == KT - 1:
+            if farq:
+                c0 = farq[0]
+                if agg > 1 and len(pending) > 1:
+                    s0 = pending[0]
+                    reads = [t for s in pending
+                             for t in col_tiles(s, s)]
+                    reads += [t for c2 in farq
+                              for t in col_tiles(c2, s0)]
+                    ft = rec.task("upd_far", s0, len(pending),
+                                  priority=KT - s0,
+                                  rank=rank_at(s0, c0),
+                                  reads=reads,
+                                  writes=[t for c2 in farq
+                                          for t in col_tiles(c2, s0)])
+                    for s in pending:
+                        rec.edge(panel_ids[s], ft, "panel")
+                    prevs = {last[c2] for c2 in farq
+                             if last.get(c2) is not None}
+                    for p in prevs:
+                        rec.edge(p, ft, "C")
+                    for c2 in farq:
+                        last[c2] = ft
+                else:
+                    for s in pending:
+                        ft = rec.task(
+                            "upd_far", s, 1, priority=KT - s,
+                            rank=rank_at(s, c0),
+                            reads=col_tiles(s, s) + [
+                                t for c2 in farq
+                                for t in col_tiles(c2, s)],
+                            writes=[t for c2 in farq
+                                    for t in col_tiles(c2, s)])
+                        rec.edge(panel_ids[s], ft, "panel")
+                        prevs = {last[c2] for c2 in farq
+                                 if last.get(c2) is not None}
+                        for p in prevs:
+                            rec.edge(p, ft, "C")
+                        for c2 in farq:
+                            last[c2] = ft
+            pending.clear()
+        while len(ahead) < 1 + la and farq:
+            ahead.append(peel())
+    return rec

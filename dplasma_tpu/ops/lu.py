@@ -91,38 +91,51 @@ def laswp(A: TileMatrix, perm, inverse: bool = False) -> TileMatrix:
 
 # -- no-pivoting LU ----------------------------------------------------
 
-def getrf_nopiv(A: TileMatrix) -> TileMatrix:
+def _lu_apply_block(pan, blk, bw: int, perm=None):
+    """Apply one factored LU panel to a column block: optional pivot
+    gather, U solve of the top bw rows, rank-bw Schur update below.
+    The shared narrow/wide update of the pipelined sweep."""
+    if perm is not None:
+        blk = blk[perm]
+    u = k.trsm(pan[:bw], blk[:bw], side="L", lower=True, unit=True)
+    below = blk[bw:]
+    if below.shape[0]:
+        below = below - k.dot(pan[bw:], u)
+    return u, below
+
+
+def getrf_nopiv(A: TileMatrix, lookahead=None) -> TileMatrix:
     """Blocked right-looking LU without pivoting
     (dplasma_zgetrf_nopiv). Returns packed L\\U (unit L implicit).
 
-    Shrinking-window sweep: the trailing submatrix is a fresh value
-    each step (no dynamic-update-slice rematerialization of the full
-    matrix) and each Schur update is one full-width MXU matmul."""
+    Lookahead-pipelined shrinking-window sweep
+    (:func:`dplasma_tpu.ops._sweep.pipelined_sweep`): the next panel's
+    block-column is updated first by a narrow solve+rank-nb product,
+    so the serialized chain is panel -> column-update -> panel while
+    the full-width MXU Schur update of the remainder stays dataflow-
+    independent of the next panel. ``lookahead=0`` (or MCA
+    ``sweep.lookahead 0``) is the serialized baseline, bit-identical
+    op order."""
+    from dplasma_tpu.ops import _sweep
     assert A.desc.mb == A.desc.nb, "getrf needs square tiles"
+    la, _ = _sweep.sweep_params(lookahead)
     nb = A.desc.nb
     KT = A.desc.KT
     NT = A.desc.NT
     rest = A.pad_diag().data
-    packs, urows = [], []
-    for kk in range(KT):
-        col = rest[:, :nb]
+
+    def panel(col):
         d = k.getrf_nopiv(col[:nb])
         if col.shape[0] > nb:
             pan = jnp.concatenate(
                 [d, k.trsm(d, col[nb:], side="R", lower=False)], axis=0)
         else:
             pan = d
-        packs.append(pan)
-        trail = rest[:, nb:]
-        if trail.shape[1]:
-            u12 = k.trsm(d, trail[:nb], side="L", lower=True, unit=True)
-            urows.append(u12)
-            trail = trail[nb:]
-            if trail.shape[0]:
-                trail = trail - k.dot(pan[nb:], u12)
-        else:
-            urows.append(trail[:nb])
-        rest = trail
+        return pan, pan
+
+    packs, urows = _sweep.pipelined_sweep(
+        rest, nb, KT, NT, panel,
+        lambda pan, blk: _lu_apply_block(pan, blk, nb), lookahead=la)
     full = assemble_sweep(packs, urows, KT, NT, nb)
     return TileMatrix(pmesh.constrain2d(full), A.desc)
 
@@ -223,40 +236,63 @@ def _lu_finish(packs, urows, step_ids, ids, Mp, KT, NT, bw):
     return full, final_ids
 
 
-def _lu_sweep(X, bw: int, panel_fn):
+def _lu_sweep(X, bw: int, panel_fn, lookahead=None,
+              jit_steps: bool = False):
     """Generic pivoted shrinking-window LU sweep at block width ``bw``:
     right-looking, with *deferred* pivot bookkeeping — each block's
     permutation is applied to the shrinking trailing window only (one
     gather), never to already-factored left columns; the packed factor
     is stitched at the end from traced row ids. Returns
     (packed L\\U, perm) with ``X[perm] = L U``. Used at two levels:
-    the nb-wide matrix sweep and the ib-wide in-panel sweep."""
+    the nb-wide matrix sweep and the ib-wide in-panel sweep.
+
+    Lookahead-pipelined via :func:`~dplasma_tpu.ops._sweep.
+    pipelined_sweep`: the next panel's column is permuted+updated
+    first (narrow), the wide Schur remainder stays off the panel
+    chain. ``jit_steps=True`` routes the panel and block updates
+    through per-shape jitted executables (the eager dd route —
+    the traced monolith OOM-kills the tunnel compile helper at
+    N=8192; r5 note); there the far flushes of MCA ``lu.agg_depth``
+    consecutive panels fuse into one executable (identical op order —
+    pure dispatch fusion, unlike QR's reassociating compact-WY
+    aggregation, so the recorded DAG keeps per-step far tasks)."""
+    from dplasma_tpu.ops import _sweep
+    from dplasma_tpu.utils import config as _cfg
+    # the jitted route dispatches through module-level executables
+    # that hardcode _panel_lu (a lambda panel_fn would retrace per
+    # call); refuse a mismatched panel_fn rather than silently
+    # factoring with the wrong kernel
+    assert not jit_steps or panel_fn is _panel_lu, \
+        "jit_steps supports only the _panel_lu panel kernel"
+    la, _ = _sweep.sweep_params(lookahead)
+    agg = max(_cfg.mca_get_int("lu.agg_depth", 1), 1) if jit_steps \
+        else 1
     Mp, Np = X.shape
     KT = min(Mp, Np) // bw
     NT = -(-Np // bw)
-    rest = X
-    ids = jnp.arange(Mp)
-    packs, urows, step_ids = [], [], []
-    for kk in range(KT):
-        pan, perm = panel_fn(rest[:, :bw])
-        idsp = ids[perm]
-        step_ids.append(idsp)
-        packs.append(pan)
-        trail = rest[:, bw:]
-        if trail.shape[1]:
-            trail = trail[perm]
-            u12 = k.trsm(pan[:bw], trail[:bw], side="L", lower=True,
-                         unit=True)
-            urows.append(u12)
-            trail = trail[bw:]
-            if trail.shape[0]:
-                trail = trail - k.dot(pan[bw:], u12)
-        else:
-            urows.append(trail[:bw])
-        rest = trail
-        ids = idsp[bw:]
+    ids_cell = [jnp.arange(Mp)]
+    step_ids = []
 
-    return _lu_finish(packs, urows, step_ids, ids, Mp, KT, NT, bw)
+    def panel(col):
+        pan, perm = _jit_lu_panel(col) if jit_steps else panel_fn(col)
+        idsp = ids_cell[0][perm]
+        step_ids.append(idsp)
+        ids_cell[0] = idsp[bw:]
+        return pan, (pan, perm)
+
+    def apply_block(st, blk):
+        if jit_steps:
+            return _jit_lu_apply(st[0], st[1], blk)
+        return _lu_apply_block(st[0], blk, bw, perm=st[1])
+
+    def agg_apply(sts, far):
+        return _jit_lu_flush(far, *[x for st in sts for x in st])
+
+    packs, urows = _sweep.pipelined_sweep(
+        X, bw, KT, NT, panel, apply_block, lookahead=la,
+        agg_depth=agg, agg_apply=agg_apply if agg > 1 else None)
+    return _lu_finish(packs, urows, step_ids, ids_cell[0], Mp, KT, NT,
+                      bw)
 
 
 def _panel_lu_dd(panel, ib: int | None = None):
@@ -303,64 +339,50 @@ def _panel_lu(panel, ib: int | None = None):
         ib = _cfg.mca_get_int("lu.panel_ib", _LU_IB)
     if ib <= 0 or nb <= ib or nb % ib or m % ib:
         return _base_lu(panel)
-    return _lu_sweep(panel, ib, _base_lu)
+    # the in-panel sweep stays serialized (lookahead=0): inside the
+    # latency-bound panel a column split only adds narrow ops — the
+    # matrix-level sweep owns the pipeline
+    return _lu_sweep(panel, ib, _base_lu, lookahead=0)
 
 
-# -- shape-cached dd LU sweep (eager) ----------------------------------
-# Eager callers ride ONE fused executable per step k (panel + pivot
-# bookkeeping + trailing update), compiled per shrinking-window shape
-# and persistent-cached. r5 profiling of the r4 three-executables-per-
-# step form at N=8192: ~0.34 s of the 0.95 s run was per-exec dispatch
-# and ~half the panel time was the FIXED full-height seed LU — fusing
-# and factoring at the true height removes both. Zero-padded panel
-# rows remain PIVOT-SAFE: partial pivoting never selects a zero row
-# over a nonzero one, and an unselected zero row stays zero and in
-# place — so perm[:m] permutes only real rows.
+# -- shape-cached dd LU sweep callbacks (eager) ------------------------
+# Eager callers drive the pipelined sweep engine over per-callback
+# executables, compiled per shrinking-window shape and persistent-
+# cached (the traced monolith OOM-kills the tunnel compile helper at
+# N=8192). Panels factor at the TRUE window height (r5: ~half the
+# panel time of the fixed-height form factored zero pad rows).
+# Zero-padded panel rows remain PIVOT-SAFE: partial pivoting never
+# selects a zero row over a nonzero one, and an unselected zero row
+# stays zero and in place — so perm[:m] permutes only real rows.
 
 import functools as _functools
 
 import jax as _jax
 
 
-@_functools.partial(_jax.jit, static_argnums=(2,))
-def _jit_dd_lu_step(rest, ids, bw: int):
-    """One full LU step at the window's true shape: factor the bw-wide
-    panel, permute the trailing window, solve U12, Schur-update."""
-    m, n = rest.shape
-    assert n >= bw, (n, bw)   # KT = min//bw keeps every window >= bw
-    pan, perm = _panel_lu(rest[:, :bw])
-    idsp = ids[perm]
-    trail = rest[:, bw:]
-    if n > bw:
-        trail = trail[perm]
-        u12 = k.trsm(pan[:bw], trail[:bw], side="L", lower=True,
-                     unit=True)
-        rest_next = trail[bw:]
-        if m > bw:
-            rest_next = rest_next - k.dot(pan[bw:], u12)
-    else:
-        u12 = trail[:bw]
-        rest_next = trail[bw:]
-    return pan, idsp, u12, rest_next
+@_jax.jit
+def _jit_lu_panel(col):
+    return _panel_lu(col)
 
 
-def _lu_sweep_dd_eager(X, bw: int):
-    """Eager twin of :func:`_lu_sweep` over per-step fused executables
-    (same deferred-pivot bookkeeping and assembly)."""
-    Mp, Np = X.shape
-    KT = min(Mp, Np) // bw
-    NT = -(-Np // bw)
-    rest = X
-    ids = jnp.arange(Mp)
-    packs, urows, step_ids = [], [], []
-    for kk in range(KT):
-        pan, idsp, u12, rest = _jit_dd_lu_step(rest, ids, bw)
-        packs.append(pan)
-        urows.append(u12)
-        step_ids.append(idsp)
-        ids = idsp[bw:]
+@_jax.jit
+def _jit_lu_apply(pan, perm, blk):
+    return _lu_apply_block(pan, blk, pan.shape[1], perm=perm)
 
-    return _lu_finish(packs, urows, step_ids, ids, Mp, KT, NT, bw)
+
+@_jax.jit
+def _jit_lu_flush(far, *pan_perm):
+    """Fused far flush: the wide updates of several consecutive panels
+    in ONE executable — IDENTICAL op order to the per-step applies
+    (dispatch fusion, not reassociation; ~5 ms/exec on the tunnel, r5).
+    ``pan_perm`` is pan0, perm0, pan1, perm1, ..."""
+    tops = []
+    for i in range(0, len(pan_perm), 2):
+        pan = pan_perm[i]
+        top, far = _lu_apply_block(pan, far, pan.shape[1],
+                                   perm=pan_perm[i + 1])
+        tops.append(top)
+    return tops, far
 
 
 def getrf_1d(A: TileMatrix):
@@ -384,7 +406,8 @@ def getrf_1d(A: TileMatrix):
     # 4096, measured r4)
     if (use_dd and utils.is_concrete(X)
             and min(X.shape) // A.desc.nb > 8):
-        full, final_ids = _lu_sweep_dd_eager(X, A.desc.nb)
+        full, final_ids = _lu_sweep(X, A.desc.nb, _panel_lu,
+                                    jit_steps=True)
     else:
         full, final_ids = _lu_sweep(X, A.desc.nb, _panel_lu)
     return TileMatrix(pmesh.constrain2d(full), A.desc), final_ids
@@ -782,7 +805,7 @@ def getrf_lowmem(A, nb: int = 512, budget_bytes: int | None = None):
     return Ah, jnp.asarray(perm)
 
 
-def dag(A: TileMatrix, recorder=None):
+def dag(A: TileMatrix, recorder=None, *, lookahead=None):
     """Record the tile-level right-looking LU DAG (task classes
     getrf/trsm_l/trsm_u/gemm with block-cyclic owner ranks) into
     ``recorder`` for ``--dot`` dumps and DAG analytics.
@@ -791,10 +814,17 @@ def dag(A: TileMatrix, recorder=None):
     (data-independent), so it is emitted analytically. Priorities reuse
     the cubic critical-path family (getrf on the potrf formula, panel
     solves on trsm, updates on gemm — the zgetrf JDF uses the same
-    shape).
+    shape). With an active pipeline (MCA ``sweep.lookahead`` > 0 or
+    the explicit kwarg) the recorded DAG is instead the engine's
+    split-column structure (:func:`dplasma_tpu.ops._sweep.
+    dag_pipelined`) — what the compiled sweep actually emits.
     """
     from dplasma_tpu import native
+    from dplasma_tpu.ops import _sweep
     from dplasma_tpu.utils import profiling
+    la, _ = _sweep.sweep_params(lookahead)
+    if la > 0:
+        return _sweep.dag_pipelined(A, "getrf", recorder, la)
     rec = recorder if recorder is not None else profiling.recorder
     MT, NT = A.desc.MT, A.desc.NT
     KT = min(MT, NT)

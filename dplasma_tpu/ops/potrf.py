@@ -40,14 +40,26 @@ from dplasma_tpu.ops.aux import _tri_mask
 from dplasma_tpu.parallel import mesh as pmesh
 
 
-def potrf(A: TileMatrix, uplo: str = "L", *, diag_kernel=None) -> TileMatrix:
+def potrf(A: TileMatrix, uplo: str = "L", *, diag_kernel=None,
+          lookahead=None) -> TileMatrix:
     """Tile Cholesky: A = L L^H (uplo=L) or A = U^H U (uplo=U).
 
     Left-looking block-column algorithm (see module docstring); the
     opposite triangle of the result is zero. ``diag_kernel`` replaces
     the diagonal-tile factorizer (kernels.blas.potrf) — the RECURSIVE
     chore hook (no module-global monkeypatching, round-1 ADVICE).
-    """
+
+    Pipelined accumulation (MCA ``sweep.lookahead`` = ``la`` > 0, or
+    the explicit kwarg): column k's update keeps only the ``la``
+    freshest panels as individual narrow rank-mb products — the
+    serialized chain stays ``panel_{k-1} -> narrow update ->
+    panel_k`` — while every older panel's contribution folds into ONE
+    wide aggregated MXU product (concatenated panels), replacing k-1
+    skinny products that each re-streamed the column through HBM.
+    ``lookahead=0`` is the per-panel baseline (bit-identical op
+    order)."""
+    from dplasma_tpu.ops._sweep import sweep_params
+    la, _ = sweep_params(lookahead)
     dk = diag_kernel if diag_kernel is not None else k.potrf
     assert A.desc.mb == A.desc.nb, "potrf needs square tiles"
     assert A.desc.M == A.desc.N, "potrf needs a square matrix"
@@ -71,9 +83,20 @@ def potrf(A: TileMatrix, uplo: str = "L", *, diag_kernel=None) -> TileMatrix:
     cols = []
     for kk in range(nt):
         s = kk * mb
+        fresh_from = max(kk - la, 0) if la > 0 else 0
         if lower:
             col = X[s:, s:s + mb]
-            for j in range(kk):
+            if fresh_from > 0:
+                # aggregated wide product of the older panels (one
+                # column stream instead of fresh_from skinny ones)
+                W = jnp.concatenate(
+                    [cols[j][s - j * mb:] for j in range(fresh_from)],
+                    axis=1)
+                B = jnp.concatenate(
+                    [cols[j][s - j * mb:s - j * mb + mb]
+                     for j in range(fresh_from)], axis=1)
+                col = col - k.dot(W, B, tb=True, conj_b=True)
+            for j in range(fresh_from, kk):
                 Lj = cols[j]
                 off = s - j * mb
                 col = col - k.dot(Lj[off:, :], Lj[off:off + mb, :],
@@ -87,7 +110,15 @@ def potrf(A: TileMatrix, uplo: str = "L", *, diag_kernel=None) -> TileMatrix:
                 cols.append(lkk)
         else:
             row = X[s:s + mb, s:]
-            for j in range(kk):
+            if fresh_from > 0:
+                W = jnp.concatenate(
+                    [cols[j][:, s - j * mb:] for j in range(fresh_from)],
+                    axis=0)
+                B = jnp.concatenate(
+                    [cols[j][:, s - j * mb:s - j * mb + mb]
+                     for j in range(fresh_from)], axis=0)
+                row = row - k.dot(B, W, ta=True, conj_a=True)
+            for j in range(fresh_from, kk):
                 Uj = cols[j]
                 off = s - j * mb
                 row = row - k.dot(Uj[:, off:off + mb], Uj[:, off:],
@@ -133,10 +164,16 @@ def potrf_rec(A: TileMatrix, uplo: str = "L",
     return potrf(A, uplo, diag_kernel=nested)
 
 
-def dag(A: TileMatrix, uplo: str = "L", recorder=None):
+def dag(A: TileMatrix, uplo: str = "L", recorder=None, *,
+        lookahead=None):
     """Record the tile-level POTRF DAG (task classes potrf/trsm/herk/gemm
     with the cubic priorities of src/zpotrf_L.jdf:58-69,116,219 and
     block-cyclic owner ranks) into ``recorder`` for ``--dot`` dumps.
+
+    With an active pipeline (MCA ``sweep.lookahead`` > 0 or the
+    explicit kwarg) the recorded DAG is the left-looking column
+    sweep's lookahead structure instead
+    (:func:`dplasma_tpu.ops._sweep.dag_pipelined`).
 
     The DAG is data-independent (pure index algebra), so it is emitted
     analytically rather than by instrumenting the compute path — the
@@ -146,7 +183,12 @@ def dag(A: TileMatrix, uplo: str = "L", recorder=None):
     itself is identical by symmetry.
     """
     from dplasma_tpu import native
+    from dplasma_tpu.ops import _sweep
     from dplasma_tpu.utils import profiling
+    la, _ = _sweep.sweep_params(lookahead)
+    if la > 0:
+        return _sweep.dag_pipelined(A, "potrf", recorder, la,
+                                    uplo=uplo)
     rec = recorder if recorder is not None else profiling.recorder
     nt = A.desc.KT
     lower = uplo.upper() == "L"

@@ -35,37 +35,34 @@ from dplasma_tpu.ops._sweep import assemble_sweep
 from dplasma_tpu.parallel import mesh as pmesh
 
 
-# -- shape-cached dd QR sweep (eager) ----------------------------------
+# -- shape-cached dd QR sweep callbacks (eager) ------------------------
 # The monolithic traced dd sweep OOM-kills the tunnel compile helper
 # above N=2048 (each panel inlines the full geqrt_f64 limb graph —
-# ~30-40 exact-product subgraphs). Eager callers instead ride ONE
-# fused executable per step (panel at the TRUE shrinking height +
-# compact-WY trailing apply), compiled per window shape and
-# persistent-cached — r5 profiling of the r4 fixed-height three-exec
-# form showed ~1/3 of the run in per-exec dispatch and ~half the
-# panel time factoring zero pad rows.
+# ~30-40 exact-product subgraphs). Eager callers instead drive the
+# pipelined sweep engine over per-callback executables, compiled per
+# shrinking-window shape and persistent-cached; the aggregated far
+# apply keeps the executable count near the r5 fused form (one panel
+# + one narrow column apply per step, one wide apply per agg_depth
+# steps) while streaming the far trailing matrix once per flush.
 
-@partial(jax.jit, static_argnums=(1,))
-def _jit_dd_qr_step(rest, nb: int):
+@jax.jit
+def _jit_dd_qr_panel(col):
     from dplasma_tpu.kernels import dd as _dd
-    n = rest.shape[1]
-    packed, v, T = _dd.geqrt_f64(rest[:, :nb])
-    trail = rest[:, nb:]
-    if n > nb:
-        trail = hh.apply_q(v, T, trail, trans="C")
-    return packed, T, trail[:nb], trail[nb:]
+    return _dd.geqrt_f64(col)
 
 
-def _dd_sweep_eager(rest, nb: int, KT: int, NT: int):
-    """Eager dd QR sweep over per-step fused executables; same math as
-    the traced loop below."""
-    panels, packs, rrows = [], [], []
-    for _ in range(KT):
-        packed, T, rrow, rest = _jit_dd_qr_step(rest, nb)
-        panels.append((None, T))
-        packs.append(packed)
-        rrows.append(rrow)
-    return panels, packs, rrows
+@jax.jit
+def _jit_qr_apply(v, T, blk):
+    out = hh.apply_q(v, T, blk, trans="C")
+    nb = v.shape[1]
+    return out[:nb], out[nb:]
+
+
+@jax.jit
+def _jit_qr_agg_apply(far, *vts):
+    panels = [(vts[i], vts[i + 1]) for i in range(0, len(vts), 2)]
+    V, T = hh.wy_stack(panels)
+    return hh.apply_q(V, T, far, trans="C")
 
 
 def _check_square_tiles(A: TileMatrix, who: str):
@@ -109,13 +106,7 @@ def geqrt_rec(a, hnb: int):
         if V is None:
             V, T = vfull, tj
         else:
-            t12 = k.dot(-k.dot(T, V, tb=True, conj_b=True), vfull)
-            t12 = k.dot(t12, tj)
-            T = jnp.concatenate([
-                jnp.concatenate([T, t12], axis=1),
-                jnp.concatenate([jnp.zeros((wj, T.shape[0]), a.dtype),
-                                 tj], axis=1)], axis=0)
-            V = jnp.concatenate([V, vfull], axis=1)
+            V, T = hh.wy_merge(V, T, vfull, tj)
         rest = trail[wj:]
     # stitch the packed panel: column block i carries the R12 slices of
     # every earlier sub-step above its own (R diag + V below) pack
@@ -130,19 +121,30 @@ def geqrt_rec(a, hnb: int):
     return packed, V, T
 
 
-def geqrf(A: TileMatrix, *, panel_kernel=None) -> tuple[TileMatrix,
-                                                        TileMatrix]:
+def geqrf(A: TileMatrix, *, panel_kernel=None, lookahead=None,
+          agg_depth=None) -> tuple[TileMatrix, TileMatrix]:
     """A = Q R (dplasma_zgeqrf). Returns (packed factor, T factors).
 
-    Right-looking sweep on a *shrinking* trailing window: panel k's
-    reflector block hits the whole remaining submatrix as three wide
-    MXU matmuls (compact-WY), then the finished top row-slab and left
-    panel split off and the window shrinks. The window is a fresh value
-    each step — no dynamic-update-slice re-materialization of the full
-    matrix (the pathology that forced ops.potrf left-looking), and the
-    per-step matmuls keep their full (M-k·nb) x (N-k·nb) width instead
-    of the one-column applies of a left-looking sweep."""
+    Lookahead-pipelined right-looking sweep on a *shrinking* trailing
+    window (:func:`dplasma_tpu.ops._sweep.pipelined_sweep`): panel k's
+    reflector block first hits the next panel's block-column with a
+    narrow compact-WY apply — so the latency-bound panel chain
+    ``panel_k -> column_update -> panel_{k+1}`` never waits for the
+    wide trailing update — and the remainder gets the MXU-bound wide
+    apply off that chain. MCA ``qr.agg_depth`` > 1 additionally holds
+    the far update back for d panels and applies them as ONE rank-d·nb
+    compact-WY product (:func:`~dplasma_tpu.kernels.householder.
+    wy_stack`), streaming the far trailing matrix once instead of d
+    times. ``lookahead=0, agg_depth=1`` is the serialized baseline
+    (bit-identical op order); defaults come from MCA
+    ``sweep.lookahead`` / ``qr.agg_depth`` (CLI ``--lookahead``).
+
+    The window is a fresh value each step — no dynamic-update-slice
+    re-materialization of the full matrix (the pathology that forced
+    ops.potrf left-looking)."""
+    from dplasma_tpu.ops import _sweep
     _check_square_tiles(A, "geqrf")
+    la, agg = _sweep.sweep_params(lookahead, agg_depth)
     nb = A.desc.nb
     KT = A.desc.KT
     NT = A.desc.NT
@@ -156,9 +158,11 @@ def geqrf(A: TileMatrix, *, panel_kernel=None) -> tuple[TileMatrix,
         # rank — the CholeskyQR2 panel breaks down on zero columns.
         idx = jnp.arange(A.desc.N, rest.shape[1])
         rest = rest.at[idx, idx].set(jnp.ones((), rest.dtype))
-    panels = []   # (v, T) per finished panel
-    packs = []    # packed panel columns (R diag + V below)
-    rrows = []    # finished nb-row R slabs right of each panel
+    Ts = []       # T triangle per finished panel (V blocks are NOT
+    #               retained: only the engine's in-flight states hold
+    #               them — the eager dd route exists because of memory
+    #               pressure, so nothing keeps KT limb-carrying V
+    #               blocks alive until assembly)
 
     # d-precision route: CholQR2+reconstruction panels with every heavy
     # product an exact limb GEMM (kernels.dd.geqrt_f64). Envelope: the
@@ -173,30 +177,46 @@ def geqrf(A: TileMatrix, *, panel_kernel=None) -> tuple[TileMatrix,
     if use_dd:
         from dplasma_tpu.kernels import dd as _dd
 
-    if (use_dd and panel_kernel is None and KT > 1
-            and utils.is_concrete(rest)):
-        # eager callers: per-step fused executables, persistent-cached
-        # — the monolithic trace OOM-kills the compile helper > 2048
-        panels, packs, rrows = _dd_sweep_eager(rest, nb, KT, NT)
-    else:
-        for kk in range(KT):
-            if panel_kernel is not None:
-                packed, v, T = panel_kernel(rest[:, :nb])
-            elif use_dd:
-                packed, v, T = _dd.geqrt_f64(rest[:, :nb])
-            else:
-                packed, v, T = hh.geqrt(rest[:, :nb], rankfull=True)
-            panels.append((v, T))
-            packs.append(packed)
-            trail = rest[:, nb:]
-            if trail.shape[1]:
-                trail = hh.apply_q(v, T, trail, trans="C")
-            rrows.append(trail[:nb])
-            rest = trail[nb:]
+    eager = (use_dd and panel_kernel is None and KT > 1
+             and utils.is_concrete(rest))
+    # eager dd callers ride per-callback executables, persistent-
+    # cached per window shape — the monolithic trace OOM-kills the
+    # compile helper > 2048
+
+    def panel(col):
+        if eager:
+            packed, v, T = _jit_dd_qr_panel(col)
+        elif panel_kernel is not None:
+            packed, v, T = panel_kernel(col)
+        elif use_dd:
+            packed, v, T = _dd.geqrt_f64(col)
+        else:
+            packed, v, T = hh.geqrt(col, rankfull=True)
+        Ts.append(T)
+        return packed, (v, T)
+
+    def apply_block(st, blk):
+        if eager:
+            return _jit_qr_apply(st[0], st[1], blk)
+        out = hh.apply_q(st[0], st[1], blk, trans="C")
+        return out[:nb], out[nb:]
+
+    def agg_apply(sts, far):
+        if eager:
+            new = _jit_qr_agg_apply(far, *[x for vt in sts for x in vt])
+        else:
+            new = hh.apply_q(*hh.wy_stack(sts), far, trans="C")
+        d = len(sts)
+        return ([new[i * nb:(i + 1) * nb] for i in range(d)],
+                new[d * nb:])
+
+    packs, rrows = _sweep.pipelined_sweep(
+        rest, nb, KT, NT, panel, apply_block, lookahead=la,
+        agg_depth=agg, agg_apply=agg_apply if agg > 1 else None)
 
     full = assemble_sweep(packs, rrows, KT, NT, nb)
     Tm = t_desc(A)
-    Td = jnp.concatenate([T for _, T in panels], axis=1)
+    Td = jnp.concatenate(Ts, axis=1)
     if Td.shape[1] < Tm.desc.Np:
         Td = jnp.pad(Td, ((0, 0), (0, Tm.desc.Np - Td.shape[1])))
     return (TileMatrix(pmesh.constrain2d(full), A.desc),
@@ -214,13 +234,29 @@ def geqrf_rec(A: TileMatrix, hnb: int = 0):
 
 
 def _qr_panels(Af: TileMatrix, Tf: TileMatrix):
-    """Yield (row_start, V, T) per panel from a geqrf result."""
+    """Yield (row_start, V, T) per panel from a geqrf result.
+
+    The split is cached on ``Af`` per exact (Af.data, Tf.data) pair:
+    repeated applies against one factor object (the geqrs solve path,
+    the RBT replay, unmqr both-sides) re-use the V gathers instead of
+    re-emitting KT tril/diag-set ops per call. Identity-checked
+    against the live arrays, so a factor with replaced data never
+    serves a stale split; inside a jit the cache naturally scopes to
+    the trace that built the TileMatrix."""
+    cache = getattr(Af, "_qr_panels_cache", None)
+    if cache is not None and cache[0] is Af.data \
+            and cache[1] is Tf.data:
+        return cache[2]
     nb = Af.desc.nb
     out = []
     for kk in range(Af.desc.KT):
         s, e = kk * nb, (kk + 1) * nb
         v, _ = hh.split_qr(Af.data[s:, s:e])
         out.append((s, v, Tf.data[:, s:e]))
+    try:
+        Af._qr_panels_cache = (Af.data, Tf.data, out)
+    except (AttributeError, TypeError):
+        pass
     return out
 
 
@@ -398,10 +434,17 @@ def geqrf_lowmem(A, nb: int = 512, budget_bytes: int | None = None):
     return Ah, Ts
 
 
-def dag(A: TileMatrix, recorder=None):
+def dag(A: TileMatrix, recorder=None, *, lookahead=None,
+        agg_depth=None):
     """Record the tile-level blocked QR DAG (task classes geqrt/unmqr/
     tsqrt/tsmqr — the zgeqrf JDF's flat-tree dependence structure) into
     ``recorder`` for ``--dot`` dumps and DAG analytics.
+
+    With an active pipeline (MCA ``sweep.lookahead`` > 0 or
+    ``qr.agg_depth`` > 1, or the explicit kwargs) the recorded DAG is
+    the pipelined engine's split-column task structure instead
+    (:func:`dplasma_tpu.ops._sweep.dag_pipelined`) — what the compiled
+    sweep actually emits.
 
     Pure index algebra like :func:`dplasma_tpu.ops.potrf.dag`.
     Priorities grow with the panel index (later panels sit deeper on
@@ -415,7 +458,11 @@ def dag(A: TileMatrix, recorder=None):
     per-region flows).
     """
     from dplasma_tpu import native
+    from dplasma_tpu.ops import _sweep
     from dplasma_tpu.utils import profiling
+    la, agg = _sweep.sweep_params(lookahead, agg_depth)
+    if la > 0 or agg > 1:
+        return _sweep.dag_pipelined(A, "geqrf", recorder, la, agg)
     rec = recorder if recorder is not None else profiling.recorder
     MT, NT = A.desc.MT, A.desc.NT
     KT = min(MT, NT)

@@ -353,10 +353,17 @@ def _seed_pad_diag(A, desc: CyclicDesc, gid, gcid):
     return jnp.where(eq, jnp.ones((), A.dtype), A)
 
 
-@partial(jax.jit, static_argnums=(1, 2))
-def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh):
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh, lookahead: int = 0):
     # ``mesh`` (hashable) is part of the jit key: two same-shaped meshes
     # with different device orders must not share a trace.
+    # ``lookahead`` > 0 pipelines the sweep: step k broadcasts and
+    # narrowly updates the NEXT panel's block column before issuing
+    # the wide trailing matmul, so step k+1's panel chain (its psum
+    # collectives + potrf + trsm) is dataflow-independent of step k's
+    # MXU-bound update and the compiler/runtime can overlap them —
+    # the lookahead the reference gets from PaRSEC running panel
+    # tasks as soon as their block-column lands.
     d = desc.dist
     P, Q = d.P, d.Q
     mb = desc.mb
@@ -376,16 +383,21 @@ def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh):
         q = jax.lax.axis_index(pmesh.COL_AXIS)
         grow = _grow(desc.MTL, mb, p, P, d.kp, d.ip)      # (mloc,)
         gcol = _grow(desc.NTL, mb, q, Q, d.kq, d.jq)      # (nloc,)
+        pan_next = None
         for k in range(KT):
             pk = layout.owner(k, P, d.kp, d.ip)
             qk = layout.owner(k, Q, d.kq, d.jq)
             lrk = layout.local_index(k, P, d.kp)
             lck = layout.local_index(k, Q, d.kq)
-            # 1) broadcast block column k along 'q' (panel bcast)
+            # 1) broadcast block column k along 'q' (panel bcast) —
+            # or take the lookahead-carried pre-updated column
             cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
-            pan = jax.lax.psum(
-                jnp.where(q == qk, cs, jnp.zeros_like(cs)),
-                pmesh.COL_AXIS)
+            if pan_next is None:
+                pan = jax.lax.psum(
+                    jnp.where(q == qk, cs, jnp.zeros_like(cs)),
+                    pmesh.COL_AXIS)
+            else:
+                pan = pan_next
             # 2) broadcast diagonal tile along 'p'
             dt = jax.lax.dynamic_slice_in_dim(pan, lrk * mb, mb, axis=0)
             ddt = jax.lax.psum(
@@ -413,8 +425,27 @@ def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh):
             lj = (jt // (d.kp * P)) * d.kp + jt % d.kp
             idx = pj * mloc + lj * mb + jnp.arange(nloc) % mb
             W = jnp.where((jt > k)[:, None], allg[idx], 0)  # (nloc, mb)
-            # 6) local trailing update (one MXU matmul)
             Lbelow = jnp.where(below, Lpan, 0)
+            # 5b) lookahead: broadcast the STALE next panel column and
+            # apply step k's rank-mb update to it narrowly (allg is
+            # replicated along 'q', so the catch-up is local compute)
+            # — next step's panel chain never waits for the wide matmul
+            if lookahead > 0 and k + 1 < KT:
+                qk1 = layout.owner(k + 1, Q, d.kq, d.jq)
+                lck1 = layout.local_index(k + 1, Q, d.kq)
+                pk1 = layout.owner(k + 1, P, d.kp, d.ip)
+                lrk1 = layout.local_index(k + 1, P, d.kp)
+                cs1 = jax.lax.dynamic_slice_in_dim(A, lck1 * mb, mb,
+                                                   axis=1)
+                stale = jax.lax.psum(
+                    jnp.where(q == qk1, cs1, jnp.zeros_like(cs1)),
+                    pmesh.COL_AXIS)
+                Lk1 = allg[pk1 * mloc + lrk1 * mb:
+                           pk1 * mloc + (lrk1 + 1) * mb]
+                pan_next = stale - kb.dot(Lbelow, ct(Lk1))
+            else:
+                pan_next = None
+            # 6) local trailing update (one MXU matmul)
             A = A - kb.dot(Lbelow, ct(W))
         return A.reshape(1, 1, mloc, nloc)
 
@@ -431,8 +462,9 @@ def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh):
     return f(data)
 
 
-@partial(jax.jit, static_argnums=(1, 2))
-def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh):
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh,
+                      lookahead: int = 0):
     """Distributed tournament-pivoting LU over cyclic local slabs —
     the reference's hand-distributed parallel panel
     (src/zgetrf_ptgpanel.jdf: per-rank panel elimination + pivot
@@ -465,14 +497,19 @@ def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh):
         A = _seed_pad_diag(A, desc, gid, gcid)
         active = jnp.ones((mloc,), bool)
         wins = []
+        pan_next = None
         for k in range(KT):
             qk = layout.owner(k, Q, d.kq, d.jq)
             lck = layout.local_index(k, Q, d.kq)
-            # 1) panel broadcast along 'q'
+            # 1) panel broadcast along 'q' — or the lookahead-carried
+            # pre-updated next column from the previous step
             cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
-            pan = jax.lax.psum(
-                jnp.where(q == qk, cs, jnp.zeros_like(cs)),
-                pmesh.COL_AXIS)
+            if pan_next is None:
+                pan = jax.lax.psum(
+                    jnp.where(q == qk, cs, jnp.zeros_like(cs)),
+                    pmesh.COL_AXIS)
+            else:
+                pan = pan_next
             panm = jnp.where(active[:, None], pan, 0)
             # 2) local candidate election (one local LU per row-rank,
             #    concurrently across 'p' — the distributed panel)
@@ -503,6 +540,28 @@ def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh):
             # 6) local L column + Schur update of my trailing columns
             l21 = kb.trsm(jnp.triu(top), panm, side="R", lower=False)
             l21 = jnp.where((active & ~elim)[:, None], l21, 0)
+            # 6b) lookahead: assemble the NEXT panel column — narrow
+            # Schur update + the winner-row substitution of step 8,
+            # broadcast along 'q' — BEFORE the wide local update, so
+            # step k+1's candidate election and playoff collectives
+            # overlap this step's MXU-bound Schur matmul
+            if lookahead > 0 and k + 1 < KT:
+                qk1 = layout.owner(k + 1, Q, d.kq, d.jq)
+                lck1 = layout.local_index(k + 1, Q, d.kq)
+                cs1 = jax.lax.dynamic_slice_in_dim(A, lck1 * mb, mb,
+                                                   axis=1)
+                u12k1 = jax.lax.dynamic_slice_in_dim(u12, lck1 * mb,
+                                                     mb, axis=1)
+                coln = cs1 - kb.dot(l21, u12k1)
+                coln = coln.at[win_lrow].set(
+                    jnp.where(mine[:, None], u12k1,
+                              coln[jnp.where(mine, win_lrow, 0)]),
+                    mode="drop")
+                pan_next = jax.lax.psum(
+                    jnp.where(q == qk1, coln, jnp.zeros_like(coln)),
+                    pmesh.COL_AXIS)
+            else:
+                pan_next = None
             A = A - kb.dot(l21, u12)
             # 7) owners write the L column into the panel block
             newcs = jnp.where((active & ~elim)[:, None], l21, cs)
@@ -550,7 +609,8 @@ def getrf_cyclic(A: CyclicMatrix):
     ms = (m.shape[pmesh.ROW_AXIS], m.shape[pmesh.COL_AXIS])
     assert ms == (A.desc.dist.P, A.desc.dist.Q), (
         f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
-    out, wins, active = _getrf_cyclic_jit(A.data, A.desc, m)
+    out, wins, active = _getrf_cyclic_jit(A.data, A.desc, m,
+                                          _cyclic_lookahead())
     desc = A.desc
     d = desc.dist
     mb = desc.mb
@@ -620,8 +680,9 @@ def _cqr2_panel(x, M: int, mb: int, eps: float, pdiag, ldiag, p, ct,
     return packedtop, V1, T, Ub, q2
 
 
-@partial(jax.jit, static_argnums=(1, 2))
-def _geqrf_cyclic_jit(data, desc: CyclicDesc, mesh):
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def _geqrf_cyclic_jit(data, desc: CyclicDesc, mesh,
+                      lookahead: int = 0):
     """Distributed blocked Householder QR over cyclic local slabs —
     BASELINE config #3's hierarchical QR (ref src/zgeqrf_param.jdf +
     dplasma_hqr.c high-level trees) re-designed for the mesh: each
@@ -665,15 +726,19 @@ def _geqrf_cyclic_jit(data, desc: CyclicDesc, mesh):
         # identity-seed pad columns (zero pad panels break the Gram)
         A = _seed_pad_diag(A, desc, gid, gcid)
         Ts = []
+        pan_next = None
         for k in range(KT):
             pk = layout.owner(k, P, d.kp, d.ip)
             qk = layout.owner(k, Q, d.kq, d.jq)
             lrk = layout.local_index(k, P, d.kp)
             lck = layout.local_index(k, Q, d.kq)
             cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
-            pan = jax.lax.psum(
-                jnp.where(q == qk, cs, jnp.zeros_like(cs)),
-                pmesh.COL_AXIS)
+            if pan_next is None:
+                pan = jax.lax.psum(
+                    jnp.where(q == qk, cs, jnp.zeros_like(cs)),
+                    pmesh.COL_AXIS)
+            else:
+                pan = pan_next
             act = (gid >= k * mb)[:, None]
             x = jnp.where(act, pan, 0)
             # distributed CholeskyQR2 + TSQR-HR (shared helper), U
@@ -691,6 +756,25 @@ def _geqrf_cyclic_jit(data, desc: CyclicDesc, mesh):
             # trailing + R12 update: C <- C - V (T^H (V^H C))
             W = jax.lax.psum(kb.dot(Vloc, A, ta=True, conj_a=True),
                              pmesh.ROW_AXIS)
+            # lookahead: assemble + broadcast the NEXT panel column
+            # with a narrow compact-WY apply before the wide trailing
+            # update — step k+1's distributed CholeskyQR2 (its Gram
+            # psums) overlaps this step's MXU-bound apply
+            if lookahead > 0 and k + 1 < KT:
+                qk1 = layout.owner(k + 1, Q, d.kq, d.jq)
+                lck1 = layout.local_index(k + 1, Q, d.kq)
+                cs1 = jax.lax.dynamic_slice_in_dim(A, lck1 * mb, mb,
+                                                   axis=1)
+                Wk1 = jax.lax.dynamic_slice_in_dim(W, lck1 * mb, mb,
+                                                   axis=1)
+                updn = kb.dot(Vloc, kb.dot(T, Wk1, ta=True,
+                                           conj_a=True))
+                pan_next = jax.lax.psum(
+                    jnp.where(q == qk1, cs1 - updn,
+                              jnp.zeros_like(cs1)),
+                    pmesh.COL_AXIS)
+            else:
+                pan_next = None
             upd = kb.dot(Vloc, kb.dot(T, W, ta=True, conj_a=True))
             trail = (gcid >= (k + 1) * mb)[None, :]
             A = A - jnp.where(trail, upd, 0)
@@ -1121,8 +1205,20 @@ def geqrf_cyclic(A: CyclicMatrix):
     ms = (m.shape[pmesh.ROW_AXIS], m.shape[pmesh.COL_AXIS])
     assert ms == (A.desc.dist.P, A.desc.dist.Q), (
         f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
-    out, Ts = _geqrf_cyclic_jit(A.data, A.desc, m)
+    out, Ts = _geqrf_cyclic_jit(A.data, A.desc, m,
+                                _cyclic_lookahead())
     return CyclicMatrix(out, A.desc), Ts[0, 0]
+
+
+def _cyclic_lookahead() -> int:
+    """Pipeline depth for the cyclic factorization kernels: MCA
+    ``sweep.lookahead`` > 0 enables the one-column pan_next carry
+    (the shard_map bodies pipeline exactly one panel ahead — deeper
+    windows would carry multiple pre-updated columns for no extra
+    overlap on a single in-order core per rank)."""
+    from dplasma_tpu.ops._sweep import sweep_params
+    la, _ = sweep_params()
+    return 1 if la > 0 else 0
 
 
 def _mesh_of(A: CyclicMatrix):
@@ -1932,9 +2028,12 @@ def potrf_cyclic(A: CyclicMatrix, uplo: str = "L") -> CyclicMatrix:
     assert ms == (A.desc.dist.P, A.desc.dist.Q), (
         f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
     if uplo.upper() == "U":
+        # the U storage is the compat variant; the lookahead pipeline
+        # lives on the L path (and the single-chip sweep)
         out = _potrf_cyclic_upper_jit(A.data, A.desc, m)
     else:
-        out = _potrf_cyclic_jit(A.data, A.desc, m)
+        out = _potrf_cyclic_jit(A.data, A.desc, m,
+                                _cyclic_lookahead())
     return CyclicMatrix(out, A.desc)
 
 

@@ -17,7 +17,10 @@ tile row/column block, appended as extra tile blocks on the padded
   yields C plus its row/column checksum blocks. Verification compares
   per-tile block sums of C against both carried checksums; a tile
   flagged by BOTH is corrected by an O(mb·nb·K) recompute of just that
-  tile.
+  tile. An independent input-side probe ``alpha·A(Bw) + beta·Cw`` vs
+  ``C'w`` closes the consistent-corruption hole: a fault that zeroes
+  data AND carried checksums together passes the block-sum comparison
+  but not arithmetic it never touched.
 - **POTRF** (:func:`potrf_checksummed` / :func:`potrf_verify`): the
   bordered matrix ``[[A, A w], [w^T A, B]]`` (w = ones, B chosen to
   keep the border PD) factors so the border block of the factor IS the
@@ -132,7 +135,16 @@ def gemm_verify(out_aug: TileMatrix, alpha, A: TileMatrix, B: TileMatrix,
                 transb: str = "N", max_correct: int = 4):
     """Verify (and correct) a checksummed GEMM. Returns
     ``(C_plain, report)``; a tile flagged by both the carried row and
-    column checksums is recomputed in place (O(mb·nb·K) per tile)."""
+    column checksums is recomputed in place (O(mb·nb·K) per tile).
+
+    Besides the carried-vs-direct block sums, an INPUT-SIDE probe
+    ``alpha·A(Bw) + beta·Cw`` vs ``C'w`` (w = ones; O(n^2) matvecs on
+    the clean operands) cross-checks the product: a fault that
+    corrupts data and checksum blocks CONSISTENTLY — e.g.
+    ``--inject=zero@gemm:1`` zeroing the whole augmented product,
+    carried sums included — leaves the block-sum comparison blind but
+    cannot fool arithmetic the fault never touched (the ROADMAP ABFT
+    gap; same probe family as the potrf/getrf verifiers)."""
     with inject.suppressed():
         mb, nb = C0.desc.mb, C0.desc.nb
         MT, NT = C0.desc.MT, C0.desc.NT
@@ -143,7 +155,10 @@ def gemm_verify(out_aug: TileMatrix, alpha, A: TileMatrix, B: TileMatrix,
         exp_r = d[Mp:Mp + MT, :Np].reshape(MT, NT, nb).sum(axis=2)
         exp_c = d[:Mp, Np:Np + NT].reshape(MT, mb, NT).sum(axis=1)
         actn, rn, cn = (np.asarray(x) for x in (act, exp_r, exp_c))
-        Kdim = blas3._op(A.zero_pad().data, transa).shape[1]
+        a = blas3._op(A.zero_pad().data, transa)
+        b = blas3._op(B.zero_pad().data, transb)
+        c0 = C0.zero_pad().data
+        Kdim = a.shape[1]
         eps = _eps(C0.dtype)
         scale = max(_finite_max(actn, rn, cn), 1.0)
         # rounding of a block sum grows ~sqrt(work), and a single
@@ -156,14 +171,21 @@ def gemm_verify(out_aug: TileMatrix, alpha, A: TileMatrix, B: TileMatrix,
         m2 = _flag_outliers(actn - cn, floor)
         both = m1 & m2
         located = [(int(i), int(j)) for i, j in np.argwhere(both)]
-        detected = bool(m1.any() or m2.any())
+        al = jnp.asarray(alpha, C0.dtype)
+        be = jnp.asarray(beta, C0.dtype)
+        w = jnp.ones((Np,), C0.dtype)
+        lhs = al * (a @ (b @ w)) + be * (c0 @ w)
+        s_prb = max(_finite_max(lhs), 1.0)
+
+        def probe_bad(cur):
+            prb = np.asarray(lhs - cur @ w)
+            with np.errstate(invalid="ignore"):
+                return ~(np.abs(prb) <= THRESHOLD * eps
+                         * max(Kdim, Np) * s_prb)
+        bad_prb = probe_bad(core)
+        detected = bool(m1.any() or m2.any() or bad_prb.any())
         corrected = False
         if located and len(located) <= max_correct:
-            a = blas3._op(A.zero_pad().data, transa)
-            b = blas3._op(B.zero_pad().data, transb)
-            c0 = C0.zero_pad().data
-            al = jnp.asarray(alpha, C0.dtype)
-            be = jnp.asarray(beta, C0.dtype)
             for (i, j) in located:
                 r0, r1 = i * mb, (i + 1) * mb
                 c0_, c1 = j * nb, (j + 1) * nb
@@ -171,14 +193,17 @@ def gemm_verify(out_aug: TileMatrix, alpha, A: TileMatrix, B: TileMatrix,
                     + be * c0[r0:r1, c0_:c1]
                 core = core.at[r0:r1, c0_:c1].set(tile)
             corrected = True
+            bad_prb = probe_bad(core)   # re-probe the corrected product
         plain = TileMatrix(core, C0.desc).zero_pad()
         report = {
             "scheme": "gemm", "detected": detected,
             "located": [list(t) for t in located],
             "corrected": corrected,
             "mismatches": {"row_chk": int(m1.sum()),
-                           "col_chk": int(m2.sum())},
-            "ok": (not detected) or corrected,
+                           "col_chk": int(m2.sum()),
+                           "probe": int(bad_prb.sum())},
+            "ok": ((not detected) or corrected)
+            and not bool(bad_prb.any()),
         }
         return plain, report
 

@@ -117,6 +117,11 @@ def mca_set(name: str, value) -> None:
     _MCA_OVERRIDES[name] = str(value)
 
 
+def mca_unset(name: str) -> None:
+    """Drop a programmatic override (the env/default tiers resume)."""
+    _MCA_OVERRIDES.pop(name, None)
+
+
 def mca_get(name: str, default=None) -> Optional[str]:
     """Resolution order: explicit override > env DPLASMA_MCA_<NAME>
     (dots → underscores) > registered default > ``default``."""
@@ -189,6 +194,29 @@ mca_register("qr_panel", "auto",
              "matmul-shaped work; requires numerically full-rank "
              "panels). Applies only to ops.qr.geqrf, whose edge tiles "
              "are identity-padded to keep panels full rank.")
+mca_register("sweep.lookahead", "1",
+             "Lookahead depth of the pipelined factorization sweeps "
+             "(potrf/getrf/geqrf, single-chip and cyclic): how many "
+             "upcoming panel columns are updated by narrow applies "
+             "ahead of the wide trailing update, keeping the "
+             "serialized chain panel -> column-update -> panel "
+             "(Kurzak/Dongarra tiled-LU/QR lookahead; the reference "
+             "gets it from PaRSEC's dataflow scheduler). 0 = the "
+             "serialized baseline, bit-identical op order. CLI "
+             "--lookahead overrides.")
+mca_register("lu.agg_depth", "4",
+             "Fused far-flush depth of the EAGER dd LU sweep: the "
+             "wide trailing updates of this many consecutive panels "
+             "dispatch as ONE executable (identical op order — pure "
+             "dispatch fusion at ~5 ms/exec on the tunnel; the traced "
+             "sweep is already a single executable and ignores this).")
+mca_register("qr.agg_depth", "4",
+             "Update aggregation depth of the pipelined QR sweep: "
+             "the far trailing matrix is left untouched for this "
+             "many consecutive panels and then updated by ONE "
+             "compact-WY rank-(d*nb) apply (block-T accumulation), "
+             "streaming the far block through HBM once instead of d "
+             "times. 1 = per-panel far updates (baseline op order).")
 mca_register("dd_gemm", "auto",
              "FP64-equivalent limb GEMM for f64/c128 matmuls: auto "
              "(MXU backends only), always, never. The d/z-precision "

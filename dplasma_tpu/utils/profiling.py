@@ -224,6 +224,9 @@ class DagRecorder:
     tasks: List[_Task] = field(default_factory=list)
     edges: List[Tuple[int, int, str]] = field(default_factory=list)
     on_conflict: str = "raise"
+    #: builder-stamped metadata (e.g. the active pipeline shape, read
+    #: by dag_stats / the dagcheck comm reconciliation)
+    meta: Dict[str, dict] = field(default_factory=dict)
     _names: Dict[Tuple[str, Tuple[int, ...]], int] = field(
         default_factory=dict)
 
@@ -308,6 +311,7 @@ class DagRecorder:
         otherwise accumulates across runs)."""
         self.tasks.clear()
         self.edges.clear()
+        self.meta.clear()
         self._names.clear()
 
 

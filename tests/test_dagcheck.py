@@ -27,19 +27,36 @@ def _square(nt, dist):
 
 
 def _build(op, nt, dist, uplo="L"):
+    """Classic tile-level DAGs (lookahead pinned off — the golden
+    fixtures below assert the serialized structure and the exact comm
+    reconciliation; gemm has no pipelined variant)."""
     from dplasma_tpu.ops import gemm, lu, potrf, qr
     rec = DagRecorder(enabled=True)
     A = _square(nt, dist)
     if op == "potrf":
-        potrf.dag(A, uplo, rec)
+        potrf.dag(A, uplo, rec, lookahead=0)
     elif op == "getrf":
-        lu.dag(A, rec)
+        lu.dag(A, rec, lookahead=0)
     elif op == "geqrf":
-        qr.dag(A, rec)
+        qr.dag(A, rec, lookahead=0, agg_depth=1)
     else:
         Am = TileMatrix.zeros(nt * NB, 2 * NB, NB, NB, dist=dist)
         Bm = TileMatrix.zeros(2 * NB, nt * NB, NB, NB, dist=dist)
         gemm.dag(A, Am, Bm, rec)
+    return rec
+
+
+def _build_pipelined(op, nt, dist, la=1, agg=1, uplo="L"):
+    """The engine's split-column DAGs (ops._sweep.dag_pipelined)."""
+    from dplasma_tpu.ops import lu, potrf, qr
+    rec = DagRecorder(enabled=True)
+    A = _square(nt, dist)
+    if op == "potrf":
+        potrf.dag(A, uplo, rec, lookahead=la)
+    elif op == "getrf":
+        lu.dag(A, rec, lookahead=la)
+    else:
+        qr.dag(A, rec, lookahead=la, agg_depth=agg)
     return rec
 
 
@@ -66,6 +83,65 @@ def test_clean_across_size_grid_sweep(op, nt, dist):
             assert cm["dag_walk"] >= cm["model"]
         else:
             assert cm["dag_walk"] == cm["model"]
+
+
+@pytest.mark.parametrize("op", ["potrf", "getrf", "geqrf"])
+@pytest.mark.parametrize("nt", [3, 4, 5])
+@pytest.mark.parametrize("dist", GRIDS, ids=lambda d: f"{d.P}x{d.Q}")
+@pytest.mark.parametrize("la,agg", [(1, 1), (2, 1), (1, 2), (1, 4)])
+def test_pipelined_clean_across_size_grid_sweep(op, nt, dist, la, agg):
+    """The pipelined (split-column) DAG variants verify race-free,
+    flow-covered, and owner-consistent across the same size/grid sweep
+    as the classic fixtures; the comm walk is explicitly skipped
+    (fused-task granularity)."""
+    if op != "geqrf" and agg > 1:
+        pytest.skip("aggregation is the QR far-update knob")
+    rec = _build_pipelined(op, nt, dist, la=la, agg=agg)
+    res = check_dag(rec, rank_of=rank_of_dist(dist))
+    cm = check_comm(rec, op, nt * NB, nt * NB, 1, NB, NB, dist, res)
+    assert res.ok, res.format(f"{op}_pipe")
+    assert res.declared == res.tasks
+    assert res.checked_reads > 0
+    assert rec.meta["pipeline"]["lookahead"] == la
+    assert cm["relation"] == "skipped:pipelined" and cm["model"] is None
+
+
+def test_pipelined_mutation_dropped_column_update_edge():
+    """Drop the column-update -> panel flow edge (the edge that makes
+    the lookahead pipeline correct): the next panel's read of its
+    block-column is now unordered against the narrow update — the
+    checker names the exact task pair."""
+    dist = Dist(P=2, Q=2)
+    rec = _build_pipelined("getrf", 3, dist, la=1)
+    u = _tid(rec, "upd_col", 0, 1)
+    v = _tid(rec, "panel", 1)
+    assert (u, v) in {(s, d) for s, d, _ in rec.edges}
+    rec.edges = [e for e in rec.edges if (e[0], e[1]) != (u, v)]
+    res = check_dag(rec, rank_of=rank_of_dist(dist))
+    assert not res.ok
+    bad = [d for d in res.diagnostics if d.kind in ("war",
+                                                    "missing-flow")]
+    assert any(set(d.tasks) == {"upd_col(0,1)", "panel(1)"}
+               for d in bad), res.format()
+
+
+def test_pipelined_agg_far_update_reads_all_panels():
+    """With agg_depth=2 the aggregated far task applies two
+    consecutive panels in one pass: it must read both panel columns
+    and carry direct flow edges from both."""
+    rec = _build_pipelined("geqrf", 5, Dist(), la=0, agg=2)
+    agg_tasks = [t for t in rec.tasks
+                 if t.cls == "upd_far" and t.index[1] > 1]
+    assert agg_tasks, [t.name for t in rec.tasks]
+    edges = {(s, d) for s, d, _ in rec.edges}
+    for t in agg_tasks:
+        s0, d = t.index
+        for s in range(s0, s0 + d):
+            p = _tid(rec, "panel", s)
+            assert (p, t.tid) in edges
+            assert any(a[:2] == (s, s) or a[:3] == ("A", s, s)
+                       for a in t.reads)
+    assert check_dag(rec).ok
 
 
 def test_potrf_upper_is_clean_and_reconciles_transposed():
@@ -273,8 +349,10 @@ def test_large_dag_skips_reach_checks_but_not_linear_ones():
 
 
 def test_driver_dagcheck_end_to_end(tmp_path, capsys):
-    """--dagcheck verifies before executing and lands in the schema-v3
-    run-report."""
+    """--dagcheck verifies before executing and lands in the schema-v4
+    run-report. The default pipeline (lookahead=1) records the
+    engine's split-column DAG; --lookahead=0 records the classic tile
+    DAG — both must verify clean."""
     import json
 
     from dplasma_tpu.drivers import main
@@ -284,24 +362,43 @@ def test_driver_dagcheck_end_to_end(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "dagcheck[testing_dpotrf]" in out and "OK" in out
+    assert "#+ pipeline: sweep.lookahead=1" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 3
+    assert doc["schema"] == 4
+    assert doc["pipeline"]["sweep.lookahead"] == 1
     (entry,) = doc["dagcheck"]
-    assert entry["ok"] and entry["tasks"] == 20 and entry["edges"] == 30
-    assert entry["declared"] == 20 and entry["counts"] == {}
+    # pipelined potrf DAG at nt=4, la=1: 4 panels + 3 narrow lookahead
+    # column updates + 2 aggregated wide updates
+    assert entry["ok"] and entry["tasks"] == 9 and entry["edges"] == 11
+    assert entry["declared"] == 9 and entry["counts"] == {}
     assert any(m["name"] == "dagcheck_tasks_total"
                for m in doc["metrics"])
+    # serialized baseline: the classic tile DAG, unchanged
+    rj0 = str(tmp_path / "r0.json")
+    rc = main(["-N", "64", "-t", "16", "--lookahead", "0",
+               "--dagcheck", f"--report={rj0}", "-v=0"],
+              prog="testing_dpotrf")
+    capsys.readouterr()
+    assert rc == 0
+    doc0 = json.load(open(rj0))
+    assert doc0["pipeline"]["sweep.lookahead"] == 0
+    (entry0,) = doc0["dagcheck"]
+    assert entry0["ok"] and entry0["tasks"] == 20 \
+        and entry0["edges"] == 30 and entry0["declared"] == 20
 
 
 def test_driver_dagcheck_grid_reconciles(tmp_path, capsys, devices8):
     """On a 2x2 grid the owner-computes check runs against the CLI
     layout (the testers dress the DAG descriptor with it) and the
-    cross-rank flow walk reconciles exactly with the comm model."""
+    cross-rank flow walk reconciles exactly with the comm model
+    (classic DAG, --lookahead=0); the pipelined DAG verifies with the
+    tile-message walk explicitly skipped (fused-task granularity)."""
     import json
 
     from dplasma_tpu.drivers import main
     rj = str(tmp_path / "r.json")
     rc = main(["-N", "64", "-t", "16", "-p", "2", "-q", "2",
+               "--lookahead", "0",
                "--dagcheck", f"--report={rj}", "-v=0"],
               prog="testing_dpotrf")
     capsys.readouterr()
@@ -310,3 +407,12 @@ def test_driver_dagcheck_grid_reconciles(tmp_path, capsys, devices8):
     assert entry["ok"]
     assert entry["comm"]["relation"] == "==" and \
         entry["comm"]["dag_walk"] == entry["comm"]["model"] > 0
+    rj1 = str(tmp_path / "r1.json")
+    rc = main(["-N", "64", "-t", "16", "-p", "2", "-q", "2",
+               "--dagcheck", f"--report={rj1}", "-v=0"],
+              prog="testing_dpotrf")
+    capsys.readouterr()
+    assert rc == 0
+    (entry1,) = json.load(open(rj1))["dagcheck"]
+    assert entry1["ok"]
+    assert entry1["comm"]["relation"] == "skipped:pipelined"

@@ -47,7 +47,14 @@ def test_driver_dot_dump(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     text = open(dot).read()
-    assert "digraph" in text and "potrf(0)" in text
+    # default pipeline: the split-column engine DAG (panel/upd_col)
+    assert "digraph" in text and "panel(0)" in text
+    dot0 = dot + ".classic"
+    rc = main(["-N", "64", "-t", "16", "--lookahead", "0",
+               f"--dot={dot0}", "-v"], prog="testing_dpotrf")
+    capsys.readouterr()
+    assert rc == 0
+    assert "potrf(0)" in open(dot0).read()
 
 
 def test_driver_unknown_and_usage(capsys):
@@ -159,6 +166,7 @@ def test_driver_dot_uses_global_recorder(tmp_path, capsys):
         assert rc == 0
         capsys.readouterr()
         # recorder was used, then left disabled; its contents are the
-        # single run's DAG (4 panels -> 20 tasks), not an accumulation
+        # single run's pipelined DAG (4 panels + 3 narrow + 2 agg
+        # updates -> 9 tasks), not an accumulation
         assert not profiling.recorder.enabled
-        assert len(profiling.recorder.tasks) == 20
+        assert len(profiling.recorder.tasks) == 9

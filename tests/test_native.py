@@ -95,7 +95,7 @@ def test_profile_spans(tmp_path):
 def test_potrf_dag_dot():
     A = TileMatrix.zeros(16, 16, 4, 4, dist=Dist(P=2, Q=2))
     rec = DagRecorder(enabled=True)
-    potrf_mod.dag(A, "L", rec)
+    potrf_mod.dag(A, "L", rec, lookahead=0)   # classic structure
     names = {(t.cls, t.index) for t in rec.tasks}
     NT = 4
     assert ("potrf", (0,)) in names and ("potrf", (NT - 1,)) in names
@@ -134,9 +134,9 @@ def test_potrf_dag_uplo_u_ranks():
     # non-symmetric grid so (m,k) vs (k,m) owners differ
     A = TileMatrix.zeros(16, 16, 4, 4, dist=Dist(P=1, Q=4))
     rl = DagRecorder(enabled=True)
-    potrf_mod.dag(A, "L", rl)
+    potrf_mod.dag(A, "L", rl, lookahead=0)    # classic structure
     ru = DagRecorder(enabled=True)
-    potrf_mod.dag(A, "U", ru)
+    potrf_mod.dag(A, "U", ru, lookahead=0)
     # same task graph, transposed tile ownership
     assert {(t.cls, t.index) for t in rl.tasks} == \
         {(t.cls, t.index) for t in ru.tasks}

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 3
+    assert doc["schema"] == REPORT_SCHEMA == 4
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -151,7 +151,7 @@ def test_dag_stats_potrf():
     from dplasma_tpu.ops import potrf as potrf_mod
     A = TileMatrix.zeros(16, 16, 4, 4, dist=Dist(P=2, Q=2))
     rec = profiling.DagRecorder(enabled=True)
-    potrf_mod.dag(A, "L", rec)
+    potrf_mod.dag(A, "L", rec, lookahead=0)   # classic structure
     st = dag_stats(rec)
     NT = 4
     assert st["tasks"] == len(rec.tasks)
@@ -273,7 +273,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 3
+    assert doc["schema"] == 4
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]
@@ -318,7 +318,7 @@ def test_qr_dag_cross_panel_dependence():
     from dplasma_tpu.ops import qr
     A = TileMatrix.zeros(24, 24, 8, 8, dist=Dist(P=2, Q=2))
     rec = profiling.DagRecorder(enabled=True)
-    qr.dag(A, rec)
+    qr.dag(A, rec, lookahead=0, agg_depth=1)  # classic structure
     by = {(t.cls, t.index): t.tid for t in rec.tasks}
     edges = {(s, d) for s, d, _ in rec.edges}
     assert (by[("tsmqr", (2, 2, 0))], by[("tsmqr", (2, 2, 1))]) in edges

@@ -145,6 +145,25 @@ def test_abft_gemm_clean_zero_faults():
     assert float(jnp.max(jnp.abs(plain.to_dense() - ref))) < 1e-8
 
 
+def test_abft_gemm_consistent_corruption_probe():
+    """The ROADMAP ABFT gap, closed: zero@gemm:1 zeroes the WHOLE
+    augmented product — data and carried checksum blocks consistently
+    — so the block-sum comparison sees 0 == 0 everywhere. The
+    input-side probe alpha·A(Bw) + beta·Cw vs C'w runs on arithmetic
+    the fault never touched and must trip verification (ok=False)."""
+    A, B, C = _gemm_operands()
+    with inject.active(inject.parse_plan("zero@gemm:1", seed=7)) as f:
+        out = abft.gemm_checksummed(0.5, A, B, -0.3, C)
+    assert len(f) == 1
+    plain, rep = abft.gemm_verify(out, 0.5, A, B, -0.3, C)
+    # the carried checksums are blind to the consistent corruption...
+    assert rep["mismatches"]["row_chk"] == 0
+    assert rep["mismatches"]["col_chk"] == 0
+    # ...but the probe is not
+    assert rep["mismatches"]["probe"] > 0
+    assert rep["detected"] and not rep["corrected"] and not rep["ok"]
+
+
 def test_abft_potrf_detects_and_locates():
     n, t = 64, 16
     A0 = generators.plghe(float(n), n, t, seed=42, dtype=jnp.float64)
@@ -269,7 +288,7 @@ def test_driver_inject_detect_remediate_report(tmp_path, capsys):
     assert "#+ resilience: injected nan at trsm" in out
     assert "outcome remediated" in out
     doc = json.load(open(rep))
-    assert doc["schema"] == 3
+    assert doc["schema"] == 4
     r = doc["resilience"][0]
     assert r["injection"]["plan"].startswith("nan@trsm")
     assert len(r["injection"]["faults"]) == 1

@@ -58,9 +58,21 @@ def run_dagcheck_smoke() -> int:
         N = nt * nb
         A = TileMatrix.zeros(N, N, nb, nb, dist=dist)
         cases = [
-            ("potrf", lambda r: potrf.dag(A, "L", r), "potrf", 1),
-            ("lu", lambda r: lu.dag(A, r), "getrf", 1),
-            ("qr", lambda r: qr.dag(A, r), "geqrf", 1),
+            # classic DAGs (lookahead=0): comm reconciliation exact;
+            # pipelined DAGs (lookahead=1 + QR aggregation): the
+            # engine's split-column structure must also verify clean
+            # (comm walk skipped — fused-task granularity)
+            ("potrf", lambda r: potrf.dag(A, "L", r, lookahead=0),
+             "potrf", 1),
+            ("lu", lambda r: lu.dag(A, r, lookahead=0), "getrf", 1),
+            ("qr", lambda r: qr.dag(A, r, lookahead=0, agg_depth=1),
+             "geqrf", 1),
+            ("potrf_pipe", lambda r: potrf.dag(A, "L", r, lookahead=1),
+             "potrf", 1),
+            ("lu_pipe", lambda r: lu.dag(A, r, lookahead=1),
+             "getrf", 1),
+            ("qr_pipe", lambda r: qr.dag(A, r, lookahead=1,
+                                         agg_depth=2), "geqrf", 1),
         ]
         for label, build, op, K in cases:
             rec = DagRecorder(enabled=True)
