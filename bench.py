@@ -31,6 +31,13 @@ is re-printed (one line, flushed) after EVERY ladder entry, so an
 external timeout still leaves the last complete line parseable. Entries
 that would not fit the remaining budget are recorded as skipped rather
 than attempted.
+
+Cross-run ledger: every completed run appends its final JSON doc to
+``bench_history.jsonl`` (``--history=PATH`` / ``DPLASMA_BENCH_HISTORY``
+override), and ``--gate`` compares this run against the newest prior
+ledger entry with ``tools/perfdiff.py`` — a ladder metric regressing
+past ``--gate-threshold`` (default 10%) exits nonzero with the worst
+offender named.
 """
 from __future__ import annotations
 
@@ -61,6 +68,7 @@ from dplasma_tpu.kernels import blas as kb  # noqa: E402
 from dplasma_tpu.ops import generators, lu as lu_mod  # noqa: E402
 from dplasma_tpu.ops import potrf as potrf_mod, qr as qr_mod  # noqa: E402
 from dplasma_tpu.utils import flops as lawn41  # noqa: E402
+from tools import perfdiff  # noqa: E402
 from tools.gemmpeak import measure_peak  # noqa: E402
 
 
@@ -210,9 +218,30 @@ def _dd_bound_products(K: int) -> int:
     return nl * (nl + 1) // 2
 
 
-def main():
+def _parse_args(argv):
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="bench", description="headline benchmark ladder")
+    ap.add_argument("--history", default=None,
+                    help="bench_history.jsonl ledger path (default: "
+                         "$DPLASMA_BENCH_HISTORY or "
+                         "bench_history.jsonl)")
+    ap.add_argument("--gate", action="store_true",
+                    help="compare this run against the newest prior "
+                         "ledger entry (tools/perfdiff.py); exit "
+                         "nonzero on regression")
+    ap.add_argument("--gate-threshold", type=float,
+                    default=perfdiff.DEFAULT_THRESHOLD,
+                    help="relative regression threshold for --gate")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
     from dplasma_tpu.observability import RunReport
 
+    ns = _parse_args(argv)
+    history = ns.history or os.environ.get("DPLASMA_BENCH_HISTORY",
+                                           "bench_history.jsonl")
     on_tpu = jax.default_backend() != "cpu"
     budget_s = float(os.environ.get(
         "DPLASMA_BENCH_BUDGET_S", "1500" if on_tpu else "600"))
@@ -234,6 +263,8 @@ def main():
 
     def remaining():
         return deadline - time.monotonic()
+
+    last_doc = {}   # newest emitted doc (the ledger/gate source)
 
     def emit():
         """Print the full cumulative JSON doc (one line, flushed).
@@ -262,6 +293,7 @@ def main():
         report.extra["headline"] = {
             k: doc[k] for k in ("metric", "value", "unit",
                                 "vs_baseline", "elapsed_s")}
+        last_doc["doc"] = doc
         print(json.dumps(doc), flush=True)
         rp = os.environ.get("DPLASMA_BENCH_REPORT")
         if rp:
@@ -417,6 +449,43 @@ def main():
                   dtype=jnp.float64, hi=3)
     emit()
 
+    # cross-run ledger + regression gate: the newest PRIOR entry is
+    # the baseline (read before this run appends itself)
+    doc = last_doc.get("doc")
+    rc = 0
+    if doc is not None:
+        prev = None
+        if os.path.exists(history):
+            try:
+                prev = perfdiff.latest_ledger_entry(history)
+            except (OSError, ValueError) as exc:
+                print(f"#! cannot read bench history: {exc}",
+                      file=sys.stderr)
+        try:
+            perfdiff.append_ledger(history, doc)
+        except OSError as exc:
+            print(f"#! cannot append bench history: {exc}",
+                  file=sys.stderr)
+        if ns.gate:
+            if prev is None:
+                print("# bench gate: no prior ledger entry; skipped",
+                      file=sys.stderr)
+            else:
+                res = perfdiff.compare(prev, doc,
+                                       threshold=ns.gate_threshold)
+                for line in perfdiff.format_result(res):
+                    print(line, file=sys.stderr)
+                if res["compared"] == 0:
+                    # every ladder entry errored/skipped: a gate that
+                    # cannot compare anything must not pass vacuously
+                    print("# bench gate: nothing comparable against "
+                          "the prior entry; failing the gate",
+                          file=sys.stderr)
+                    rc = 1
+                elif not res["ok"]:
+                    rc = 1
+    return rc
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

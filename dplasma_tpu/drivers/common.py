@@ -93,6 +93,9 @@ class IParam:
     profile: Optional[str] = None    # DTPUPROF1 binary trace
     report: Optional[str] = None     # versioned JSON run-report
     jaxtrace: Optional[str] = None   # JAX/XLA profiler logdir
+    # performance attribution (--phase-profile/--peaks-file)
+    phase_profile: bool = False      # per-phase attributed pass (v5)
+    peaks_file: Optional[str] = None  # roofline peaks source
     # resilience (--abft/--inject/--max-retries/--run-timeout)
     abft: bool = False               # checksum-carried op variants
     inject: Optional[str] = None     # fault plan KIND@STAGE[:RATE[:COUNT]]
@@ -156,6 +159,18 @@ Optional arguments:
                      model, DAG analytics; default file: report.json)
  --jaxtrace[=dir]  : capture a device-side JAX/XLA profiler trace into
                      dir (default: jax_trace)
+ --phase-profile   : phase-level performance attribution: one extra
+                     eager attributed pass after the timed loop, with
+                     scoped phase timers (panel/lookahead/far_flush/
+                     catchup/assemble) fenced at span exit and met
+                     with roofline expectations; the per-phase table
+                     prints at -v>=2 and lands in the run-report
+                     (schema v5 "phases"/"roofline"). The timed loop
+                     itself stays fence-free
+ --peaks-file=FILE : hardware peaks for the roofline ledger (a bench
+                     JSON doc/report with a "peaks" section, or a raw
+                     {mxu_gflops, hbm_gbps, ici_gbps, latency_us}
+                     dict); default: conservative built-ins
  --abft            : checksum-carried (ABFT) op variants where
                      available (gemm/potrf/getrf): detect + locate a
                      corrupted tile in O(n^2), correct it for GEMM
@@ -206,6 +221,8 @@ _LONG = {
     "ht": ("_ht", _int),
     "abft": ("abft", None), "inject": ("inject", str),
     "dagcheck": ("dagcheck", None),
+    "phase-profile": ("phase_profile", None),
+    "peaks-file": ("peaks_file", str),
     "max-retries": ("max_retries", _int),
     "run-timeout": ("run_timeout", float),
 }
@@ -315,6 +332,11 @@ def _parse_arguments(args: list[str], ip: IParam) -> IParam:
     return ip
 
 
+def _pct(frac) -> str:
+    """Format an achieved fraction as a percent (None -> n/a)."""
+    return "n/a" if frac is None else f"{100.0 * frac:.1f}%"
+
+
 def _algo_of(name: str) -> str:
     """Precision-less algo name of a driver: testing_dpotrf -> potrf."""
     base = name.rsplit("/", 1)[-1]
@@ -379,6 +401,8 @@ class Driver:
         # -x verifications failed (run_driver turns that into exit 1)
         self.winner = name
         self.check_failures = 0
+        # roofline peaks (resolved lazily: --peaks-file / defaults)
+        self._peaks_cache = None
         # observability: one profile + one run-report per driver run
         # (written at close() when --profile/--report asked for them)
         self.prof = Profile(rank=ip.rank)
@@ -492,6 +516,60 @@ class Driver:
         if not res.ok:
             raise dc.DagCheckError(res)
         return res
+
+    def _peaks(self):
+        """Resolve the roofline peaks once per driver run
+        (``--peaks-file`` — a bench doc/report or raw peaks dict —
+        else the conservative built-ins). An unreadable file degrades
+        to the defaults with a warning, never a failed run."""
+        if self._peaks_cache is None:
+            from dplasma_tpu.observability import roofline as _rl
+            try:
+                self._peaks_cache = _rl.resolve_peaks(
+                    getattr(self.ip, "peaks_file", None),
+                    prec=getattr(self.ip, "prec", "d"))
+            except (OSError, ValueError) as exc:
+                sys.stderr.write(f"#! cannot read peaks file: {exc}\n")
+                self._peaks_cache = (dict(_rl.DEFAULT_PEAKS), "default")
+        return self._peaks_cache
+
+    def _phase_attribution(self, fn, args, name):
+        """``--phase-profile``: one extra EAGER attributed pass after
+        the timed loop. Eager dispatch gives the phase spans real
+        execution boundaries (per-callback jits on the dd routes, one
+        XLA op at a time elsewhere); each span fences at exit and the
+        ledger's measured times meet the roofline model's per-phase
+        expectations. The timed loop itself never fences — the default
+        path's fusion/overlap is untouched — so ``attributed_run_s``
+        is a separate, deliberately serialized measurement. Returns
+        the schema-v5 ``"phases"`` dict, or None when the pass fails
+        (a fn that only compiles under jit, an OOM, ...)."""
+        from dplasma_tpu.observability import phases as _phases
+        from dplasma_tpu.observability import roofline as _rl
+        from dplasma_tpu.observability.comm import OP_CLASS
+        ip = self.ip
+        t0 = time.perf_counter()
+        try:
+            with _phases.profiling() as led, \
+                    self.prof.span(f"phase:{name}"):
+                out = fn(*args)
+                self._sync(out)
+        except Exception as exc:
+            sys.stderr.write(
+                f"#! phase attribution failed for {name}: {exc!r}\n")
+            return None
+        total = time.perf_counter() - t0
+        peaks, src = self._peaks()
+        itemsize = np.dtype(PRECISIONS[ip.prec]).itemsize
+        model = _rl.phase_model(
+            OP_CLASS.get(_algo_of(self.name)), ip.M, ip.N, ip.NB,
+            itemsize, lookahead=self.pipeline["sweep.lookahead"],
+            agg_depth=self.pipeline["qr.agg_depth"])
+        spans = _rl.attribute_phases(led, model, peaks)
+        ssum = led.total()
+        return {"attributed_run_s": total, "sum_s": ssum,
+                "coverage": (ssum / total) if total > 0 else None,
+                "peaks_source": src, "spans": spans}
 
     def _lower_compile(self, fn, args, name):
         """Trace+compile with the device-chore host fallback
@@ -724,11 +802,29 @@ class Driver:
         dest = time.perf_counter() - t0
         gflops = (flops / 1e9) / best
         total = enq + best + dest
-        comm = self._comm_model() if ip.report else None
+        want_attrib = ip.report or getattr(ip, "phase_profile", False)
+        comm = self._comm_model() if want_attrib else None
+        # --phase-profile: the attributed eager pass runs AFTER the
+        # timed loop (and after any remediation settled on cur_fn), so
+        # the stats above are from the fence-free compiled path
+        phase_info = None
+        if getattr(ip, "phase_profile", False):
+            phase_info = self._phase_attribution(cur_fn, args, name)
         entry = self.report.add_op(
             name, prec=ip.prec, flops=flops, enq_s=enq, warmup_s=warm,
             dest_s=dest, runs_s=times, gflops=gflops, xla=xla_info,
-            comm=comm, dag=dag_info)
+            comm=comm, dag=dag_info, phases=phase_info)
+        # roofline ledger: expected-vs-measured for the whole op
+        # (schema v5 "roofline" section)
+        rl_entry = None
+        if want_attrib:
+            from dplasma_tpu.observability import roofline as _rl
+            from dplasma_tpu.observability.comm import OP_CLASS
+            peaks, src = self._peaks()
+            itemsize = np.dtype(PRECISIONS[ip.prec]).itemsize
+            rl_entry = self.report.add_roofline(_rl.op_roofline(
+                name, OP_CLASS.get(_algo_of(self.name)), ip.M, ip.N,
+                ip.K, itemsize, flops, comm, best, peaks, src))
         stats = entry["timings"]
         reg = self.report.metrics
         lbl = dict(op=name, prec=ip.prec)
@@ -746,6 +842,13 @@ class Driver:
         if comm and comm.get("dag_model"):
             reg.gauge("comm_bytes_dag_model", **lbl).set(
                 comm["dag_model"]["bytes_total"])
+        if rl_entry is not None and rl_entry["achieved_frac"] is not None:
+            reg.gauge("roofline_achieved_frac", **lbl).set(
+                rl_entry["achieved_frac"])
+        if phase_info is not None:
+            for s in phase_info["spans"]:
+                reg.gauge("phase_seconds", phase=s["phase"],
+                          **lbl).set(s["measured_s"])
         self.prof.save_dinfo(f"GFLOPS:{name}", gflops)
         if ip.rank == 0:
             if ip.loud >= 2:
@@ -760,6 +863,27 @@ class Driver:
                                          stats["median_s"],
                                          stats["max_s"],
                                          stats["stddev_s"]))
+                if rl_entry is not None:
+                    print("#+ roofline[%s]: bound=%s expected %.5g s "
+                          "measured %.5g s achieved %s (peaks: %s)"
+                          % (name, rl_entry["bound"],
+                             rl_entry["expected_s"], best,
+                             _pct(rl_entry["achieved_frac"]),
+                             rl_entry["peaks_source"]))
+                if phase_info is not None:
+                    print("#+ phases[%s]: attributed run %.5f s, "
+                          "spans %.5f s (coverage %s)"
+                          % (name, phase_info["attributed_run_s"],
+                             phase_info["sum_s"],
+                             _pct(phase_info["coverage"])))
+                    for s in phase_info["spans"]:
+                        print("#+   %-10s n=%3d measured %10.5f s "
+                              "expected %10.5g s achieved %7s "
+                              "bound=%s"
+                              % (s["phase"], s["count"],
+                                 s["measured_s"], s["expected_s"],
+                                 _pct(s["achieved_frac"]),
+                                 s["bound"]))
             print("[****] TIME(s) %12.5f : %s\tPxQxg= %3d %-3d %d NB= %4d "
                   "N= %7d : %14f gflops - ENQ&PROG&DEST %12.5f : %14f gflops"
                   " - ENQ %12.5f - DEST %12.5f"

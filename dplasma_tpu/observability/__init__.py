@@ -19,8 +19,20 @@ skeleton in :mod:`dplasma_tpu.utils.profiling`:
 * :mod:`.dag` — analytics over :class:`~dplasma_tpu.utils.profiling.
   DagRecorder` (task counts, critical path, wavefront width profile);
 * :mod:`.chrome` — DTPUPROF1 → Chrome trace-event JSON conversion
-  (the PaRSEC profile-converter analogue; view in Perfetto).
+  (the PaRSEC profile-converter analogue; view in Perfetto);
+* :mod:`.phases` — scoped phase timers (``panel`` / ``lookahead`` /
+  ``far_flush`` / ``catchup`` / ``assemble`` spans in the sweep
+  engine and ops), activated by the driver's ``--phase-profile``
+  attributed pass; inert no-ops otherwise;
+* :mod:`.roofline` — the roofline efficiency ledger: expected seconds
+  per phase/op from analytic flop/byte/dispatch demands against
+  probed peaks (bench ``peaks`` / ``--peaks-file`` / conservative
+  defaults), with a ``bound ∈ {mxu, hbm, ici, latency}`` label and
+  ``achieved_frac``. ``tools/perfdiff.py`` closes the loop across
+  runs (run-report vs run-report or vs the ``bench_history.jsonl``
+  ledger).
 """
+from dplasma_tpu.observability import phases, roofline
 from dplasma_tpu.observability.chrome import profile_to_chrome
 from dplasma_tpu.observability.comm import comm_volume_model
 from dplasma_tpu.observability.dag import dag_stats
@@ -30,5 +42,6 @@ from dplasma_tpu.observability.xla import capture_compiled
 
 __all__ = [
     "MetricsRegistry", "RunReport", "REPORT_SCHEMA", "capture_compiled",
-    "comm_volume_model", "dag_stats", "profile_to_chrome",
+    "comm_volume_model", "dag_stats", "phases", "profile_to_chrome",
+    "roofline",
 ]

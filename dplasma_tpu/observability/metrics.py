@@ -123,10 +123,17 @@ class MetricsRegistry:
         return self._metrics.get((name, _label_key(labels)))
 
     def snapshot(self) -> List[dict]:
-        """All instruments as JSON-able dicts, sorted by (name, labels)."""
+        """All instruments as JSON-able dicts, deterministically
+        ordered by (family name, sorted label pairs) — never by
+        insertion order — and with fixed key order inside each entry,
+        so two runs recording the same figures produce byte-identical
+        metric sections (``tools/perfdiff.py`` and the run-report
+        diffing depend on this)."""
         out = []
         with self._lock:
-            for (name, lk), m in sorted(self._metrics.items()):
+            items = sorted(self._metrics.items(),
+                           key=lambda kv: (kv[0][0], kv[0][1]))
+            for (name, lk), m in items:
                 kind = self._families[name]
                 entry = {"name": name, "type": kind, "labels": dict(lk)}
                 if kind == "histogram":

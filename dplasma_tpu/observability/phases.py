@@ -1,0 +1,140 @@
+"""Scoped phase timers for performance attribution (``--phase-profile``).
+
+The reference runtime attributes time to individual tasks (PaRSEC's
+per-task trace is how DPLASMA tells a panel-latency-bound run from an
+update-throughput-bound one); the TPU port executes whole sweeps as a
+handful of large XLA dispatches, so the useful granularity here is the
+*phase*: panel factorization, narrow lookahead applies, wide far
+flushes, catch-up replays, assembly. The sweep engine and the eager op
+routes wrap those regions in :func:`span`; a driver run with
+``--phase-profile`` activates a :class:`PhaseLedger` around one
+*attributed* eager pass and lands the per-phase times next to the
+roofline expectations (:mod:`dplasma_tpu.observability.roofline`) in
+the run-report (schema v5 ``"phases"`` per-op section).
+
+Fencing contract: a span only measures truthfully if the async work it
+issued has retired, so the values the instrumented region hands to the
+span sink are fenced (``jax.block_until_ready``) at span exit — but
+ONLY while a ledger is active. With no active ledger :func:`span`
+yields a no-op sink and never fences, so the default path keeps XLA's
+fusion/overlap behavior bit-for-bit (asserted by
+``tests/test_phases.py``). Spans encountered while *tracing* (inside a
+``jit``) are harmless either way: ``block_until_ready`` passes tracers
+through untouched, and the ledger is only ever activated around eager
+execution.
+
+Usage (instrumented code)::
+
+    with phases.span("panel") as fence:
+        pack, state = panel(col)
+        fence((pack, state))      # fenced at exit iff profiling is on
+
+Usage (harness)::
+
+    with phases.profiling() as ledger:
+        out = fn(*args)
+    ledger.summary()   # [{"phase", "count", "measured_s"}, ...]
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+
+class PhaseLedger:
+    """Per-phase accumulator: dispatch count + wall seconds."""
+
+    def __init__(self):
+        self.phases: Dict[str, dict] = {}
+
+    def add(self, name: str, seconds: float) -> None:
+        e = self.phases.setdefault(name, {"count": 0, "seconds": 0.0})
+        e["count"] += 1
+        e["seconds"] += float(seconds)
+
+    def total(self) -> float:
+        return sum(e["seconds"] for e in self.phases.values())
+
+    def summary(self) -> List[dict]:
+        """Phases as JSON-able rows, heaviest first (ties: by name, so
+        two identical runs serialize identically)."""
+        return [{"phase": name, "count": e["count"],
+                 "measured_s": e["seconds"]}
+                for name, e in sorted(self.phases.items(),
+                                      key=lambda kv:
+                                      (-kv[1]["seconds"], kv[0]))]
+
+
+#: the active ledger; None = profiling off (spans are no-ops)
+_active: Optional[PhaseLedger] = None
+
+
+def active() -> Optional[PhaseLedger]:
+    return _active
+
+
+def _fence(values) -> None:
+    """Block until every array in ``values`` has retired (tracers and
+    non-arrays pass through). The single choke point the no-fencing
+    test patches."""
+    import jax
+    jax.block_until_ready(values)
+
+
+class _Sink:
+    """Span sink: values passed in are fenced at span exit."""
+
+    __slots__ = ("values",)
+
+    def __init__(self):
+        self.values = []
+
+    def __call__(self, x):
+        self.values.append(x)
+        return x
+
+
+class _NoopSink:
+    """Inactive-profiling sink: identity, retains nothing."""
+
+    __slots__ = ()
+
+    def __call__(self, x):
+        return x
+
+
+_NOOP = _NoopSink()
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Time one phase region. Yields a sink; values the region passes
+    to the sink are fenced at exit *only when profiling is active* —
+    otherwise the whole thing is a no-op (no fencing, no timing)."""
+    led = _active
+    if led is None:
+        yield _NOOP
+        return
+    sink = _Sink()
+    t0 = time.perf_counter()
+    try:
+        yield sink
+    finally:
+        if sink.values:
+            _fence(sink.values)
+        led.add(name, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def profiling(ledger: Optional[PhaseLedger] = None):
+    """Activate a (fresh by default) ledger for the block; restores
+    the previous one on exit, so nested/overlapping scopes compose."""
+    global _active
+    prev = _active
+    led = ledger if ledger is not None else PhaseLedger()
+    _active = led
+    try:
+        yield led
+    finally:
+        _active = prev

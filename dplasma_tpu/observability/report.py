@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 4``).
+"""The versioned JSON run-report (``"schema": 5``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -13,13 +13,21 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
      "iparam": {...},              # the parsed driver parameter block
      "env": {"backend": ..., "jax": ..., "device_count": ...},
      "ops": [{"label": ..., "prec": ...,
-              "timings": {"enq_s", "warmup_s", "dest_s", "runs_s": [...],
+              "timings": {"enq_s", "warmup_s", "dest_s", "nruns",
+                          "runs_s": [...],
                           "best_s", "min_s", "median_s", "max_s",
-                          "mean_s", "stddev_s"},
+                          "mean_s", "stddev_s"},  # nruns=0 dry runs
+                                     # carry explicit nulls, never NaN
               "model_flops": ..., "gflops": ...,
               "xla": {...} | null,  # observability.xla.capture_compiled
               "comm": {...} | null, # observability.comm model
-              "dag": {...} | null}],# observability.dag.dag_stats
+              "dag": {...} | null,  # observability.dag.dag_stats
+              "phases": {"attributed_run_s", "sum_s", "coverage",
+                         "peaks_source",
+                         "spans": [{"phase", "count", "measured_s",
+                                    "expected_s", "achieved_frac",
+                                    "bound"}]} | null}],  # (v5,
+                                     # --phase-profile attribution)
      "metrics": [...],             # MetricsRegistry.snapshot()
      "checks": [{"what", "residual", "ok"}],   # -x verifications (v2)
      "resilience": [{"op", "enabled", "injection": {...} | null,
@@ -35,14 +43,21 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                                     "tile"}]}],            # (v3)
      "pipeline": {"sweep.lookahead": n,
                   "qr.agg_depth": d} | absent,             # (v4)
+     "roofline": [{"op", "op_class", "expected_s", "measured_s",
+                   "achieved_frac", "bound", "components_s",
+                   "peaks", "peaks_source"}],              # (v5)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
 sections; 3 adds ``"dagcheck"`` (--dagcheck static dataflow
 verification, analysis.dagcheck); 4 adds ``"pipeline"`` (the active
-lookahead/aggregation shape of the pipelined factorization sweeps).
-All additive — v1 readers of the other keys are unaffected; this
-reader accepts <= 4.
+lookahead/aggregation shape of the pipelined factorization sweeps);
+5 adds ``"phases"`` per op entry and the ``"roofline"`` section
+(--phase-profile / --peaks-file performance attribution,
+observability.phases + observability.roofline) plus the ``nruns``
+timing field. All additive — v1 readers of the other keys are
+unaffected; this reader accepts <= 5 (:func:`load_report` tolerates
+every v1-v5 vintage, filling the always-present keys).
 """
 from __future__ import annotations
 
@@ -54,18 +69,21 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 4
+REPORT_SCHEMA = 5
 
 
 def run_stats(runs_s: List[float]) -> dict:
     """min/median/max/mean/stddev of the per-run times (the reference
     prints per-run lines; ``best`` alone hides variance). The math is
     :meth:`Histogram.stats` — one statistics implementation for both
-    the report timings and the metrics snapshot."""
+    the report timings and the metrics snapshot. A no-runs entry
+    (``nruns=0`` dry runs) carries explicit nulls for every statistic
+    so the document still serializes/round-trips cleanly."""
     h = Histogram()
     h.samples = list(runs_s)
     s = h.stats()
-    return {"runs_s": list(runs_s), "best_s": s["min"],
+    return {"nruns": len(runs_s), "runs_s": list(runs_s),
+            "best_s": s["min"],
             "min_s": s["min"], "median_s": s["median"],
             "max_s": s["max"], "mean_s": s["mean"],
             "stddev_s": s["stddev"]}
@@ -84,6 +102,7 @@ class RunReport:
         self.resilience: List[dict] = []  # per-op ladder summaries
         self.dagcheck: List[dict] = []  # --dagcheck verification (v3)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
+        self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
         self._t0 = time.time_ns()
 
@@ -91,14 +110,15 @@ class RunReport:
                enq_s: float = 0.0, warmup_s: Optional[float] = None,
                dest_s: float = 0.0, runs_s: Optional[List[float]] = None,
                gflops: Optional[float] = None, xla: Optional[dict] = None,
-               comm: Optional[dict] = None,
-               dag: Optional[dict] = None) -> dict:
+               comm: Optional[dict] = None, dag: Optional[dict] = None,
+               phases: Optional[dict] = None) -> dict:
         timings = {"enq_s": enq_s, "warmup_s": warmup_s,
                    "dest_s": dest_s}
         timings.update(run_stats(runs_s or []))
         entry = {"label": label, "prec": prec, "model_flops": flops,
                  "gflops": gflops, "timings": timings,
-                 "xla": xla, "comm": comm, "dag": dag}
+                 "xla": xla, "comm": comm, "dag": dag,
+                 "phases": phases}
         self.ops.append(entry)
         return entry
 
@@ -121,6 +141,12 @@ class RunReport:
         analysis.dagcheck.CheckResult.summary)."""
         entry = {"op": op, **summary}
         self.dagcheck.append(entry)
+        return entry
+
+    def add_roofline(self, entry: dict) -> dict:
+        """Record one per-op roofline ledger entry (schema v5; see
+        observability.roofline.op_roofline)."""
+        self.roofline.append(entry)
         return entry
 
     def snapshot(self) -> dict:
@@ -148,6 +174,8 @@ class RunReport:
             doc["dagcheck"] = self.dagcheck
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
+        if self.roofline:
+            doc["roofline"] = self.roofline
         if self.entries:
             doc["entries"] = self.entries
         if self.extra:
@@ -176,11 +204,24 @@ def _json_default(o):
 
 def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
-    this reader."""
+    this reader.
+
+    Every older vintage (v1-v5) loads: the schema history is purely
+    additive, so an old doc is a valid new doc minus the sections its
+    writer didn't know about. The always-present keys (``schema``,
+    ``ops``, ``metrics``) are filled with safe defaults when absent,
+    so consumers (perfdiff, bench) can iterate them unconditionally;
+    optional sections stay absent exactly as the writer left them.
+    """
     with open(path) as f:
         doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: run-report is not a JSON object")
     if doc.get("schema", 0) > REPORT_SCHEMA:
         raise ValueError(
             f"run-report schema {doc.get('schema')} is newer than "
             f"supported ({REPORT_SCHEMA})")
+    doc.setdefault("schema", 1)
+    doc.setdefault("ops", [])
+    doc.setdefault("metrics", [])
     return doc

@@ -1,0 +1,293 @@
+"""Roofline efficiency ledger: expected-time-per-phase from analytic
+models against probed hardware peaks.
+
+The roofline model (Williams et al., CACM 2009) bounds the time of a
+computation from below by its heaviest resource demand: flops against
+the matrix-unit rate, bytes against HBM bandwidth, cross-chip bytes
+against ICI bandwidth, and dispatch count against per-call latency.
+The repo already carries analytic flops (LAWN-41 via
+``RunReport.add_op(model_flops=)``), an analytic comm-volume model
+(:mod:`dplasma_tpu.observability.comm`), and probed peaks (the bench
+ladder's ``peaks`` dict) — this module confronts them with measured
+time:
+
+* :func:`resolve_peaks` — peaks from a ``--peaks-file`` (a bench
+  report/JSON doc or a raw peaks dict) or the conservative built-in
+  defaults;
+* :func:`expected_seconds` — the roofline lower bound + the binding
+  resource label (``bound ∈ {mxu, hbm, ici, latency}``);
+* :func:`phase_model` — per-phase flop/byte/dispatch demands of the
+  factorization sweeps, simulated over the *same control flow* as
+  :func:`dplasma_tpu.ops._sweep.pipelined_sweep` (and the left-looking
+  potrf), so the expected split matches what the engine actually ran;
+* :func:`attribute_phases` / :func:`op_roofline` — join measured
+  (phase ledger / timed loop) with expected into the run-report's
+  schema-v5 ``"phases"`` and ``"roofline"`` sections, each with an
+  ``achieved_frac = expected_s / measured_s`` (1.0 = running at the
+  roofline; small = unexplained gap).
+
+Every expectation is a *lower bound* (touch-each-operand-once bytes,
+peak-rate flops), so ``achieved_frac`` lands in (0, 1] on honest
+peaks; a value far below 1 names the phase to go dig into.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Tuple
+
+#: conservative built-in peaks (used when no --peaks-file / bench peaks
+#: are available — e.g. CPU CI runs). Deliberately modest: an inflated
+#: peak would understate achieved_frac everywhere, a conservative one
+#: only compresses the range. Override from a bench report for real
+#: attribution on hardware.
+DEFAULT_PEAKS = {
+    "mxu_gflops": 200.0,   # sustained matmul rate
+    "hbm_gbps": 50.0,      # main-memory streaming bandwidth
+    "ici_gbps": 10.0,      # cross-chip interconnect bandwidth
+    "latency_us": 50.0,    # per-dispatch overhead
+}
+
+#: resource labels, in tie-break precedence order
+BOUNDS = ("mxu", "hbm", "ici", "latency")
+
+#: bench peaks-dict key per precision letter (the ladder probes the
+#: f32-HIGHEST GEMM peak and the int8-limb f64-equivalent bound)
+_BENCH_MXU_KEY = {"s": "f32_highest_gflops", "c": "f32_highest_gflops",
+                  "d": "f64equiv_bound_gflops",
+                  "z": "f64equiv_bound_gflops"}
+
+
+def resolve_peaks(path: Optional[str] = None,
+                  prec: str = "s") -> Tuple[dict, str]:
+    """Resolve the peaks dict: ``(peaks, source)``.
+
+    ``path`` may be a bench run-report (peaks under ``extra.peaks``),
+    the bench one-line JSON doc (top-level ``peaks``), or a raw peaks
+    dict with the canonical keys. Missing figures keep the
+    conservative defaults; the MXU rate maps per precision from the
+    bench ladder's probed peaks when no explicit ``mxu_gflops`` is
+    given. No path → :data:`DEFAULT_PEAKS`.
+    """
+    peaks = dict(DEFAULT_PEAKS)
+    if not path:
+        return peaks, "default"
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    raw = doc.get("peaks") or (doc.get("extra") or {}).get("peaks") \
+        or doc
+    if not isinstance(raw, dict):
+        # e.g. {"peaks": [..]} — a ValueError keeps the driver's
+        # degrade-to-defaults contract (Driver._peaks catches it)
+        raise ValueError(f"{path}: peaks section is not a JSON object")
+    for key in DEFAULT_PEAKS:
+        if isinstance(raw.get(key), (int, float)):
+            peaks[key] = float(raw[key])
+    if not isinstance(raw.get("mxu_gflops"), (int, float)):
+        probed = raw.get(_BENCH_MXU_KEY.get(prec, "f32_highest_gflops"))
+        if isinstance(probed, (int, float)) and probed > 0:
+            peaks["mxu_gflops"] = float(probed)
+    return peaks, f"file:{path}"
+
+
+def expected_seconds(flops: float = 0.0, hbm_bytes: float = 0.0,
+                     ici_bytes: float = 0.0, dispatches: int = 0,
+                     peaks: Optional[dict] = None):
+    """Roofline lower bound for one phase/op.
+
+    Returns ``(expected_s, bound, components_s)`` where ``bound`` names
+    the binding resource and ``components_s`` carries every resource's
+    individual bound (so a report reader sees how close the runner-up
+    is)."""
+    p = peaks or DEFAULT_PEAKS
+    comp = {
+        "mxu": flops / (p["mxu_gflops"] * 1e9),
+        "hbm": hbm_bytes / (p["hbm_gbps"] * 1e9),
+        "ici": ici_bytes / (p["ici_gbps"] * 1e9),
+        "latency": dispatches * p["latency_us"] * 1e-6,
+    }
+    bound = max(BOUNDS, key=lambda b: comp[b])
+    return comp[bound], bound, comp
+
+
+# ---------------------------------------------------------------------
+# Analytic per-phase demand model of the factorization sweeps
+# ---------------------------------------------------------------------
+
+def _apply_cost(op_class: str, m: int, w: int, nb: int, d: int,
+                itemsize: int):
+    """Flops/bytes of applying ``d`` aggregated panels (rank d·nb) to
+    an ``m x w`` block: LU = triangular solve + Schur product, QR =
+    compact-WY (two tall products + the T application)."""
+    r = d * nb
+    fl = (4.0 if op_class == "geqrf" else 2.0) * m * r * w
+    by = (2.0 * m * w + d * (m * nb + nb * w)) * itemsize
+    return fl, by
+
+
+def _panel_cost(op_class: str, m: int, nb: int, itemsize: int):
+    fl = (2.0 if op_class == "geqrf" else 1.0) * m * nb * nb
+    return fl, 2.0 * m * nb * itemsize
+
+
+def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
+                itemsize: int, lookahead: int = 1,
+                agg_depth: int = 1) -> Optional[Dict[str, list]]:
+    """Per-phase ``{name: [flops, hbm_bytes, dispatches]}`` demands.
+
+    Mirrors the control flow of :func:`dplasma_tpu.ops._sweep.
+    pipelined_sweep` (right-looking ``getrf``/``geqrf``) and the
+    left-looking ``potrf`` column sweep, at the same (lookahead,
+    agg_depth) shape — phase names match the spans the instrumented
+    code emits (``panel`` / ``lookahead`` / ``far_flush`` / ``catchup``
+    / ``assemble``). The total flops across phases is invariant in the
+    pipeline shape (the split moves work between phases, never creates
+    it). Unmodelled op classes return None.
+    """
+    if op_class not in ("getrf", "geqrf", "potrf") or nb <= 0:
+        return None
+    la = max(int(lookahead), 0)
+    agg = max(int(agg_depth), 1) if op_class == "geqrf" else 1
+    MT, NT = -(-M // nb), -(-N // nb)
+    KT = min(MT, NT)
+    Mp = MT * nb
+
+    acc: Dict[str, list] = {}
+
+    def add(phase, fl, by, n=1):
+        a = acc.setdefault(phase, [0.0, 0.0, 0])
+        a[0] += fl
+        a[1] += by
+        a[2] += n
+
+    if op_class == "potrf":
+        # left-looking: column kk accumulates panels 0..kk-1 (la
+        # freshest narrow, older folded into one wide product), then
+        # factors its own panel
+        for kk in range(KT):
+            m = Mp - kk * nb
+            fresh_from = max(kk - la, 0) if la > 0 else 0
+            if fresh_from > 0:
+                add("far_flush",
+                    *_apply_cost("potrf", m, nb, nb, fresh_from,
+                                 itemsize))
+            for _ in range(fresh_from, kk):
+                add("lookahead",
+                    *_apply_cost("potrf", m, nb, nb, 1, itemsize))
+            add("panel", *_panel_cost("potrf", m, nb, itemsize))
+        add("assemble", 0.0, 2.0 * Mp * Mp * itemsize)
+        return acc
+
+    # right-looking engine simulation (mirrors pipelined_sweep /
+    # _sweep.dag_pipelined)
+    pending: list = []
+    ahead: list = []
+    farq = list(range(NT))
+
+    def peel():
+        c = farq.pop(0)
+        if pending:
+            fl = by = 0.0
+            for s in pending:
+                f, b = _apply_cost(op_class, Mp - s * nb, nb, nb, 1,
+                                   itemsize)
+                fl += f
+                by += b
+            add("catchup", fl, by)
+        return c
+
+    for _ in range(min(1 + la, NT)):
+        ahead.append(peel())
+
+    for kk in range(KT):
+        ahead.pop(0)
+        m = Mp - kk * nb
+        add("panel", *_panel_cost(op_class, m, nb, itemsize))
+        pending.append(kk)
+        if ahead:
+            fl = by = 0.0
+            for _ in ahead:
+                f, b = _apply_cost(op_class, m, nb, nb, 1, itemsize)
+                fl += f
+                by += b
+            add("lookahead", fl, by)
+        if len(pending) >= agg or kk == KT - 1:
+            if farq:
+                w = len(farq) * nb
+                if agg > 1 and len(pending) > 1:
+                    add("far_flush",
+                        *_apply_cost(op_class, Mp - pending[0] * nb, w,
+                                     nb, len(pending), itemsize))
+                else:
+                    for s in pending:
+                        add("far_flush",
+                            *_apply_cost(op_class, Mp - s * nb, w, nb,
+                                         1, itemsize))
+            pending.clear()
+        while len(ahead) < 1 + la and farq:
+            ahead.append(peel())
+
+    add("assemble", 0.0, 2.0 * Mp * NT * nb * itemsize)
+    return acc
+
+
+# ---------------------------------------------------------------------
+# Joins: measured x expected -> report sections
+# ---------------------------------------------------------------------
+
+def attribute_phases(ledger, model: Optional[dict],
+                     peaks: Optional[dict] = None) -> list:
+    """Join a :class:`~dplasma_tpu.observability.phases.PhaseLedger`
+    with the analytic demand model into the schema-v5 per-phase rows
+    ``{phase, count, measured_s, expected_s, achieved_frac, bound}``.
+
+    Phases the model doesn't know get a latency-only expectation (the
+    dispatch count is still a real lower bound), so every measured
+    span carries a bound label."""
+    out = []
+    for row in ledger.summary():
+        name, meas = row["phase"], row["measured_s"]
+        demand = (model or {}).get(name)
+        if demand is not None:
+            exp, bound, _ = expected_seconds(
+                flops=demand[0], hbm_bytes=demand[1],
+                dispatches=row["count"], peaks=peaks)
+        else:
+            exp, bound, _ = expected_seconds(
+                dispatches=row["count"], peaks=peaks)
+        out.append({"phase": name, "count": row["count"],
+                    "measured_s": meas, "expected_s": exp,
+                    "achieved_frac": (exp / meas) if meas > 0 else None,
+                    "bound": bound})
+    return out
+
+
+def op_roofline(label: str, op_class: Optional[str], M: int, N: int,
+                K: int, itemsize: int, model_flops: float,
+                comm: Optional[dict], measured_s: float,
+                peaks: Optional[dict] = None,
+                peaks_source: str = "default") -> dict:
+    """Whole-op roofline entry for the report's ``"roofline"`` section.
+
+    HBM bytes are the touch-each-operand-once lower bound; ICI bytes
+    come from the analytic comm model when present (max of the DAG and
+    SPMD pricings — either is a valid lower bound on what crossed the
+    wire)."""
+    hbm = float(M * N + M * K + K * N) * itemsize
+    ici = 0.0
+    for mdl in ("dag_model", "spmd_model"):
+        m = (comm or {}).get(mdl) or {}
+        b = m.get("bytes_total")
+        if isinstance(b, (int, float)):
+            ici = max(ici, float(b))
+    exp, bound, comp = expected_seconds(
+        flops=model_flops, hbm_bytes=hbm, ici_bytes=ici, dispatches=1,
+        peaks=peaks)
+    return {"op": label, "op_class": op_class,
+            "expected_s": exp, "measured_s": measured_s,
+            "achieved_frac": (exp / measured_s) if measured_s > 0
+            else None,
+            "bound": bound, "components_s": comp,
+            "peaks": dict(peaks or DEFAULT_PEAKS),
+            "peaks_source": peaks_source}

@@ -29,6 +29,12 @@ matrix through HBM once instead of d times.
 ``lookahead=0, agg_depth=1`` reproduces the serialized sweep's exact
 op order (bit-identical trace); MCA ``sweep.lookahead`` /
 ``qr.agg_depth`` (CLI ``--lookahead``) select the pipeline shape.
+
+The engine's regions carry scoped phase spans
+(:mod:`dplasma_tpu.observability.phases`: ``panel`` / ``lookahead`` /
+``far_flush`` / ``catchup`` / ``assemble``) — inert no-ops unless a
+driver's ``--phase-profile`` attributed pass activates a ledger, so
+the default traced path is unchanged.
 """
 from __future__ import annotations
 
@@ -72,6 +78,7 @@ def pipelined_sweep(rest, bw: int, KT: int, NT: int, panel, apply_block,
     from far mid-window is caught up by replaying the pending states.
     Returns ``(packs, urows)`` in :func:`assemble_sweep` layout.
     """
+    from dplasma_tpu.observability import phases
     la = max(int(lookahead), 0)
     d = max(int(agg_depth), 1) if agg_apply is not None else 1
     packs = []
@@ -88,9 +95,12 @@ def pipelined_sweep(rest, bw: int, KT: int, NT: int, panel, apply_block,
         far = far[:, w:]
         idx = far_col
         far_col += 1
-        for s, st in pending:          # catch up to the window
-            top, blk = apply_block(st, blk)
-            pieces[s][idx] = top
+        if pending:                    # catch up to the window
+            with phases.span("catchup") as _f:
+                for s, st in pending:
+                    top, blk = apply_block(st, blk)
+                    pieces[s][idx] = top
+                _f(blk)
         return [idx, blk]
 
     for _ in range(min(1 + la, NT)):   # window: panel + la columns
@@ -98,22 +108,30 @@ def pipelined_sweep(rest, bw: int, KT: int, NT: int, panel, apply_block,
 
     for kk in range(KT):
         _, c = ahead.pop(0)
-        pack, st = panel(c)
+        with phases.span("panel") as _f:
+            pack, st = panel(c)
+            _f((pack, st))
         packs.append(pack)
         pending.append((kk, st))
-        for slot in ahead:             # narrow lookahead-column updates
-            top, slot[1] = apply_block(st, slot[1])
-            pieces[kk][slot[0]] = top
+        if ahead:                      # narrow lookahead-column updates
+            with phases.span("lookahead") as _f:
+                for slot in ahead:
+                    top, slot[1] = apply_block(st, slot[1])
+                    pieces[kk][slot[0]] = top
+                    _f((top, slot[1]))
         if len(pending) >= d or kk == KT - 1:   # far flush
             if far.shape[1]:
-                if agg_apply is not None and len(pending) > 1:
-                    tops, far = agg_apply([s for _, s in pending], far)
-                    for (s, _), top in zip(pending, tops):
-                        pieces[s][far_col] = top
-                else:
-                    for s, st in pending:
-                        top, far = apply_block(st, far)
-                        pieces[s][far_col] = top
+                with phases.span("far_flush") as _f:
+                    if agg_apply is not None and len(pending) > 1:
+                        tops, far = agg_apply([s for _, s in pending],
+                                              far)
+                        for (s, _), top in zip(pending, tops):
+                            pieces[s][far_col] = top
+                    else:
+                        for s, st in pending:
+                            top, far = apply_block(st, far)
+                            pieces[s][far_col] = top
+                    _f(far)
             pending.clear()
         while len(ahead) < 1 + la and far.shape[1] > 0:
             ahead.append(peel())       # refill the window
@@ -135,20 +153,22 @@ def assemble_sweep(packs, urows, KT: int, NT: int, nb: int,
     right of it. ``reorder``, when given, maps column-block index ->
     traced row-gather indices for the below-diagonal part (deferred
     pivoting)."""
-    outcols = []
-    for kk in range(NT):
-        pieces = [urows[j][:, (kk - j - 1) * nb:(kk - j) * nb]
-                  for j in range(min(kk, KT))]
-        if kk < KT:
-            pan = packs[kk]
-            pieces.append(pan[:nb])
-            if pan.shape[0] > nb:
-                below = pan[nb:] if reorder is None else \
-                    pan[reorder(kk)]
-                pieces.append(below)
-        outcols.append(pieces[0] if len(pieces) == 1
-                       else jnp.concatenate(pieces, axis=0))
-    return jnp.concatenate(outcols, axis=1)
+    from dplasma_tpu.observability import phases
+    with phases.span("assemble") as _f:
+        outcols = []
+        for kk in range(NT):
+            pieces = [urows[j][:, (kk - j - 1) * nb:(kk - j) * nb]
+                      for j in range(min(kk, KT))]
+            if kk < KT:
+                pan = packs[kk]
+                pieces.append(pan[:nb])
+                if pan.shape[0] > nb:
+                    below = pan[nb:] if reorder is None else \
+                        pan[reorder(kk)]
+                    pieces.append(below)
+            outcols.append(pieces[0] if len(pieces) == 1
+                           else jnp.concatenate(pieces, axis=0))
+        return _f(jnp.concatenate(outcols, axis=1))
 
 
 # ---------------------------------------------------------------------

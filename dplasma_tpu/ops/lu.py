@@ -223,8 +223,13 @@ def _base_lu(panel, chunk: int | None = None):
 
 def _lu_finish(packs, urows, step_ids, ids, Mp, KT, NT, bw):
     """Deferred-pivot stitching shared by the traced and eager sweeps:
-    final row order, per-step reorder closure, assembly."""
-    final_ids = jnp.concatenate([si[:bw] for si in step_ids] + [ids])
+    final row order, per-step reorder closure, assembly. The pivot
+    bookkeeping is attributed to the ``assemble`` phase (sibling of
+    the span inside :func:`~dplasma_tpu.ops._sweep.assemble_sweep`)."""
+    from dplasma_tpu.observability import phases
+    with phases.span("assemble") as _f:
+        final_ids = _f(jnp.concatenate(
+            [si[:bw] for si in step_ids] + [ids]))
 
     def reorder(kk):
         sids = step_ids[kk]

@@ -80,6 +80,9 @@ def potrf(A: TileMatrix, uplo: str = "L", *, diag_kernel=None,
 
     # cols[j]: finished block column j (lower: rows j*mb.., width mb;
     # upper: the mirrored row block), diagonal tile at the top/left.
+    # Regions carry phase spans (observability.phases) — inert unless
+    # a --phase-profile attributed pass has a ledger active.
+    from dplasma_tpu.observability import phases
     cols = []
     for kk in range(nt):
         s = kk * mb
@@ -89,57 +92,71 @@ def potrf(A: TileMatrix, uplo: str = "L", *, diag_kernel=None,
             if fresh_from > 0:
                 # aggregated wide product of the older panels (one
                 # column stream instead of fresh_from skinny ones)
-                W = jnp.concatenate(
-                    [cols[j][s - j * mb:] for j in range(fresh_from)],
-                    axis=1)
-                B = jnp.concatenate(
-                    [cols[j][s - j * mb:s - j * mb + mb]
-                     for j in range(fresh_from)], axis=1)
-                col = col - k.dot(W, B, tb=True, conj_b=True)
-            for j in range(fresh_from, kk):
-                Lj = cols[j]
-                off = s - j * mb
-                col = col - k.dot(Lj[off:, :], Lj[off:off + mb, :],
-                                  tb=True, conj_b=True)
-            lkk = dk(col[:mb], lower=True)
-            if s + mb < Mp:
-                pan = k.trsm(lkk, col[mb:], side="R", lower=True,
-                             trans="C")
-                cols.append(jnp.concatenate([lkk, pan], axis=0))
-            else:
-                cols.append(lkk)
+                with phases.span("far_flush") as _f:
+                    W = jnp.concatenate(
+                        [cols[j][s - j * mb:]
+                         for j in range(fresh_from)], axis=1)
+                    B = jnp.concatenate(
+                        [cols[j][s - j * mb:s - j * mb + mb]
+                         for j in range(fresh_from)], axis=1)
+                    col = _f(col - k.dot(W, B, tb=True, conj_b=True))
+            if fresh_from < kk:
+                with phases.span("lookahead") as _f:
+                    for j in range(fresh_from, kk):
+                        Lj = cols[j]
+                        off = s - j * mb
+                        col = col - k.dot(Lj[off:, :],
+                                          Lj[off:off + mb, :],
+                                          tb=True, conj_b=True)
+                    _f(col)
+            with phases.span("panel") as _f:
+                lkk = dk(col[:mb], lower=True)
+                if s + mb < Mp:
+                    pan = k.trsm(lkk, col[mb:], side="R", lower=True,
+                                 trans="C")
+                    cols.append(_f(jnp.concatenate([lkk, pan], axis=0)))
+                else:
+                    cols.append(_f(lkk))
         else:
             row = X[s:s + mb, s:]
             if fresh_from > 0:
-                W = jnp.concatenate(
-                    [cols[j][:, s - j * mb:] for j in range(fresh_from)],
-                    axis=0)
-                B = jnp.concatenate(
-                    [cols[j][:, s - j * mb:s - j * mb + mb]
-                     for j in range(fresh_from)], axis=0)
-                row = row - k.dot(B, W, ta=True, conj_a=True)
-            for j in range(fresh_from, kk):
-                Uj = cols[j]
-                off = s - j * mb
-                row = row - k.dot(Uj[:, off:off + mb], Uj[:, off:],
-                                  ta=True, conj_a=True)
-            ukk = dk(row[:, :mb], lower=False)
-            if s + mb < Mp:
-                pan = k.trsm(ukk, row[:, mb:], side="L", lower=False,
-                             trans="C")
-                cols.append(jnp.concatenate([ukk, pan], axis=1))
-            else:
-                cols.append(ukk)
-    if lower:
-        out = [jnp.concatenate(
-            [jnp.zeros((j * mb, mb), X.dtype), c], axis=0)
-            for j, c in enumerate(cols)]
-        full = jnp.concatenate(out, axis=1)
-    else:
-        out = [jnp.concatenate(
-            [jnp.zeros((mb, j * mb), X.dtype), c], axis=1)
-            for j, c in enumerate(cols)]
-        full = jnp.concatenate(out, axis=0)
+                with phases.span("far_flush") as _f:
+                    W = jnp.concatenate(
+                        [cols[j][:, s - j * mb:]
+                         for j in range(fresh_from)], axis=0)
+                    B = jnp.concatenate(
+                        [cols[j][:, s - j * mb:s - j * mb + mb]
+                         for j in range(fresh_from)], axis=0)
+                    row = _f(row - k.dot(B, W, ta=True, conj_a=True))
+            if fresh_from < kk:
+                with phases.span("lookahead") as _f:
+                    for j in range(fresh_from, kk):
+                        Uj = cols[j]
+                        off = s - j * mb
+                        row = row - k.dot(Uj[:, off:off + mb],
+                                          Uj[:, off:],
+                                          ta=True, conj_a=True)
+                    _f(row)
+            with phases.span("panel") as _f:
+                ukk = dk(row[:, :mb], lower=False)
+                if s + mb < Mp:
+                    pan = k.trsm(ukk, row[:, mb:], side="L",
+                                 lower=False, trans="C")
+                    cols.append(_f(jnp.concatenate([ukk, pan], axis=1)))
+                else:
+                    cols.append(_f(ukk))
+    with phases.span("assemble") as _f:
+        if lower:
+            out = [jnp.concatenate(
+                [jnp.zeros((j * mb, mb), X.dtype), c], axis=0)
+                for j, c in enumerate(cols)]
+            full = jnp.concatenate(out, axis=1)
+        else:
+            out = [jnp.concatenate(
+                [jnp.zeros((mb, j * mb), X.dtype), c], axis=1)
+                for j, c in enumerate(cols)]
+            full = jnp.concatenate(out, axis=0)
+        _f(full)
     return TileMatrix(pmesh.constrain2d(full), A.desc)
 
 

@@ -216,9 +216,14 @@ def geqrf(A: TileMatrix, *, panel_kernel=None, lookahead=None,
 
     full = assemble_sweep(packs, rrows, KT, NT, nb)
     Tm = t_desc(A)
-    Td = jnp.concatenate(Ts, axis=1)
-    if Td.shape[1] < Tm.desc.Np:
-        Td = jnp.pad(Td, ((0, 0), (0, Tm.desc.Np - Td.shape[1])))
+    # T-factor stitching rides the assemble phase (sibling span of the
+    # one inside assemble_sweep — no nesting, no double counting)
+    from dplasma_tpu.observability import phases
+    with phases.span("assemble") as _f:
+        Td = jnp.concatenate(Ts, axis=1)
+        if Td.shape[1] < Tm.desc.Np:
+            Td = jnp.pad(Td, ((0, 0), (0, Tm.desc.Np - Td.shape[1])))
+        _f(Td)
     return (TileMatrix(pmesh.constrain2d(full), A.desc),
             TileMatrix(Td, Tm.desc))
 

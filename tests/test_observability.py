@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 4
+    assert doc["schema"] == REPORT_SCHEMA == 5
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -76,6 +76,99 @@ def test_run_report_rejects_newer_schema(tmp_path):
         json.dump({"schema": REPORT_SCHEMA + 1}, f)
     with pytest.raises(ValueError):
         load_report(p)
+
+
+def test_run_report_no_runs_entry_roundtrip(tmp_path):
+    """A dry run (nruns=0, no timed executions, no warmup) must
+    serialize cleanly: explicit nulls for every statistic, and the
+    doc round-trips through write/load_report byte-honestly."""
+    rep = RunReport("testing_dpotrf")
+    entry = rep.add_op("testing_dpotrf", prec="d", runs_s=[])
+    t = entry["timings"]
+    assert t["nruns"] == 0 and t["runs_s"] == []
+    assert t["warmup_s"] is None
+    for k in ("best_s", "min_s", "median_s", "max_s", "mean_s",
+              "stddev_s"):
+        assert t[k] is None
+    p = str(tmp_path / "dry.json")
+    rep.write(p)
+    doc = load_report(p)
+    back = doc["ops"][0]["timings"]
+    assert back["nruns"] == 0 and back["median_s"] is None
+    assert json.loads(json.dumps(doc)) == doc
+    # a no-runs doc is inert for the regression gate, not a crash
+    from tools import perfdiff
+    assert perfdiff.extract_metrics(doc) == {}
+
+
+def test_load_report_tolerates_v1_to_current(tmp_path):
+    """The schema history is additive: every older vintage loads, and
+    the always-present keys are filled so consumers iterate them
+    unconditionally. Only newer-than-reader rejects."""
+    vintages = {
+        1: {"schema": 1, "name": "v1",
+            "ops": [{"label": "op", "timings": {"median_s": 0.5}}]},
+        2: {"schema": 2, "name": "v2", "ops": [], "metrics": [],
+            "checks": [], "resilience": []},
+        3: {"schema": 3, "name": "v3", "ops": [], "metrics": [],
+            "dagcheck": []},
+        4: {"schema": 4, "name": "v4", "ops": [], "metrics": [],
+            "pipeline": {"sweep.lookahead": 1, "qr.agg_depth": 4}},
+        5: {"schema": 5, "name": "v5", "ops": [], "metrics": [],
+            "roofline": []},
+    }
+    assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
+    for v, doc in vintages.items():
+        p = str(tmp_path / f"v{v}.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        back = load_report(p)
+        assert back["schema"] == v
+        assert isinstance(back["ops"], list)
+        assert isinstance(back["metrics"], list)
+    # a schema-less pre-versioning doc reads as v1
+    p = str(tmp_path / "v0.json")
+    with open(p, "w") as f:
+        json.dump({"name": "ancient"}, f)
+    back = load_report(p)
+    assert back["schema"] == 1 and back["ops"] == []
+    # non-object docs are rejected, not mangled
+    p = str(tmp_path / "list.json")
+    with open(p, "w") as f:
+        json.dump([1, 2], f)
+    with pytest.raises(ValueError):
+        load_report(p)
+
+
+def test_metrics_snapshot_insertion_order_independent():
+    """Two runs recording the same figures in different orders must
+    produce byte-identical metric sections (perfdiff/report diffing
+    depends on it)."""
+    specs = [("runs_total", "counter", {"op": "a", "prec": "d"}, 1),
+             ("runs_total", "counter", {"prec": "s", "op": "b"}, 2),
+             ("gflops_best", "gauge", {"op": "a"}, 3.5),
+             ("run_seconds", "histogram", {"op": "a"}, 0.25)]
+
+    def build(order):
+        reg = MetricsRegistry()
+        for name, kind, labels, val in order:
+            if kind == "counter":
+                reg.counter(name, **labels).inc(val)
+            elif kind == "gauge":
+                reg.gauge(name, **labels).set(val)
+            else:
+                reg.histogram(name, **labels).observe(val)
+        return reg.snapshot()
+
+    fwd, rev = build(specs), build(specs[::-1])
+    assert json.dumps(fwd) == json.dumps(rev)
+    # label kwarg order is immaterial too (sorted label pairs)
+    reg = MetricsRegistry()
+    reg.counter("runs_total", prec="d", op="a").inc()
+    snap = reg.snapshot()
+    assert snap[0]["labels"] == {"op": "a", "prec": "d"}
+    assert json.dumps(snap[0]["labels"]) == \
+        json.dumps(dict(sorted({"prec": "d", "op": "a"}.items())))
 
 
 # --------------------------------------------------------- XLA capture
@@ -273,7 +366,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 4
+    assert doc["schema"] == 5
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

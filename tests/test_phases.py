@@ -1,0 +1,397 @@
+"""Phase-level performance attribution: the scoped phase-timer engine
+(observability.phases), the roofline efficiency ledger
+(observability.roofline), the driver's --phase-profile/--peaks-file
+acceptance path, and the tools/perfdiff.py regression gate."""
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from dplasma_tpu.observability import phases, roofline
+from dplasma_tpu.ops import generators
+from dplasma_tpu.ops import lu as lu_mod
+from tools import perfdiff
+
+
+# -------------------------------------------------------- phase timers
+
+def test_span_noop_when_inactive(monkeypatch):
+    fenced = []
+    monkeypatch.setattr(phases, "_fence", fenced.append)
+    assert phases.active() is None
+    with phases.span("panel") as f:
+        assert f(42) == 42          # identity sink, retains nothing
+    assert not fenced               # no ledger -> no fencing, no timing
+
+
+def test_profiling_scope_fence_and_accumulation(monkeypatch):
+    fenced = []
+    monkeypatch.setattr(phases, "_fence", fenced.append)
+    with phases.profiling() as led:
+        assert phases.active() is led
+        with phases.span("panel") as f:
+            assert f("x") == "x"
+        with phases.span("panel"):
+            pass                    # nothing registered -> no fence
+        with phases.span("far_flush") as f:
+            f("y")
+            f("z")
+    assert phases.active() is None  # restored
+    assert led.phases["panel"]["count"] == 2
+    assert led.phases["far_flush"]["count"] == 1
+    assert led.total() == pytest.approx(
+        sum(e["seconds"] for e in led.phases.values()))
+    assert fenced == [["x"], ["y", "z"]]
+    rows = led.summary()
+    assert {r["phase"] for r in rows} == {"panel", "far_flush"}
+    assert json.loads(json.dumps(rows)) == rows
+
+
+def test_profiling_nests_and_restores():
+    with phases.profiling() as outer:
+        with phases.span("a"):
+            pass
+        with phases.profiling() as inner:
+            with phases.span("b"):
+                pass
+        assert phases.active() is outer
+    assert "b" in inner.phases and "b" not in outer.phases
+    assert "a" in outer.phases
+
+
+def test_sweep_engine_spans_match_phase_model(monkeypatch):
+    """Eager getrf_nopiv under an active ledger emits exactly the
+    span counts the analytic roofline model predicts (the model
+    mirrors pipelined_sweep's control flow), and fences each one."""
+    fences = []
+    monkeypatch.setattr(phases, "_fence", fences.append)
+    A = generators.plghe(128.0, 128, 32, seed=5, dtype=jnp.float32)
+    lu_mod.getrf_nopiv(A, lookahead=1)     # default path: no ledger
+    assert not fences                      # -> never fences
+    with phases.profiling() as led:
+        lu_mod.getrf_nopiv(A, lookahead=1)
+    assert fences                          # profiled path fences
+    model = roofline.phase_model("getrf", 128, 128, 32, 4,
+                                 lookahead=1, agg_depth=1)
+    for name in ("panel", "lookahead", "far_flush", "assemble"):
+        assert led.phases[name]["count"] == model[name][2], name
+
+
+# ------------------------------------------------------------ roofline
+
+def test_expected_seconds_bounds():
+    p = dict(roofline.DEFAULT_PEAKS)
+    s, b, comp = roofline.expected_seconds(flops=1e12, peaks=p)
+    assert b == "mxu"
+    assert s == pytest.approx(1e12 / (p["mxu_gflops"] * 1e9))
+    assert s == comp["mxu"] >= comp["hbm"]
+    assert roofline.expected_seconds(hbm_bytes=1e12, peaks=p)[1] == "hbm"
+    assert roofline.expected_seconds(ici_bytes=1e12, peaks=p)[1] == "ici"
+    assert roofline.expected_seconds(dispatches=100,
+                                     peaks=p)[1] == "latency"
+    # all-zero demands: the tie breaks to the first label, not a crash
+    s0, b0, _ = roofline.expected_seconds(peaks=p)
+    assert s0 == 0.0 and b0 in roofline.BOUNDS
+
+
+def test_resolve_peaks_sources(tmp_path):
+    p, src = roofline.resolve_peaks(None, prec="s")
+    assert p == roofline.DEFAULT_PEAKS and src == "default"
+    # bench doc shape: precision maps to the probed peak
+    bench = {"peaks": {"f32_highest_gflops": 20000.0,
+                       "f64equiv_bound_gflops": 5000.0,
+                       "hbm_gbps": 800.0}}
+    f = tmp_path / "bench.json"
+    f.write_text(json.dumps(bench))
+    p, src = roofline.resolve_peaks(str(f), prec="s")
+    assert p["mxu_gflops"] == 20000.0 and p["hbm_gbps"] == 800.0
+    assert p["ici_gbps"] == roofline.DEFAULT_PEAKS["ici_gbps"]
+    assert src == f"file:{f}"
+    assert roofline.resolve_peaks(str(f), prec="d")[0][
+        "mxu_gflops"] == 5000.0
+    # run-report shape: peaks under extra.peaks
+    g = tmp_path / "report.json"
+    g.write_text(json.dumps(
+        {"schema": 5, "extra": {"peaks": {"mxu_gflops": 123.0}}}))
+    assert roofline.resolve_peaks(str(g))[0]["mxu_gflops"] == 123.0
+    # raw peaks dict
+    h = tmp_path / "raw.json"
+    h.write_text(json.dumps({"mxu_gflops": 7.0, "latency_us": 1.0}))
+    p, _ = roofline.resolve_peaks(str(h))
+    assert p["mxu_gflops"] == 7.0 and p["latency_us"] == 1.0
+    # malformed peaks sections raise ValueError (which the driver's
+    # degrade-to-defaults handler catches), never AttributeError
+    for bad in ({"peaks": [1, 2]}, [1, 2]):
+        j = tmp_path / "bad.json"
+        j.write_text(json.dumps(bad))
+        with pytest.raises(ValueError):
+            roofline.resolve_peaks(str(j))
+
+
+def test_phase_model_flops_invariant_in_pipeline_shape():
+    """The pipeline split moves update work between phases but never
+    creates or loses flops; unmodelled classes return None."""
+    tot = lambda m: sum(v[0] for v in m.values())  # noqa: E731
+    base = roofline.phase_model("getrf", 256, 256, 64, 4,
+                                lookahead=0, agg_depth=1)
+    for la in (1, 2, 3):
+        m = roofline.phase_model("getrf", 256, 256, 64, 4,
+                                 lookahead=la, agg_depth=1)
+        assert tot(m) == pytest.approx(tot(base))
+        assert "lookahead" in m
+    assert "lookahead" not in base and "far_flush" in base
+    qb = roofline.phase_model("geqrf", 256, 256, 64, 4,
+                              lookahead=1, agg_depth=1)
+    qa = roofline.phase_model("geqrf", 256, 256, 64, 4,
+                              lookahead=1, agg_depth=4)
+    # aggregation reduces far-flush dispatches, not panel count
+    assert qa["panel"][2] == qb["panel"][2]
+    assert qa.get("far_flush", [0, 0, 0])[2] <= qb["far_flush"][2]
+    assert roofline.phase_model("potrf", 128, 128, 32, 8,
+                                lookahead=1)["panel"][2] == 4
+    assert roofline.phase_model("gemm", 256, 256, 64, 4) is None
+    assert roofline.phase_model(None, 256, 256, 64, 4) is None
+
+
+def test_attribute_phases_and_op_roofline():
+    led = phases.PhaseLedger()
+    led.add("panel", 0.5)
+    led.add("mystery", 0.1)
+    model = {"panel": [1e9, 1e6, 1]}
+    spans = roofline.attribute_phases(led, model,
+                                      dict(roofline.DEFAULT_PEAKS))
+    by = {s["phase"]: s for s in spans}
+    assert by["panel"]["expected_s"] > 0
+    assert by["panel"]["achieved_frac"] == pytest.approx(
+        by["panel"]["expected_s"] / 0.5)
+    assert by["panel"]["bound"] in roofline.BOUNDS
+    # unknown phases still get a (latency) bound, never a crash
+    assert by["mystery"]["bound"] == "latency"
+    comm = {"dag_model": {"bytes_total": 1e9}, "spmd_model": None}
+    rl = roofline.op_roofline("testing_dgetrf", "getrf", 512, 512, 1,
+                              8, 1e9, comm, measured_s=1.0,
+                              peaks=dict(roofline.DEFAULT_PEAKS))
+    assert rl["bound"] in roofline.BOUNDS
+    assert rl["components_s"]["ici"] == pytest.approx(
+        1e9 / (roofline.DEFAULT_PEAKS["ici_gbps"] * 1e9))
+    assert 0 < rl["achieved_frac"] <= 1.0 or rl["expected_s"] > 1.0
+    assert json.loads(json.dumps(rl)) == rl
+
+
+# ----------------------------------------- driver acceptance (e2e CPU)
+
+def _phase_run(tmp_path, prog, extra=()):
+    from dplasma_tpu.drivers import main
+    rj = str(tmp_path / "r.json")
+    rc = main(["-N", "96", "-t", "32", "--phase-profile",
+               f"--report={rj}", "-v=2", *extra], prog=prog)
+    assert rc == 0
+    return json.load(open(rj))
+
+
+@pytest.mark.parametrize("prog", ["testing_dgetrf", "testing_dgeqrf"])
+def test_driver_phase_profile_acceptance(tmp_path, capsys, prog):
+    """The ISSUE acceptance: with --phase-profile a dgetrf/dgeqrf
+    run-report carries per-phase {measured_s, expected_s,
+    achieved_frac, bound} summing (within fencing/out-of-span
+    overhead) to the attributed run time."""
+    doc = _phase_run(tmp_path, prog)
+    out = capsys.readouterr().out
+    assert doc["schema"] == 5
+    (op,) = doc["ops"]
+    ph = op["phases"]
+    spans = ph["spans"]
+    assert spans
+    names = {s["phase"] for s in spans}
+    assert "panel" in names
+    for s in spans:
+        assert {"phase", "count", "measured_s", "expected_s",
+                "achieved_frac", "bound"} <= set(s)
+        assert s["bound"] in ("mxu", "hbm", "ici", "latency")
+        assert s["measured_s"] > 0 and s["expected_s"] >= 0
+    assert ph["sum_s"] == pytest.approx(
+        sum(s["measured_s"] for s in spans))
+    # phases sum to the attributed run time, modulo the out-of-span
+    # harness work (slicing, sync) and fencing overhead
+    assert ph["sum_s"] <= ph["attributed_run_s"]
+    assert ph["coverage"] == pytest.approx(
+        ph["sum_s"] / ph["attributed_run_s"])
+    assert ph["coverage"] > 0.25
+    # whole-op roofline entry rides along
+    (rl,) = doc["roofline"]
+    assert rl["op"] == prog and rl["bound"] in roofline.BOUNDS
+    assert rl["measured_s"] > 0 and rl["achieved_frac"] is not None
+    # per-phase table + roofline line print at -v>=2
+    assert f"#+ phases[{prog}]" in out and f"#+ roofline[{prog}]" in out
+    # metrics carry the attribution too
+    assert any(m["name"] == "phase_seconds" for m in doc["metrics"])
+    assert any(m["name"] == "roofline_achieved_frac"
+               for m in doc["metrics"])
+
+
+def test_driver_phase_profile_off_no_fencing(tmp_path, monkeypatch):
+    """With the flag off the default path never fences (fusion/overlap
+    untouched) and the op entry carries an explicit phases null."""
+    fences = []
+    monkeypatch.setattr(phases, "_fence", fences.append)
+    from dplasma_tpu.drivers import main
+    rj = str(tmp_path / "r.json")
+    rc = main(["-N", "96", "-t", "32", f"--report={rj}", "--nruns",
+               "2"], prog="testing_dgetrf")
+    assert rc == 0 and not fences
+    doc = json.load(open(rj))
+    (op,) = doc["ops"]
+    assert op["phases"] is None
+    assert op["timings"]["nruns"] == 2
+    assert op["timings"]["best_s"] > 0
+    # the roofline ledger still prices the op (it needs no fencing)
+    (rl,) = doc["roofline"]
+    assert rl["peaks_source"] == "default"
+
+
+def test_driver_peaks_file(tmp_path, capsys):
+    peaks = tmp_path / "peaks.json"
+    peaks.write_text(json.dumps({"mxu_gflops": 1e6, "hbm_gbps": 1e5,
+                                 "latency_us": 0.001}))
+    doc = _phase_run(tmp_path, "testing_dgetrf",
+                     extra=[f"--peaks-file={peaks}"])
+    (rl,) = doc["roofline"]
+    assert rl["peaks"]["mxu_gflops"] == 1e6
+    assert rl["peaks_source"].startswith("file:")
+    # absurdly fast peaks -> tiny expectations -> tiny achieved_frac
+    assert rl["achieved_frac"] < 1.0
+
+
+def test_driver_peaks_file_unreadable_degrades(tmp_path, capsys):
+    doc = _phase_run(tmp_path, "testing_dgetrf",
+                     extra=["--peaks-file=/nonexistent/peaks.json"])
+    (rl,) = doc["roofline"]
+    assert rl["peaks_source"] == "default"   # warned, not failed
+    assert doc["ops"][0]["phases"] is not None
+    # a malformed (non-dict) peaks section degrades the same way
+    bad = tmp_path / "bad_peaks.json"
+    bad.write_text(json.dumps({"peaks": [1, 2]}))
+    doc = _phase_run(tmp_path, "testing_dgetrf",
+                     extra=[f"--peaks-file={bad}"])
+    assert doc["roofline"][0]["peaks_source"] == "default"
+
+
+# ------------------------------------------------------------ perfdiff
+
+def _report_doc(median=0.010, best=0.009, gflops=100.0,
+                label="testing_dgetrf"):
+    return {"schema": 5, "name": label,
+            "ops": [{"label": label, "prec": "d", "gflops": gflops,
+                     "timings": {"nruns": 3, "median_s": median,
+                                 "best_s": best}}],
+            "metrics": []}
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_perfdiff_self_compare_exits_zero(tmp_path, capsys):
+    a = _write(tmp_path, "a.json", _report_doc())
+    assert perfdiff.main([a, a]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_perfdiff_regression_named_nonzero(tmp_path, capsys):
+    a = _write(tmp_path, "a.json", _report_doc(median=0.010))
+    b = _write(tmp_path, "b.json", _report_doc(median=0.015))
+    assert perfdiff.main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out
+    assert "testing_dgetrf.median_s" in out
+    assert "worst offender" in out
+
+
+def test_perfdiff_improvement_and_threshold(tmp_path, capsys):
+    a = _write(tmp_path, "a.json", _report_doc(median=0.010))
+    b = _write(tmp_path, "b.json", _report_doc(median=0.006,
+                                               best=0.005,
+                                               gflops=150.0))
+    assert perfdiff.main([a, b]) == 0            # faster is fine
+    c = _write(tmp_path, "c.json", _report_doc(median=0.012))
+    assert perfdiff.main([a, c]) == 1            # +20% > default 10%
+    capsys.readouterr()
+    assert perfdiff.main([a, c, "--threshold", "0.5"]) == 0
+    # per-metric override: only median_s is relaxed
+    assert perfdiff.main([a, c, "--metric-threshold",
+                          "median_s=0.5"]) == 0
+
+
+def test_perfdiff_gflops_drop_is_regression(tmp_path, capsys):
+    a = _write(tmp_path, "a.json", _report_doc())
+    b = _write(tmp_path, "b.json",
+               _report_doc(median=0.010, best=0.009, gflops=50.0))
+    assert perfdiff.main([a, b]) == 1
+    assert "testing_dgetrf.gflops" in capsys.readouterr().out
+
+
+def test_perfdiff_bench_ledger_newest_entry(tmp_path, capsys):
+    bench_old = {"metric": "x", "ladder": [
+        {"metric": "spotrf_gflops_n2048", "value": 100.0,
+         "unit": "GFlop/s", "vs_baseline": 1.0}]}
+    bench_new = {"metric": "x", "ladder": [
+        {"metric": "spotrf_gflops_n2048", "value": 200.0,
+         "unit": "GFlop/s", "vs_baseline": 2.0}]}
+    ledger = tmp_path / "bench_history.jsonl"
+    perfdiff.append_ledger(str(ledger), bench_old)
+    perfdiff.append_ledger(str(ledger), bench_new)
+    assert perfdiff.latest_ledger_entry(str(ledger)) == bench_new
+    # candidate regressed vs the NEWEST entry (200 -> 120 = -40%)
+    cand = _write(tmp_path, "cand.json", {"metric": "x", "ladder": [
+        {"metric": "spotrf_gflops_n2048", "value": 120.0,
+         "unit": "GFlop/s", "vs_baseline": 1.2}]})
+    assert perfdiff.main([str(ledger), cand]) == 1
+    assert "spotrf_gflops_n2048" in capsys.readouterr().out
+
+
+def test_perfdiff_reports_vanished_baseline_metrics(tmp_path, capsys):
+    """An op that regressed into failure records no timing at all —
+    its baseline metrics must be surfaced as absent, not silently
+    dropped from the comparison."""
+    old = _report_doc()
+    old["ops"].append({"label": "testing_dpotrf", "prec": "d",
+                       "gflops": 50.0,
+                       "timings": {"nruns": 1, "median_s": 0.02,
+                                   "best_s": 0.02}})
+    new = _report_doc()                      # dpotrf vanished
+    res = perfdiff.compare(old, new)
+    assert res["missing"] == ["testing_dpotrf.best_s",
+                              "testing_dpotrf.gflops",
+                              "testing_dpotrf.median_s"]
+    a = _write(tmp_path, "a.json", old)
+    b = _write(tmp_path, "b.json", new)
+    perfdiff.main([a, b])
+    out = capsys.readouterr().out
+    assert "absent from candidate" in out
+    assert "testing_dpotrf.median_s" in out
+
+
+def test_perfdiff_unusable_inputs(tmp_path, capsys):
+    a = _write(tmp_path, "a.json", _report_doc())
+    other = _write(tmp_path, "o.json", _report_doc(label="elsewhere"))
+    assert perfdiff.main([a, other]) == 2        # nothing comparable
+    assert perfdiff.main([a, str(tmp_path / "missing.json")]) == 2
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert perfdiff.main([str(empty), a]) == 2
+    assert perfdiff.main([a, a, "--metric-threshold", "oops"]) == 2
+
+
+def test_perfdiff_compare_api_old_schema_docs():
+    """v1-vintage docs (no nruns, no phases) compare fine — the
+    extractor only touches always-present keys."""
+    old = {"schema": 1, "ops": [{"label": "op",
+                                 "timings": {"median_s": 1.0}}]}
+    new = {"schema": 5, "ops": [{"label": "op",
+                                 "timings": {"nruns": 1,
+                                             "median_s": 2.0}}]}
+    res = perfdiff.compare(old, new)
+    assert not res["ok"] and res["worst"]["metric"] == "op.median_s"
+    assert res["worst"]["regression"] == pytest.approx(1.0)

@@ -1,0 +1,102 @@
+"""tools/tracecat.py: DTPUPROF1 -> Perfetto (Chrome trace-event)
+conversion — multi-rank/track lane round-trips, the --info and --lax
+CLI modes, and torn-tail behavior."""
+import json
+
+import pytest
+
+from dplasma_tpu.utils import profiling
+from tools import tracecat
+
+
+def _write_profile(path, rank, tracks=(0, 1, 2), spans_per_track=2):
+    prof = profiling.Profile(rank=rank)
+    prof.save_info("SCHED", "wavefront")
+    n = 0
+    for tr in tracks:
+        for i in range(spans_per_track):
+            prof.add_event(f"t{tr}:span{i}", 1000 * n, 1000 * n + 500,
+                           flops=float(n), track=tr)
+            n += 1
+    prof.write(str(path))
+    return n
+
+
+def test_convert_multitrack_lane_names(tmp_path):
+    src = tmp_path / "multi.prof"
+    n = _write_profile(src, rank=3)
+    doc = tracecat.convert(str(src))
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == n
+    assert {e["pid"] for e in spans} == {3}          # rank -> pid
+    assert {e["tid"] for e in spans} == {0, 1, 2}    # track -> tid
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    lanes = {e["args"]["name"] for e in meta
+             if e["name"] == "thread_name"}
+    assert lanes == {"track 0", "track 1", "track 2"}
+    procs = [e["args"]["name"] for e in meta
+             if e["name"] == "process_name"]
+    assert procs == ["multi.prof rank 3"]
+    assert doc["otherData"]["SCHED"] == "wavefront"
+    assert json.loads(json.dumps(doc)) == doc
+
+
+def test_convert_multirank_distinct_pids(tmp_path):
+    """One profile per rank (the SPMD story): each converts onto its
+    own (pid, tid) grid so Perfetto shows per-rank process lanes."""
+    pids = set()
+    counts = []
+    for rank in (0, 5):
+        src = tmp_path / f"r{rank}.prof"
+        counts.append(_write_profile(src, rank=rank, tracks=(0, 1)))
+        doc = tracecat.convert(str(src))
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(spans) == counts[-1]
+        (pid,) = {e["pid"] for e in spans}
+        pids.add(pid)
+    assert pids == {0, 5}
+
+
+def test_cli_output_and_info_modes(tmp_path, capsys):
+    src = tmp_path / "x.prof"
+    n = _write_profile(src, rank=1, tracks=(0, 2))
+    out = tmp_path / "x.trace.json"
+    assert tracecat.main([str(src), "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == n and {e["tid"] for e in spans} == {0, 2}
+    capsys.readouterr()
+    assert tracecat.main([str(src), "--info"]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["SCHED"] == "wavefront" and info["rank"] == "1"
+
+
+def test_cli_torn_tail_strict_vs_lax(tmp_path, capsys):
+    src = tmp_path / "torn.prof"
+    n = _write_profile(src, rank=0, tracks=(0, 1))
+    raw = src.read_bytes()
+    torn = tmp_path / "cut.prof"
+    torn.write_bytes(raw[:-4])          # cut mid-record
+    assert tracecat.main([str(torn)]) == 1          # strict: refuse
+    assert "truncated" in capsys.readouterr().err
+    assert tracecat.main([str(torn), "--lax"]) == 0  # lax: salvage
+    doc = json.loads(capsys.readouterr().out)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == n - 1          # everything before the tear
+    # track lanes of the surviving spans still decode
+    assert {e["tid"] for e in spans} <= {0, 1}
+
+
+def test_profile_load_tracks_roundtrip(tmp_path):
+    """Profile.load and tracecat.convert share decode_wire_events —
+    the lanes a Profile writes are the lanes both readers recover."""
+    src = tmp_path / "rt.prof"
+    _write_profile(src, rank=2, tracks=(0, 7), spans_per_track=1)
+    prof = profiling.Profile.load(str(src))
+    assert prof.rank == 2
+    assert sorted(e[4] for e in prof.events) == [0, 7]
+    doc = tracecat.convert(str(src))
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert sorted(e["tid"] for e in spans) == [0, 7]
+    with pytest.raises(Exception):
+        tracecat.convert(str(tmp_path / "nope.prof"))

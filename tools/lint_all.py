@@ -1,14 +1,18 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Three passes, in increasing cost order:
+Four passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
 2. ``dplasma_tpu.analysis.jaxlint`` — the JAX/TPU trace-safety rules
    (tracer concretization, mutable defaults, numpy-in-jit, float64
    literals, kernel nondeterminism);
-3. a ``dplasma_tpu.analysis.dagcheck`` smoke pass — the analytic tile
+3. a ``tools/perfdiff.py`` smoke pass — a report self-compare must
+   exit 0 and a synthetically regressed report must exit nonzero with
+   the offending metric named (the CI regression gate must itself be
+   gated);
+4. a ``dplasma_tpu.analysis.dagcheck`` smoke pass — the analytic tile
    DAGs of all four ops (potrf/lu/qr/gemm) at 3x3 tiles on 1x1 and
    2x2 grids must verify clean, with the comm-model reconciliation
    exact for the owner-computes classes.
@@ -41,6 +45,52 @@ def run_jaxlint(pkg: pathlib.Path) -> int:
     for path, line, code, msg in bad:
         sys.stderr.write(f"{path}:{line}: {code} {msg}\n")
     return len(bad)
+
+
+def run_perfdiff_smoke() -> int:
+    """The regression gate, gated: self-compare exits 0; a doubled
+    median / halved GFlop/s must exit nonzero and name the metric."""
+    import contextlib
+    import copy
+    import io
+    import json
+    import tempfile
+
+    import perfdiff
+
+    base = {"schema": 5, "name": "perfdiff-smoke",
+            "ops": [{"label": "testing_dpotrf", "prec": "d",
+                     "gflops": 100.0,
+                     "timings": {"nruns": 3, "median_s": 0.010,
+                                 "best_s": 0.009}}],
+            "metrics": []}
+    worse = copy.deepcopy(base)
+    worse["ops"][0]["timings"]["median_s"] = 0.020
+    worse["ops"][0]["gflops"] = 45.0
+    bad = 0
+    with tempfile.TemporaryDirectory() as td:
+        pa = f"{td}/base.json"
+        pb = f"{td}/worse.json"
+        for p, doc in ((pa, base), (pb, worse)):
+            with open(p, "w") as f:
+                json.dump(doc, f)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc_same = perfdiff.main([pa, pa])
+            rc_reg = perfdiff.main([pa, pb])
+        if rc_same != 0:
+            sys.stderr.write(
+                f"perfdiff-smoke: self-compare exited {rc_same}\n")
+            bad += 1
+        if rc_reg == 0:
+            sys.stderr.write(
+                "perfdiff-smoke: regressed report exited 0\n")
+            bad += 1
+        if "testing_dpotrf.median_s" not in buf.getvalue():
+            sys.stderr.write("perfdiff-smoke: regressed metric not "
+                             "named in the diagnostic\n")
+            bad += 1
+    return bad
 
 
 def run_dagcheck_smoke() -> int:
@@ -102,6 +152,7 @@ def main(argv=None) -> int:
     bad = 0
     for name, fn in (("lint_excepts", lambda: run_excepts(pkg)),
                      ("jaxlint", lambda: run_jaxlint(pkg)),
+                     ("perfdiff-smoke", run_perfdiff_smoke),
                      ("dagcheck-smoke", run_dagcheck_smoke)):
         n = fn()
         print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")

@@ -1,0 +1,223 @@
+#!/usr/bin/env python3
+"""perfdiff: cross-run performance regression gate.
+
+Compares two performance documents — versioned JSON run-reports
+(``--report`` from any driver, any schema vintage v1-v5), the bench
+one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
+entry is used) — metric by metric, with per-metric relative
+thresholds. A regression beyond threshold names the offending metric
+(worst offender highlighted) and exits nonzero, so CI can gate on it::
+
+    python tools/perfdiff.py old.json new.json
+    python tools/perfdiff.py bench_history.jsonl report.json
+    python tools/perfdiff.py old.json new.json --threshold 0.05 \\
+        --metric-threshold testing_dgetrf.median_s=0.25
+
+Comparable metrics extracted from each document:
+
+* per-op timing medians/bests (``<label>.median_s``/``.best_s``,
+  lower is better) and achieved ``<label>.gflops`` (higher is
+  better) from a run-report's ``ops`` section;
+* bench ladder entries (``<metric>`` GFlop/s values, higher is
+  better) from ``entries``/``ladder``.
+
+Exit codes: 0 = no regression, 1 = regression past threshold,
+2 = unusable input / nothing comparable.
+
+Standalone by design: stdlib-only (no jax import), so the gate runs
+anywhere — including the repo lint aggregate (``tools/lint_all.py``)
+and ``bench.py --gate``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional
+
+DEFAULT_THRESHOLD = 0.10   # 10% relative regression
+
+
+# ------------------------------------------------------------- loading
+
+def latest_ledger_entry(path: str) -> Optional[dict]:
+    """Newest (last non-empty line) entry of a .jsonl ledger."""
+    last = None
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                last = line
+    return json.loads(last) if last else None
+
+
+def append_ledger(path: str, doc: dict) -> None:
+    """Append one document to a .jsonl ledger (one line, flushed)."""
+    with open(path, "a") as f:
+        f.write(json.dumps(doc) + "\n")
+        f.flush()
+
+
+def load_doc(path: str) -> dict:
+    """A run-report / bench JSON doc, or the newest entry of a
+    ``.jsonl`` ledger. Tolerates every run-report vintage (the schema
+    history is additive; absent sections read as empty)."""
+    if path.endswith(".jsonl"):
+        doc = latest_ledger_entry(path)
+        if doc is None:
+            raise ValueError(f"{path}: empty ledger")
+        return doc
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    return doc
+
+
+# ---------------------------------------------------------- extraction
+
+def extract_metrics(doc: dict) -> Dict[str, dict]:
+    """Comparable metrics of one document:
+    ``{name: {"value": v, "better": "lower"|"higher"}}``."""
+    out: Dict[str, dict] = {}
+    for op in doc.get("ops") or []:
+        lbl = op.get("label")
+        if not lbl:
+            continue
+        t = op.get("timings") or {}
+        for key in ("median_s", "best_s"):
+            v = t.get(key)
+            if isinstance(v, (int, float)):
+                out[f"{lbl}.{key}"] = {"value": float(v),
+                                       "better": "lower"}
+        g = op.get("gflops")
+        if isinstance(g, (int, float)) and g > 0:
+            out[f"{lbl}.gflops"] = {"value": float(g),
+                                    "better": "higher"}
+    for e in (doc.get("entries") or []) + (doc.get("ladder") or []):
+        if isinstance(e, dict) and isinstance(e.get("metric"), str) \
+                and isinstance(e.get("value"), (int, float)):
+            out[e["metric"]] = {"value": float(e["value"]),
+                                "better": "higher"}
+    return out
+
+
+# ---------------------------------------------------------- comparison
+
+def compare(old_doc: dict, new_doc: dict,
+            threshold: float = DEFAULT_THRESHOLD,
+            per_metric: Optional[Dict[str, float]] = None) -> dict:
+    """Compare every metric present in both documents.
+
+    The per-metric regression ratio is positive-when-worse regardless
+    of direction: ``(new-old)/old`` for lower-is-better timings,
+    ``(old-new)/old`` for higher-is-better rates. ``per_metric`` maps
+    a full metric name (or its bare suffix, e.g. ``median_s``) to a
+    custom threshold. Returns ``{"ok", "compared", "rows",
+    "regressions", "worst"}`` with rows sorted worst-first.
+    """
+    per_metric = per_metric or {}
+    old_m, new_m = extract_metrics(old_doc), extract_metrics(new_doc)
+    rows = []
+    for name in sorted(set(old_m) & set(new_m)):
+        ov, nv = old_m[name]["value"], new_m[name]["value"]
+        if ov <= 0:
+            continue
+        better = new_m[name]["better"]
+        ratio = (nv - ov) / ov if better == "lower" else (ov - nv) / ov
+        th = per_metric.get(
+            name, per_metric.get(name.rsplit(".", 1)[-1], threshold))
+        rows.append({"metric": name, "old": ov, "new": nv,
+                     "better": better, "regression": ratio,
+                     "threshold": th, "worse": ratio > th})
+    rows.sort(key=lambda r: -r["regression"])
+    regs = [r for r in rows if r["worse"]]
+    # baseline metrics with no candidate counterpart: an op that
+    # regressed into failure records no timing at all — surface the
+    # disappearance instead of silently shrinking the comparison
+    missing = sorted(set(old_m) - set(new_m))
+    return {"ok": not regs, "compared": len(rows), "rows": rows,
+            "regressions": regs, "worst": regs[0] if regs else None,
+            "missing": missing}
+
+
+def format_result(res: dict, verbose: bool = False) -> list:
+    """Human lines: every regression (worst first), the worst offender
+    named, one summary line; ``verbose`` adds all compared rows."""
+    lines = []
+    shown = res["rows"] if verbose else res["regressions"]
+    for r in shown:
+        tag = "REGRESSION" if r["worse"] else "ok        "
+        lines.append(
+            "perfdiff: %s %s %.6g -> %.6g (%+.1f%% %s, threshold "
+            "%.1f%%)" % (tag, r["metric"], r["old"], r["new"],
+                         100.0 * r["regression"],
+                         "worse" if r["regression"] > 0 else "change",
+                         100.0 * r["threshold"]))
+    if res["worst"] is not None:
+        lines.append("perfdiff: worst offender: %s (%+.1f%%)"
+                     % (res["worst"]["metric"],
+                        100.0 * res["worst"]["regression"]))
+    missing = res.get("missing") or []
+    if missing:
+        shown = ", ".join(missing[:5])
+        if len(missing) > 5:
+            shown += ", ..."
+        lines.append("perfdiff: note: %d baseline metric(s) absent "
+                     "from candidate: %s" % (len(missing), shown))
+    if res["compared"] == 0:
+        lines.append("perfdiff: no common metrics to compare")
+    elif res["ok"]:
+        lines.append("perfdiff: OK (%d metric(s) within threshold)"
+                     % res["compared"])
+    else:
+        lines.append("perfdiff: %d regression(s) over %d metric(s)"
+                     % (len(res["regressions"]), res["compared"]))
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perfdiff", description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline: run-report/bench JSON, or "
+                                ".jsonl ledger (newest entry)")
+    ap.add_argument("new", help="candidate: run-report/bench JSON, or "
+                                ".jsonl ledger (newest entry)")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD,
+                    help="relative regression threshold "
+                         f"(default {DEFAULT_THRESHOLD})")
+    ap.add_argument("--metric-threshold", action="append", default=[],
+                    metavar="NAME=FRAC",
+                    help="per-metric threshold override (full name or "
+                         "bare suffix, e.g. median_s=0.25); repeatable")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print every compared metric, not just "
+                         "regressions")
+    ns = ap.parse_args(argv)
+    per = {}
+    for spec in ns.metric_threshold:
+        name, eq, val = spec.partition("=")
+        if not eq:
+            sys.stderr.write(f"perfdiff: bad --metric-threshold "
+                             f"{spec!r} (want NAME=FRAC)\n")
+            return 2
+        try:
+            per[name] = float(val)
+        except ValueError:
+            sys.stderr.write(f"perfdiff: bad threshold in {spec!r}\n")
+            return 2
+    try:
+        old_doc, new_doc = load_doc(ns.old), load_doc(ns.new)
+    except (OSError, ValueError) as exc:
+        sys.stderr.write(f"perfdiff: {exc}\n")
+        return 2
+    res = compare(old_doc, new_doc, ns.threshold, per)
+    for line in format_result(res, verbose=ns.verbose):
+        print(line)
+    if res["compared"] == 0:
+        return 2
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
