@@ -17,12 +17,33 @@ wrongness can enter:
   values inside jitted bodies, tracer tests only via
   :func:`dplasma_tpu.utils.is_concrete`, no mutable defaults, no
   numpy on traced values in jit, no bare ``jnp.float64`` outside the
-  dd-emulation modules, no nondeterminism in kernels).
+  dd-emulation modules, no nondeterminism in kernels, no hard-coded
+  mesh axis-name literals outside :mod:`dplasma_tpu.parallel.mesh`).
+* :mod:`.spmdcheck` — the SPMD collective-schedule verifier for the
+  shard_map execution surface: axis binding, per-rank sequence
+  uniformity (deadlock freedom), ppermute bijections, collective
+  counts reconciled against the analytic comm model, plus the
+  abstract ring-schedule simulator future ICI-ring kernels must
+  pass. Driven by ``--spmdcheck`` and ``tools/lint_all.py``.
+* :mod:`.palcheck` — the Pallas kernel contract checker: every
+  ``pl.pallas_call`` site's BlockSpec divisibility and tiling, index-
+  map grid coverage, VMEM budget, and precision contract, captured
+  without executing a kernel. Driven by ``tools/lint_all.py``.
 """
 from dplasma_tpu.analysis.dagcheck import (DagCheckError, check_dag,
                                            rank_of_dist)
 from dplasma_tpu.analysis.jaxlint import lint_file as jaxlint_file
 from dplasma_tpu.analysis.jaxlint import lint_tree as jaxlint_tree
+from dplasma_tpu.analysis.palcheck import (PalCheckError,
+                                           check_contract,
+                                           check_package)
+from dplasma_tpu.analysis.spmdcheck import (SpmdCheckError,
+                                            check_kernel, check_ring,
+                                            extract_schedule,
+                                            simulate_ring)
 
 __all__ = ["DagCheckError", "check_dag", "rank_of_dist",
-           "jaxlint_file", "jaxlint_tree"]
+           "jaxlint_file", "jaxlint_tree",
+           "SpmdCheckError", "check_kernel", "check_ring",
+           "extract_schedule", "simulate_ring",
+           "PalCheckError", "check_contract", "check_package"]

@@ -30,6 +30,13 @@ Rules:
   from a traced parameter inside a jit/shard_map body (the branch is
   resolved at trace time — recompilation hazard or wrong side baked
   in).
+* **J008 hard-coded-axis-name** — the mesh axis names ``'p'``/``'q'``
+  as string literals in collective calls (``psum``/``all_gather``/
+  ``ppermute``/``axis_index``/...), ``PartitionSpec``, or ``Mesh``
+  construction outside :mod:`dplasma_tpu.parallel.mesh`. Axis names
+  must route through ``pmesh.ROW_AXIS``/``pmesh.COL_AXIS`` — the lint
+  companion to spmdcheck's axis-binding check (a renamed mesh axis
+  must break at the one definition site, not desynchronize silently).
 
 Traced-ness is a static approximation: the parameters of a
 jit/shard_map-decorated function (minus ``static_argnums`` /
@@ -64,6 +71,19 @@ FLOAT64_ALLOWLIST = {"dplasma_tpu/kernels/dd.py",
 
 #: modules that must stay deterministic/replayable
 KERNEL_DIRS = ("dplasma_tpu/kernels",)
+
+#: the one module allowed to spell the mesh axis names as literals
+AXIS_NAME_ALLOWLIST = {"dplasma_tpu/parallel/mesh.py"}
+
+#: the mesh axis-name literals J008 polices (parallel/mesh.py owns them)
+_AXIS_LITERALS = {"p", "q"}
+
+#: callables whose string arguments name mesh axes
+_AXIS_CALLEES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                 "ppermute", "all_to_all", "axis_index",
+                 "reduce_scatter", "pshuffle", "axis_size",
+                 "PartitionSpec", "Mesh", "make_mesh",
+                 "NamedSharding"}
 
 _SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*ok(?:=(\w+))?")
 
@@ -261,6 +281,24 @@ def lint_source(src: str, rel: str) -> List[Violation]:
                                 "no native f64; route through "
                                 "kernels.dd or compare dtypes "
                                 "instead)"))
+        # J008: hard-coded mesh axis-name literals in collective /
+        # sharding calls outside parallel/mesh.py
+        if isinstance(node, ast.Call) and \
+                rel not in AXIS_NAME_ALLOWLIST:
+            callee = _dotted(node.func).rsplit(".", 1)[-1]
+            if callee in _AXIS_CALLEES:
+                for a in list(node.args) + \
+                        [k.value for k in node.keywords]:
+                    for sub in ast.walk(a):
+                        if isinstance(sub, ast.Constant) and \
+                                sub.value in _AXIS_LITERALS:
+                            out.append((sub.lineno, "J008",
+                                        f"hard-coded mesh axis name "
+                                        f"{sub.value!r} in "
+                                        f"{callee}() — route through "
+                                        f"parallel.mesh.ROW_AXIS/"
+                                        f"COL_AXIS (the mesh owns "
+                                        f"its axis names)"))
         # J006: nondeterminism in kernels
         if in_kernels:
             if isinstance(node, ast.Import):

@@ -89,6 +89,7 @@ class IParam:
     thread_multi: bool = False
     dot: Optional[str] = None
     dagcheck: bool = False           # static dataflow verification
+    spmdcheck: bool = False          # SPMD collective-schedule check
     # observability outputs (--profile/--report/--jaxtrace)
     profile: Optional[str] = None    # DTPUPROF1 binary trace
     report: Optional[str] = None     # versioned JSON run-report
@@ -152,6 +153,15 @@ Optional arguments:
                      coverage, WAW/WAR races, owner-computes ranks,
                      comm-model reconciliation); violations abort the
                      run and the result lands in the run-report (v3)
+ --spmdcheck       : verify the traced SPMD program's collective
+                     schedule before the timed loop (every collective
+                     axis bound by its shard_map mesh, per-rank
+                     sequence uniform — no collectives behind rank-
+                     divergent cond/while, every ppermute a
+                     bijection); violations abort the run and the
+                     summary lands in the run-report (v6). The cyclic
+                     kernels' exact collective-count contract is
+                     additionally enforced by tools/lint_all.py
  --profile[=file]  : write the binary DTPUPROF1 run trace (convert with
                      tools/tracecat.py; default file: run.prof)
  --report[=file]   : write the versioned JSON run-report (timings,
@@ -221,6 +231,7 @@ _LONG = {
     "ht": ("_ht", _int),
     "abft": ("abft", None), "inject": ("inject", str),
     "dagcheck": ("dagcheck", None),
+    "spmdcheck": ("spmdcheck", None),
     "phase-profile": ("phase_profile", None),
     "peaks-file": ("peaks_file", str),
     "max-retries": ("max_retries", _int),
@@ -517,6 +528,46 @@ class Driver:
             raise dc.DagCheckError(res)
         return res
 
+    def _spmdcheck(self, fn, args, name):
+        """--spmdcheck: extract the collective schedule of the program
+        about to run (jaxpr-level, no execution) and verify the
+        structural SPMD invariants — axis binding, per-rank sequence
+        uniformity (no collectives behind rank-divergent cond/while),
+        ppermute bijections. The summary (collective counts included)
+        lands in the run-report (schema v6 ``"spmdcheck"`` section);
+        violations raise SpmdCheckError before the timed loop. The
+        exact collective-count contract against the analytic comm
+        model is enforced where the kernel identity is known — the
+        cyclic kernels, via tools/lint_all.py and tests — because a
+        driver body may legitimately wrap them in conversions. A
+        GSPMD-partitioned op (no explicit shard_map) reports
+        no-collectives: its schedule belongs to XLA, not this gate."""
+        from dplasma_tpu.analysis import spmdcheck as sp
+        ip = self.ip
+        try:
+            res = sp.extract_schedule(fn, *args, kernel=name)
+        except Exception as exc:
+            # verification tracing must never break a run the real
+            # compile path accepts (e.g. a fallback-only dtype)
+            sys.stderr.write(
+                f"#! spmdcheck trace failed for {name}: {exc!r}\n")
+            return None
+        res.relation = ("no-collectives" if not res.collectives
+                        else "structural")
+        self.report.add_spmdcheck(name, res.summary())
+        lbl = dict(op=name, prec=ip.prec)
+        reg = self.report.metrics
+        reg.counter("spmdcheck_collectives_total", **lbl).inc(
+            sum(c.count for c in res.collectives))
+        reg.counter("spmdcheck_diagnostics_total", **lbl).inc(
+            len(res.diagnostics))
+        if ip.rank == 0 and (ip.loud >= 2 or not res.ok):
+            print(res.format(name))
+            sys.stdout.flush()
+        if not res.ok:
+            raise sp.SpmdCheckError(res)
+        return res
+
     def _peaks(self):
         """Resolve the roofline peaks once per driver run
         (``--peaks-file`` — a bench doc/report or raw peaks dict —
@@ -723,6 +774,10 @@ class Driver:
                 elif ip.dagcheck and ip.rank == 0 and ip.loud >= 1:
                     print(f"#+ dagcheck[{name}]: no analytic tile-DAG "
                           f"builder for this op; skipped")
+                if getattr(ip, "spmdcheck", False):
+                    # verify the traced SPMD program's collective
+                    # schedule before the timed loop ever dispatches
+                    self._spmdcheck(cur_fn, args, name)
                 if not want_dag and ip.dot:
                     # no analytic tile-DAG builder for this op: fall
                     # back to the lowered XLA program text

@@ -189,7 +189,9 @@ def comm_volume_model(op: str, M: int, N: int, K: int, mb: int, nb: int,
         desc = CyclicDesc(M, N, mb, nb,
                           Dist(dist.P, dist.Q, dist.kp, dist.kq,
                                dist.ip, dist.jq))
-        out["spmd_model"] = spmd_comm_model(desc, cls, itemsize)
+        out["spmd_model"] = spmd_comm_model(
+            desc, cls, itemsize,
+            kt=KTg if cls == "gemm" else None)
     except KeyError:
         pass
     return out

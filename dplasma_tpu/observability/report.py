@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 5``).
+"""The versioned JSON run-report (``"schema": 6``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -46,6 +46,11 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
      "roofline": [{"op", "op_class", "expected_s", "measured_s",
                    "achieved_frac", "bound", "components_s",
                    "peaks", "peaks_source"}],              # (v5)
+     "spmdcheck": [{"op", "ok", "kernel", "shard_maps", "mesh_axes",
+                    "collectives", "counts": {class: n},
+                    "relation", "expected",
+                    "diagnostics": [{"kind", "message", "kernel",
+                                     "detail"}]}],         # (v6)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
@@ -55,9 +60,11 @@ lookahead/aggregation shape of the pipelined factorization sweeps);
 5 adds ``"phases"`` per op entry and the ``"roofline"`` section
 (--phase-profile / --peaks-file performance attribution,
 observability.phases + observability.roofline) plus the ``nruns``
-timing field. All additive — v1 readers of the other keys are
-unaffected; this reader accepts <= 5 (:func:`load_report` tolerates
-every v1-v5 vintage, filling the always-present keys).
+timing field; 6 adds ``"spmdcheck"`` (--spmdcheck collective-schedule
+verification of the traced SPMD program, analysis.spmdcheck). All
+additive — v1 readers of the other keys are unaffected; this reader
+accepts <= 6 (:func:`load_report` tolerates every v1-v6 vintage,
+filling the always-present keys).
 """
 from __future__ import annotations
 
@@ -69,7 +76,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 5
+REPORT_SCHEMA = 6
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -101,6 +108,7 @@ class RunReport:
         self.checks: List[dict] = []    # -x verification outcomes
         self.resilience: List[dict] = []  # per-op ladder summaries
         self.dagcheck: List[dict] = []  # --dagcheck verification (v3)
+        self.spmdcheck: List[dict] = []  # --spmdcheck verification (v6)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
         self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
@@ -143,6 +151,13 @@ class RunReport:
         self.dagcheck.append(entry)
         return entry
 
+    def add_spmdcheck(self, op: str, summary: dict) -> dict:
+        """Record one --spmdcheck verification outcome (schema v6; see
+        analysis.spmdcheck.SpmdResult.summary)."""
+        entry = {"op": op, **summary}
+        self.spmdcheck.append(entry)
+        return entry
+
     def add_roofline(self, entry: dict) -> dict:
         """Record one per-op roofline ledger entry (schema v5; see
         observability.roofline.op_roofline)."""
@@ -172,6 +187,8 @@ class RunReport:
             doc["resilience"] = self.resilience
         if self.dagcheck:
             doc["dagcheck"] = self.dagcheck
+        if self.spmdcheck:
+            doc["spmdcheck"] = self.spmdcheck
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
         if self.roofline:
@@ -206,7 +223,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v5) loads: the schema history is purely
+    Every older vintage (v1-v6) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

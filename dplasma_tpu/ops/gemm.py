@@ -39,6 +39,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+try:
+    from jax import shard_map
+except ImportError:  # older jax spells it jax.experimental.shard_map
+    from jax.experimental.shard_map import shard_map
+
 from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.kernels import blas as k
 from dplasma_tpu.ops.blas3 import _op, gemm as gemm_dot
@@ -257,7 +262,7 @@ def gemm_summa(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
         return acc
 
     spec2d = P(pmesh.ROW_AXIS, pmesh.COL_AXIS)
-    out = jax.shard_map(
+    out = shard_map(
         local, mesh=m,
         in_specs=(spec2d, spec2d, spec2d),
         out_specs=spec2d)(a, bmat, cmat)

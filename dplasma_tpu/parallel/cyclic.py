@@ -2041,7 +2041,8 @@ def potrf_cyclic(A: CyclicMatrix, uplo: str = "L") -> CyclicMatrix:
 # Analytic SPMD comm-volume model (observability)
 # ---------------------------------------------------------------------
 
-def spmd_comm_model(desc: CyclicDesc, op: str, itemsize: int) -> dict:
+def spmd_comm_model(desc: CyclicDesc, op: str, itemsize: int,
+                    kt: int | None = None) -> dict:
     """Per-collective wire-byte model of the cyclic shard_map programs.
 
     Mirrors the collective structure the algorithms above actually
@@ -2053,9 +2054,10 @@ def spmd_comm_model(desc: CyclicDesc, op: str, itemsize: int) -> dict:
     of the gathered output). Returned bytes are TOTAL wire bytes
     across all ranks and steps; a 1x1 grid prices to zero.
 
-    Known ``op`` values: potrf, getrf, geqrf, herbt, ge2gb (the cyclic
-    kernels in this module). Raises KeyError otherwise — callers
-    surface an explicit null in the run-report rather than a guess.
+    Known ``op`` values: potrf, getrf, geqrf, gemm, herbt, ge2gb (the
+    cyclic kernels in this module). Raises KeyError otherwise —
+    callers surface an explicit null in the run-report rather than a
+    guess.
     """
     d = desc.dist
     P, Q, R = d.P, d.Q, d.P * d.Q
@@ -2090,6 +2092,16 @@ def spmd_comm_model(desc: CyclicDesc, op: str, itemsize: int) -> dict:
             # CholeskyQR2: two Gram psums + the top-block psum along 'p'
             "gram_psum_p": KT * 3 * psum(mb * mb, P),
             "trailing_vhc_psum_p": KT * psum(mb * nloc, P),
+        }
+    elif op == "gemm":
+        # SUMMA over slabs: per contraction step one A-column bcast
+        # along 'q' and one B-row bcast along 'p' (ref zsumma_NN.jdf);
+        # ``kt`` carries the contraction tile count (defaults to the
+        # square case)
+        KT = kt if kt is not None else KT
+        by = {
+            "a_col_bcast_psum_q": KT * psum(mloc * desc.nb, Q),
+            "b_row_bcast_psum_p": KT * psum(desc.nb * nloc, P),
         }
     elif op == "herbt":
         by = {

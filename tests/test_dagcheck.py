@@ -349,7 +349,7 @@ def test_large_dag_skips_reach_checks_but_not_linear_ones():
 
 
 def test_driver_dagcheck_end_to_end(tmp_path, capsys):
-    """--dagcheck verifies before executing and lands in the schema-v5
+    """--dagcheck verifies before executing and lands in the schema-v6
     run-report. The default pipeline (lookahead=1) records the
     engine's split-column DAG; --lookahead=0 records the classic tile
     DAG — both must verify clean."""
@@ -364,7 +364,7 @@ def test_driver_dagcheck_end_to_end(tmp_path, capsys):
     assert "dagcheck[testing_dpotrf]" in out and "OK" in out
     assert "#+ pipeline: sweep.lookahead=1" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 5
+    assert doc["schema"] == 6
     assert doc["pipeline"]["sweep.lookahead"] == 1
     (entry,) = doc["dagcheck"]
     # pipelined potrf DAG at nt=4, la=1: 4 panels + 3 narrow lookahead

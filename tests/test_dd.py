@@ -346,18 +346,18 @@ def test_getrf_dd_eager_many_panels():
         cfg.mca_set("dd_gemm", None)
 
 
+@pytest.mark.requires_pallas
 def test_pallas_recombine_base_matches_exact():
     """The Pallas double-single epilogue (interpret mode here) must
     match the exact emulated recombine to ~2^-45 relative — the DS
-    width contract (kernels/pallas_dd.py)."""
+    width contract (kernels/pallas_dd.py). Skipped via the shared
+    ``requires_pallas`` probe (conftest): the ad-hoc HAVE_PALLAS flag
+    only covers the import, not the API surface this kernel runs on."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from dplasma_tpu.kernels import dd, pallas_dd
 
-    if not pallas_dd.HAVE_PALLAS:
-        import pytest
-        pytest.skip("no pallas")
     rng = np.random.default_rng(3)
     M, N, nl, w = 64, 128, 8, 7
     levels = [jnp.asarray(rng.integers(-2**30, 2**30, (M, N)),

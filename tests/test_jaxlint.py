@@ -149,6 +149,45 @@ def test_wrapped_inner_body_is_traced():
     """) == ["J001"]
 
 
+def test_j008_hardcoded_axis_name():
+    """Mesh axis-name literals in collective/sharding calls must
+    route through parallel.mesh.ROW_AXIS/COL_AXIS."""
+    assert _codes("""\
+        import jax
+        def f(x):
+            return jax.lax.psum(x, 'q')
+    """) == ["J008"]
+    assert _codes("""\
+        from jax.sharding import PartitionSpec
+        spec = PartitionSpec('p', 'q')
+    """) == ["J008", "J008"]
+    assert _codes("""\
+        import jax
+        def f(x):
+            return jax.lax.all_gather(x, axis_name='p')
+    """) == ["J008"]
+    # routed through the constants: clean
+    assert _codes("""\
+        import jax
+        from dplasma_tpu.parallel import mesh as pmesh
+        def f(x):
+            return jax.lax.psum(x, pmesh.ROW_AXIS)
+    """) == []
+    # unrelated string args to unrelated callees are not axis names
+    assert _codes("""\
+        def trsm(a, b, side='L', trans='N'):
+            return a
+        y = trsm(1, 2, side='L')
+    """) == []
+    # the mesh module owns the literals
+    assert jaxlint.lint_source(
+        textwrap.dedent("""\
+            from jax.sharding import Mesh
+            def make(arr):
+                return Mesh(arr, ('p', 'q'))
+        """), "dplasma_tpu/parallel/mesh.py") == []
+
+
 def test_suppression_comment():
     assert _codes("""\
         import jax

@@ -3,8 +3,10 @@
 * tools/lint_excepts.py — bare ``except:`` and silent
   ``except Exception: pass`` are rejected across ``dplasma_tpu/``;
 * tools/lint_all.py — the aggregate runner (lint_excepts + the
-  analysis.jaxlint trace-safety rules + a dagcheck smoke pass over
-  tiny DAGs of all four ops) must exit 0 on the repo.
+  analysis.jaxlint trace-safety rules + the perfdiff smoke + the
+  analysis.palcheck pallas-contract gate + a dagcheck smoke pass over
+  tiny DAGs of all four ops + the analysis.spmdcheck collective-
+  schedule smoke over the cyclic kernels) must exit 0 on the repo.
 """
 import pathlib
 import sys
@@ -69,11 +71,12 @@ def test_lint_cli_exit_codes(tmp_path):
 
 def test_lint_all_aggregate_is_clean(capsys):
     """tools/lint_all.py gates every rule with one exit code: excepts,
-    jaxlint, and the dagcheck smoke pass must all be clean on the
-    repo."""
+    jaxlint, the perfdiff smoke, the pallas contract gate, and the
+    dagcheck/spmdcheck smoke passes must all be clean on the repo."""
     import lint_all
     rc = lint_all.main([])
     out = capsys.readouterr()
     assert rc == 0, out.err
-    for gate in ("lint_excepts", "jaxlint", "dagcheck-smoke"):
+    for gate in ("lint_excepts", "jaxlint", "perfdiff-smoke",
+                 "palcheck", "dagcheck-smoke", "spmdcheck-smoke"):
         assert f"# {gate}: OK" in out.out

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 5
+    assert doc["schema"] == REPORT_SCHEMA == 6
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -116,6 +116,8 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
             "pipeline": {"sweep.lookahead": 1, "qr.agg_depth": 4}},
         5: {"schema": 5, "name": "v5", "ops": [], "metrics": [],
             "roofline": []},
+        6: {"schema": 6, "name": "v6", "ops": [], "metrics": [],
+            "spmdcheck": []},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -366,7 +368,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 5
+    assert doc["schema"] == 6
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

@@ -3,14 +3,25 @@
 The reference's hot bodies are cuBLAS calls inside JDF chores
 (src/zgemm_NN_gpu.jdf, src/zpotrf_L.jdf:432-470); here the TPU analogues
 are Pallas kernels checked against the plain XLA path.
+
+The whole module runs only where the session-level pallas runtime
+probe passes (conftest ``requires_pallas``): these tests *execute*
+kernels, so an importable-but-API-incompatible pallas must skip them,
+not fail them. The static contracts of the same kernels are checked
+everywhere by ``analysis.palcheck`` (tests/test_palcheck.py), which
+needs no runtime.
 """
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import requires_pallas
 from dplasma_tpu.kernels import blas as k
-from dplasma_tpu.kernels import pallas_kernels as pk
+
+pk = pytest.importorskip("dplasma_tpu.kernels.pallas_kernels")
+
+pytestmark = requires_pallas
 
 
 @pytest.fixture
@@ -88,9 +99,6 @@ def test_pallas_lu_panel_matches_vendor():
 
     from dplasma_tpu.kernels import pallas_lu
 
-    if not pallas_lu.HAVE_PALLAS:
-        import pytest
-        pytest.skip("no pallas")
     rng = np.random.default_rng(2)
     for M, nb in ((128, 32), (96, 8)):
         a = rng.standard_normal((M, nb)).astype(np.float32)
@@ -115,9 +123,6 @@ def test_pallas_lu_panel_mca_routing(monkeypatch):
     from dplasma_tpu.ops import lu as lu_mod
     from dplasma_tpu.utils import config as cfg
 
-    if not pallas_lu.HAVE_PALLAS:
-        import pytest
-        pytest.skip("no pallas")
     calls = []
     orig = pallas_lu.lu_panel
     monkeypatch.setattr(pallas_lu, "lu_panel",

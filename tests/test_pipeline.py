@@ -270,7 +270,7 @@ def test_driver_lookahead_scoped_mca_override():
     assert "sweep.lookahead" not in config._MCA_OVERRIDES
 
 
-def test_report_pipeline_section_schema_v5(tmp_path, capsys):
+def test_report_pipeline_section_schema_v6(tmp_path, capsys):
     import json
 
     from dplasma_tpu.drivers import main
@@ -281,7 +281,7 @@ def test_report_pipeline_section_schema_v5(tmp_path, capsys):
     assert rc == 0
     assert "#+ pipeline: sweep.lookahead=" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 5
+    assert doc["schema"] == 6
     assert set(doc["pipeline"]) == {"sweep.lookahead", "qr.agg_depth"}
 
 

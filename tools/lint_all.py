@@ -1,30 +1,51 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Four passes, in increasing cost order:
+Six passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
 2. ``dplasma_tpu.analysis.jaxlint`` — the JAX/TPU trace-safety rules
    (tracer concretization, mutable defaults, numpy-in-jit, float64
-   literals, kernel nondeterminism);
+   literals, kernel nondeterminism, hard-coded mesh axis names);
 3. a ``tools/perfdiff.py`` smoke pass — a report self-compare must
    exit 0 and a synthetically regressed report must exit nonzero with
    the offending metric named (the CI regression gate must itself be
    gated);
-4. a ``dplasma_tpu.analysis.dagcheck`` smoke pass — the analytic tile
+4. ``dplasma_tpu.analysis.palcheck`` — every ``pl.pallas_call``
+   contract in the package: BlockSpec divisibility and tiling, index
+   maps covering the grid, the VMEM budget, the precision contract;
+5. a ``dplasma_tpu.analysis.dagcheck`` smoke pass — the analytic tile
    DAGs of all four ops (potrf/lu/qr/gemm) at 3x3 tiles on 1x1 and
    2x2 grids must verify clean, with the comm-model reconciliation
-   exact for the owner-computes classes.
+   exact for the owner-computes classes;
+6. a ``dplasma_tpu.analysis.spmdcheck`` smoke pass — the cyclic
+   shard_map kernels (potrf/getrf/geqrf/gemm) traced on tiny shapes
+   over 1x1/2x2/1x4 grids must verify clean with the collective
+   counts EXACTLY reconciling the analytic comm model, and the
+   canonical ring schedule must drain deadlock-free in the abstract
+   simulator.
 
 Usage: ``python tools/lint_all.py`` — prints ``file:line: message``
-per violation / one line per failed smoke DAG, exits nonzero on any.
+per violation / one line per failed smoke case, exits nonzero on any.
 Wired into tier-1 via ``tests/test_lint.py``.
 """
 from __future__ import annotations
 
+import os
 import pathlib
 import sys
+
+# the spmdcheck smoke builds 2x2/1x4 CPU meshes: force the virtual
+# device count BEFORE anything imports jax (a no-op under pytest,
+# where tests/conftest.py already did it)
+if "jax" not in sys.modules:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 _ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(_ROOT))
@@ -58,7 +79,7 @@ def run_perfdiff_smoke() -> int:
 
     import perfdiff
 
-    base = {"schema": 5, "name": "perfdiff-smoke",
+    base = {"schema": 6, "name": "perfdiff-smoke",
             "ops": [{"label": "testing_dpotrf", "prec": "d",
                      "gflops": 100.0,
                      "timings": {"nruns": 3, "median_s": 0.010,
@@ -147,13 +168,83 @@ def run_dagcheck_smoke() -> int:
     return bad
 
 
+def run_palcheck() -> int:
+    """Every pallas_call contract in the package must verify clean
+    (analysis.palcheck: capture + block/index/VMEM/precision checks;
+    degrades to the AST site sweep where pallas cannot import)."""
+    from dplasma_tpu.analysis import palcheck
+    res = palcheck.check_package()
+    for d in res.diagnostics:
+        sys.stderr.write(f"palcheck[{d.site}]: {d.kind}: "
+                         f"{d.message}\n")
+    return len(res.diagnostics)
+
+
+def run_spmdcheck_smoke() -> int:
+    """The cyclic shard_map kernels must verify clean with EXACT
+    collective-count reconciliation against the analytic comm model,
+    over 1x1 / 2x2 / 1x4 grids at tiny shapes (nothing executes —
+    jaxpr tracing only); plus the abstract ring simulator's golden:
+    the canonical neighbor-shift schedule drains deadlock-free."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from dplasma_tpu.analysis import spmdcheck as sp
+    from dplasma_tpu.descriptors import Dist
+    from dplasma_tpu.parallel import cyclic
+    from dplasma_tpu.parallel import mesh as pmesh
+
+    nb, nt = 4, 4
+    bad = 0
+    ndev = len(jax.devices())
+    for P, Q in ((1, 1), (2, 2), (1, 4)):
+        if P * Q > ndev:
+            print(f"# spmdcheck-smoke: {P}x{Q} skipped "
+                  f"({ndev} device(s) available)")
+            continue
+        m = pmesh.make_mesh(P, Q)
+        d = Dist(P=P, Q=Q)
+        desc = cyclic.CyclicDesc(nt * nb, nt * nb, nb, nb, d)
+        data = jnp.zeros((P, Q, desc.MTL * nb, desc.NTL * nb),
+                         jnp.float32)
+        KT = min(desc.MT, desc.NT)
+        la = 1
+        cases = [
+            ("potrf", partial(cyclic._potrf_cyclic_jit, desc=desc,
+                              mesh=m, lookahead=la), (data,), KT, la),
+            ("getrf", partial(cyclic._getrf_cyclic_jit, desc=desc,
+                              mesh=m, lookahead=la), (data,), KT, la),
+            ("geqrf", partial(cyclic._geqrf_cyclic_jit, desc=desc,
+                              mesh=m, lookahead=la), (data,), KT, la),
+            ("gemm", partial(cyclic._gemm_cyclic_jit, adesc=desc,
+                             bdesc=desc, mesh=m), (data, data),
+             desc.NT, 0),
+        ]
+        for op, fn, args, kt, la_ in cases:
+            res = sp.check_kernel(fn, args, f"{op}_{P}x{Q}", op=op,
+                                  KT=kt, lookahead=la_)
+            if not res.ok or res.relation != "==":
+                sys.stderr.write(res.format(f"{op} {P}x{Q}") + "\n")
+                bad += max(len(res.diagnostics), 1)
+    ring = sp.check_ring("ring-shift-4",
+                         sp.ring_shift_program(4, steps=3))
+    if not ring.ok:
+        sys.stderr.write(ring.format() + "\n")
+        bad += len(ring.diagnostics)
+    return bad
+
+
 def main(argv=None) -> int:
     pkg = _ROOT / "dplasma_tpu"
     bad = 0
     for name, fn in (("lint_excepts", lambda: run_excepts(pkg)),
                      ("jaxlint", lambda: run_jaxlint(pkg)),
                      ("perfdiff-smoke", run_perfdiff_smoke),
-                     ("dagcheck-smoke", run_dagcheck_smoke)):
+                     ("palcheck", run_palcheck),
+                     ("dagcheck-smoke", run_dagcheck_smoke),
+                     ("spmdcheck-smoke", run_spmdcheck_smoke)):
         n = fn()
         print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")
         bad += n
