@@ -211,6 +211,88 @@ def bench_getrf(N, nb, dtype=jnp.float32, lo=1, hi=4):
     return lawn41.getrf(N, N) / 1e9 / t
 
 
+def bench_ir_solver(kind, N, nb, nrhs=4, precision="f32", lo=1, hi=3):
+    """Mixed-precision IR solve (ops.refine): factor in ``precision``,
+    refine to f64-equivalent backward error. Eager host loop (the IR
+    engine's bench path) with differenced timing; returns
+    ``(gflops, record)`` where the record carries the iteration count
+    and the attributed factor-phase rate — the convergence metrics the
+    ladder gates alongside GFlop/s."""
+    from dplasma_tpu.observability import phases
+    from dplasma_tpu.ops import refine
+    if kind == "posv":
+        A0 = generators.plghe(float(N), N, nb, seed=3872,
+                              dtype=jnp.float64)
+        solve = lambda a, b, **kw: refine.posv_ir(a, b, "L", **kw)  # noqa: E731
+        fl = lawn41.potrf(N) + lawn41.potrs(N, nrhs)
+        fac_fl = lawn41.potrf(N)
+    else:
+        A0 = generators.plrnt(N, N, nb, nb, seed=3872,
+                              dtype=jnp.float64)
+        solve = refine.gesv_ir
+        fl = lawn41.getrf(N, N) + lawn41.getrs(N, nrhs)
+        fac_fl = lawn41.getrf(N, N)
+    B0 = generators.plrnt(N, nrhs, nb, nb, seed=3873,
+                          dtype=jnp.float64)
+    got = {}
+
+    def run_k(kk):
+        res = None
+        for i in range(kk):
+            a = A0.data.at[:1].multiply(1.0 + (i + 1) * 1e-7)
+            res = solve(TileMatrix(a, A0.desc), B0,
+                        precision=precision)
+        jax.block_until_ready(res[0].data)
+        _sync(res[0].data)
+        got["info"] = res[1]
+
+    t = _eager_diff_seconds(run_k, lo, hi)
+    # one attributed pass: the factor span's INCLUSIVE wall time (it
+    # encloses the inner sweep's child spans, which hold the work)
+    # prices the working-precision factorization rate for the record
+    with phases.profiling() as led:
+        X, _ = solve(TileMatrix(A0.data, A0.desc), B0,
+                     precision=precision)
+        jax.block_until_ready(X.data)
+    fac = {r["phase"]: r for r in led.summary()}.get("factor")
+    summ = refine.summarize(got["info"], op=f"{kind}_ir",
+                            precision=precision)
+    rec = {"precision": precision, "iterations": summ["iterations"],
+           "converged": summ["converged"],
+           "escalated": summ["escalated"],
+           "backward_error": (summ["backward_errors"][-1]
+                              if summ["backward_errors"] else None),
+           "factor_gflops": (round(fac_fl / 1e9 / fac["total_s"], 2)
+                             if fac and fac["total_s"] > 0
+                             else None)}
+    return fl / 1e9 / t, rec
+
+
+def bench_ir_factor_rates(N, nb, precisions=("bf16", "f32", "f32x2")):
+    """Per-precision working-factorization rates (the bench doc's
+    ``refine.factor_gflops`` table): one attributed posv_ir factor per
+    precision (max_iters=1, no escalation — the factor span is what's
+    being priced, not convergence)."""
+    from dplasma_tpu.observability import phases
+    from dplasma_tpu.ops import refine
+    A0 = generators.plghe(float(N), N, nb, seed=3872,
+                          dtype=jnp.float64)
+    B0 = generators.plrnt(N, 1, nb, nb, seed=3873, dtype=jnp.float64)
+    fac_fl = lawn41.potrf(N)
+    rates = {}
+    for prec in precisions:
+        kw = dict(precision=prec, max_iters=1, escalate=False)
+        X, _ = refine.posv_ir(A0, B0, **kw)     # compile + warm
+        jax.block_until_ready(X.data)
+        with phases.profiling() as led:
+            X, _ = refine.posv_ir(A0, B0, **kw)
+            jax.block_until_ready(X.data)
+        fac = {r["phase"]: r for r in led.summary()}.get("factor")
+        if fac and fac["total_s"] > 0:
+            rates[prec] = round(fac_fl / 1e9 / fac["total_s"], 2)
+    return rates
+
+
 def _dd_bound_products(K: int) -> int:
     """Limb matmuls per FP64-equivalent GEMM at reduction depth K."""
     from dplasma_tpu.kernels import dd
@@ -290,6 +372,10 @@ def main(argv=None) -> int:
             "peaks": peaks,
             "pipeline": pipeline,
         }
+        if report.extra.get("refine"):
+            # IR-solver convergence record (iterations, per-precision
+            # factor rates) — tracked in the ledger next to GFlop/s
+            doc["refine"] = report.extra["refine"]
         report.extra["headline"] = {
             k: doc[k] for k in ("metric", "value", "unit",
                                 "vs_baseline", "elapsed_s")}
@@ -383,6 +469,13 @@ def main(argv=None) -> int:
         dd_getrf_cfgs = [dict(N=8192, nb=1024, cost_s=500),
                          dict(N=4096, nb=1024, cost_s=400),
                          dict(N=2048, nb=512)]
+        # mixed-precision IR solves (ops.refine): f32 factor + dd
+        # residuals — much cheaper to compile than the full dd routes
+        ir_posv_cfgs = [dict(N=4096, nb=512, cost_s=350),
+                        dict(N=2048, nb=512)]
+        ir_gesv_cfgs = [dict(N=4096, nb=512, cost_s=400),
+                        dict(N=2048, nb=512)]
+        ir_rates_cfg = dict(N=2048, nb=512)
         dd_cost = 420.0
     else:  # CI / smoke path: tiny shapes, same code
         peak32 = measure_peak(n=1024, iters=20, dtype="float32",
@@ -399,6 +492,9 @@ def main(argv=None) -> int:
         dd_potrf_cfgs = [dict(N=1024, nb=256)]
         dd_geqrf_cfgs = [dict(N=512, nb=128)]
         dd_getrf_cfgs = [dict(N=512, nb=128)]
+        ir_posv_cfgs = [dict(N=512, nb=128)]
+        ir_gesv_cfgs = [dict(N=512, nb=128)]
+        ir_rates_cfg = dict(N=256, nb=64)
         dd_cost = 60.0
 
     # Peak reads are sanity-gated against known hardware ratios
@@ -433,6 +529,56 @@ def main(argv=None) -> int:
               cost_s=dd_cost, dtype=jnp.float64, hi=4)
     run_entry("dgemm_f64equiv", bench_gemm, dd_gemm_cfgs, dd_bound,
               cost_s=dd_cost / 3, dtype=jnp.float64)
+
+    # Mixed-precision IR solves: factor at the f32 MXU rate, refine
+    # the O(n^2) residual on the dd rungs to f64-equivalent backward
+    # error. Measured against the SAME f64-equiv bound as the dd
+    # routes — vs_baseline > the dd entries' is the route's win. The
+    # ladder additionally carries the iteration counts (lower-better:
+    # --gate flags convergence regressions, not just GFlop/s) and the
+    # doc's "refine" section the per-precision factor rates.
+    refine_sec = report.extra.setdefault("refine", {})
+
+    def run_ir_entry(name, kind, cfg_list, cost):
+        recs = {}
+
+        def fn(N, nb, **kw):
+            g, rec = bench_ir_solver(kind, N, nb, **kw)
+            recs[N] = rec
+            return g
+
+        e = run_entry(name, fn, cfg_list, dd_bound, cost_s=cost)
+        if e is None:
+            return
+        n_val = int(e["metric"].rsplit("_n", 1)[1])
+        rec = recs.get(n_val)
+        if rec is None:
+            return
+        e["refine"] = rec
+        refine_sec[name] = dict(rec, N=n_val)
+        ladder.append({"metric": f"{name}_iters_n{n_val}",
+                       "value": rec["iterations"],
+                       "unit": "iterations", "better": "lower"})
+        if rec.get("factor_gflops"):
+            ladder.append(
+                {"metric": f"{name}_factor_{rec['precision']}"
+                           f"_gflops_n{n_val}",
+                 "value": rec["factor_gflops"], "unit": "GFlop/s"})
+        emit()
+
+    run_ir_entry("dposv_ir_f64equiv", "posv", ir_posv_cfgs,
+                 dd_cost * 0.8)
+    run_ir_entry("dgesv_ir_f64equiv", "gesv", ir_gesv_cfgs, dd_cost)
+    if remaining() > (120.0 if on_tpu else 30.0):
+        try:
+            refine_sec["factor_gflops"] = dict(
+                bench_ir_factor_rates(**ir_rates_cfg),
+                N=ir_rates_cfg["N"])
+            emit()
+        except Exception as exc:  # noqa: BLE001
+            refine_sec["factor_gflops"] = {
+                "error": str(exc)[:120]}
+
     for name, fn, cfg_list, cost in cfgs32:
         run_entry(name, fn, cfg_list, peak32,
                   cost_s=cost if on_tpu else 60.0, dtype=jnp.float32)

@@ -615,7 +615,8 @@ class Driver:
         model = _rl.phase_model(
             OP_CLASS.get(_algo_of(self.name)), ip.M, ip.N, ip.NB,
             itemsize, lookahead=self.pipeline["sweep.lookahead"],
-            agg_depth=self.pipeline["qr.agg_depth"])
+            agg_depth=self.pipeline["qr.agg_depth"], nrhs=ip.K,
+            peaks=peaks)
         spans = _rl.attribute_phases(led, model, peaks)
         ssum = led.total()
         return {"attributed_run_s": total, "sum_s": ssum,
@@ -977,6 +978,33 @@ class Driver:
             for line in guard.format_lines(summary):
                 print(line)
             sys.stdout.flush()
+
+    def report_refine(self, summary: dict) -> dict:
+        """Record one mixed-precision IR solve: the run-report
+        ``"refine"`` section (schema v7; ops.refine.summarize),
+        refine_* metrics, and the ``#+ refine:`` line at -v>=2."""
+        entry = self.report.add_refine(summary)
+        reg = self.report.metrics
+        lbl = dict(op=summary.get("op", self.name), prec=self.ip.prec)
+        reg.gauge("refine_iterations", **lbl).set(
+            summary.get("iterations", 0))
+        reg.counter("refine_escalations_total", **lbl).inc(
+            1 if summary.get("escalated") else 0)
+        hist = summary.get("backward_errors") or []
+        if hist:
+            reg.gauge("refine_backward_error", **lbl).set(hist[-1])
+        ip = self.ip
+        if ip.rank == 0 and ip.loud >= 2:
+            tail = f" bwd={hist[-1]:.3e}" if hist else ""
+            print("#+ refine[%s]: precision=%s iters=%d %s%s"
+                  % (summary.get("op", self.name),
+                     summary.get("precision", "?"),
+                     summary.get("iterations", 0),
+                     ("escalated" if summary.get("escalated") else
+                      "converged" if summary.get("converged") else
+                      "exhausted"), tail))
+            sys.stdout.flush()
+        return entry
 
     def report_check(self, what: str, residual, ok) -> int:
         res = float(np.asarray(residual))

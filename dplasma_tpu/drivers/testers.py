@@ -376,13 +376,8 @@ def gels(drv: Driver):
                           lawn41.geqrf(ip.M, ip.N, cplx)
                           + lawn41.unmqr("L", ip.M, ip.K, ip.N, cplx))
     if ip.check:
-        # least squares: A^H (A x - b) == 0
-        Ad, Xd = A0.to_dense(), out.to_dense()[:ip.N]
-        res = Ad.conj().T @ (Ad @ Xd - B.to_dense()[:ip.M])
-        nrm = jnp.linalg.norm(Ad) ** 2 * jnp.linalg.norm(Xd)
-        eps = jnp.finfo(res.real.dtype).eps
-        r = jnp.linalg.norm(res) / (nrm * eps * max(ip.M, ip.N))
-        return drv.report_check("GELS normal eq", r, r < 60)
+        r, ok = checks.check_gels(A0, B, out.to_dense())
+        return drv.report_check("GELS normal eq", r, ok)
     return 0
 
 
@@ -606,6 +601,114 @@ def gesv_incpiv(drv: Driver):
         X = out[-1] if isinstance(out, tuple) else out
         r, ok = checks.check_axmb(A0, B, X)
         return drv.report_check("GESV_INCPIV |b-Ax|", r, ok)
+    return 0
+
+
+# ------------------------------------------- mixed-precision IR solves
+
+def _refine_flops(ip, kind: str) -> float:
+    """Advertised flop model of an IR solve: the factorization + one
+    solve (the LAWN-41 counts of the op the IR route replaces — the
+    O(n^2) refinement steps are not counted, exactly as gerfs-style
+    refinement is unpriced in the reference)."""
+    cplx = _is_complex(ip.prec_dtype)
+    if kind == "posv":
+        return lawn41.potrf(ip.N, cplx) + lawn41.potrs(ip.N, ip.K,
+                                                       cplx)
+    if kind == "gesv":
+        return lawn41.getrf(ip.N, ip.N, cplx) + lawn41.getrs(
+            ip.N, ip.K, cplx)
+    return lawn41.geqrf(ip.M, ip.N, cplx) + lawn41.unmqr(
+        "L", ip.M, ip.K, ip.N, cplx)
+
+
+def posv_ir(drv: Driver):
+    """testing_dposv_ir: SPD solve, factored in the MCA ``ir.precision``
+    working precision and iteratively refined to f64-equivalent
+    backward error (ops.refine). The solver's own divergence escalation
+    re-solves via the full dd route; the SAME escape is additionally
+    wired as a remediation-ladder fallback rung so an unhealthy IR
+    output (injected faults, non-finites) walks the PR 2 ladder like
+    any other op."""
+    from dplasma_tpu.ops import refine
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
+    B = _gen(drv, ip.N, ip.K, 1)
+    fallbacks = [("posv_dd", lambda a, b: potrf_mod.posv(a, b, "L"))]
+    out, _ = drv.progress(
+        lambda a, b: refine.posv_ir(a, b, "L"),
+        (_put(drv, A0), _put(drv, B)), _refine_flops(ip, "posv"),
+        dag_fn=lambda rec: refine.dag(_dagm(drv, A0), "posv", rec),
+        fallbacks=fallbacks)
+    if drv.winner == "posv_dd":
+        X = out[1]
+    else:
+        X, info = out
+        drv.report_refine(refine.summarize(info, op=drv.name))
+    if ip.check:
+        r, ok = checks.check_solve(A0, B, X, uplo="L")
+        return drv.report_check("POSV_IR backward error", r, ok)
+    return 0
+
+
+def gesv_ir(drv: Driver):
+    """testing_dgesv_ir: general solve by low-precision pivoted LU +
+    iterative refinement (see posv_ir)."""
+    from dplasma_tpu.ops import refine
+    ip = drv.ip
+    A0 = _gen(drv, ip.N, ip.N)
+    B = _gen(drv, ip.N, ip.K, 1)
+
+    def _gesv_ptg(a, b):
+        # the grid-correct full-precision route (ptgpanel dispatches
+        # to the distributed panel under a mesh) — same escape the
+        # solver's own escalation rung takes
+        F, p = lu.getrf_ptgpanel(a)
+        return F, p, lu.getrs("N", F, p, b)
+
+    fallbacks = [("gesv_dd", _gesv_ptg)]
+    out, _ = drv.progress(
+        refine.gesv_ir, (_put(drv, A0), _put(drv, B)),
+        _refine_flops(ip, "gesv"),
+        dag_fn=lambda rec: refine.dag(_dagm(drv, A0), "gesv", rec),
+        fallbacks=fallbacks)
+    if drv.winner == "gesv_dd":
+        X = out[-1]
+    else:
+        X, info = out
+        drv.report_refine(refine.summarize(info, op=drv.name))
+    if ip.check:
+        r, ok = checks.check_solve(A0, B, X)
+        return drv.report_check("GESV_IR backward error", r, ok)
+    return 0
+
+
+def gels_ir(drv: Driver):
+    """testing_dgels_ir: overdetermined least squares by low-precision
+    QR + semi-normal-equation refinement on the R factor (see
+    posv_ir)."""
+    from dplasma_tpu.ops import refine
+    ip = drv.ip
+    if ip.M < ip.N:
+        raise SystemExit("gels_ir: overdetermined (M >= N) only; use "
+                         "testing_?gels for the minimum-norm path")
+    A0 = _gen(drv, ip.M, ip.N)
+    B = _gen(drv, ip.M, ip.K, 1)
+    fallbacks = [("gels_dd", qr.gels)]
+    out, _ = drv.progress(
+        refine.gels_ir, (_put(drv, A0), _put(drv, B)),
+        _refine_flops(ip, "gels"),
+        dag_fn=lambda rec: refine.dag(_dagm(drv, A0), "gels", rec),
+        fallbacks=fallbacks)
+    if drv.winner == "gels_dd":
+        Xd = out.to_dense()[:ip.N]
+    else:
+        X, info = out
+        drv.report_refine(refine.summarize(info, op=drv.name))
+        Xd = X.to_dense()
+    if ip.check:
+        r, ok = checks.check_gels(A0, B, Xd)
+        return drv.report_check("GELS_IR normal eq", r, ok)
     return 0
 
 
@@ -977,6 +1080,8 @@ DRIVERS = {
     "getrf_ptgpanel": getrf_ptgpanel, "getrf_incpiv": getrf_incpiv,
     "getrf_qrf": getrf_qrf,
     "gesv": gesv, "gesv_incpiv": gesv_incpiv,
+    # mixed-precision iterative-refinement solvers (ops.refine)
+    "posv_ir": posv_ir, "gesv_ir": gesv_ir, "gels_ir": gels_ir,
     "heev": heev, "hetrd": hetrd, "gesvd": gesvd, "gebrd": gebrd,
     "hetrf": hetrf, "hebut": hebut,
     "lange": lange, "lanhe": lanhe, "lansy": lansy, "lantr": lantr,

@@ -87,6 +87,36 @@ def _level_recombine(levels, w: int):
     return acc
 
 
+def _pin_cat_axis(p):
+    """Keep the limb-concat (last) axis of a level dot output
+    UNSHARDED under an active device mesh.
+
+    With a sharded consumer (e.g. a 2-D-distributed residual), GSPMD
+    back-propagates the output's column sharding through the per-limb
+    prefix slices into the concatenated dot — partitioning the concat
+    axis at limb-interior boundaries, which XLA's halo-exchange
+    lowering miscompiles (observed on the 2x2 CPU grid: jit+sharded
+    results are garbage while eager is exact). Pinning the concat axis
+    (rows stay 'p'-distributed when they divide) forces the reshard to
+    happen AFTER the slices instead, restoring exactness. No-op
+    without an active grid, and skipped on concrete (eager) values —
+    the bug is a partitioner miscompile, eager execution is exact and
+    must not pay placement traffic per limb product."""
+    from dplasma_tpu.parallel import mesh as pmesh
+    m = pmesh._ACTIVE
+    if m is None or utils.is_concrete(p):
+        return p
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rows_ax = p.ndim - 2   # lhs-free axis (batched when chunked)
+    rows = (pmesh.ROW_AXIS
+            if p.shape[rows_ax] % m.shape[pmesh.ROW_AXIS] == 0
+            else None)
+    spec = [None] * p.ndim
+    spec[rows_ax] = rows
+    return jax.lax.with_sharding_constraint(
+        p, NamedSharding(m, P(*spec)))
+
+
 def _limb_levels(al, bl, K: int, w: int, nl: int, kc: int,
                  lhs_t: bool = False):
     """Exact level sums of the limb-pair products.
@@ -135,8 +165,8 @@ def _limb_levels(al, bl, K: int, w: int, nl: int, kc: int,
     for i in range(nl):
         nj = nl - i
         bcat = jax.lax.slice_in_dim(bfull, 0, nj * P, axis=cat_ax)
-        p = jax.lax.dot_general(al[i], bcat, dn,
-                                preferred_element_type=jnp.int32)
+        p = _pin_cat_axis(jax.lax.dot_general(
+            al[i], bcat, dn, preferred_element_type=jnp.int32))
         for j in range(nj):
             # output = batch + lhs-free + rhs-free: the concatenated
             # right limbs always land on the LAST axis
@@ -859,10 +889,13 @@ def potrf_f64_blocked(A, nb: int = 512, lower: bool = True,
 # ---------------------------------------------------------------------
 
 
-def lu_ir(pp, L, U, refine: int = 4):
+def lu_ir(pp, L, U, refine: int = 4, bits: int | None = None):
     """Refine a seed factorization pp ~= L U to f64-equivalent accuracy
     (pp is the already-row-permuted panel, L (m,nb) unit-lower
-    trapezoidal, U (nb,nb) upper).
+    trapezoidal, U (nb,nb) upper). ``bits`` pins EVERY residual to one
+    limb-ladder rung (the mixed-precision IR solvers' f32x2 working
+    factorization runs one step at bits=32); None keeps the default
+    32,32,53,... ladder.
 
     Correction step: with exact E = pp - L U, G = L1^{-1} E1 U^{-1}
     gives dU = triu(G) U, dL1 = L1 stril(G) (so dL1 U + L1 dU = E1),
@@ -899,8 +932,9 @@ def lu_ir(pp, L, U, refine: int = 4):
         return jnp.matmul(a, b, preferred_element_type=f32)
 
     for r in range(refine):
-        bits = 32 if (r < 2 and refine > 2) else 53
-        E = gemm_residual(pp, L, U, bits=bits)
+        rbits = bits if bits is not None \
+            else (32 if (r < 2 and refine > 2) else 53)
+        E = gemm_residual(pp, L, U, bits=rbits)
         E32 = E.astype(f32)
         G = f32mm(f32mm(L1i, E32[:nb]), Ui)
         dU = f32mm(jnp.triu(G), U32)

@@ -40,6 +40,10 @@ OP_CLASS = {
     "trsm": "gemm", "gemm_dtd": "gemm",
     "hetrd": "herbt", "heev": "herbt", "hbrdt": "herbt",
     "gebrd": "ge2gb", "gesvd": "ge2gb", "gebrd_ge2gb": "ge2gb",
+    # mixed-precision IR solvers (ops.refine): their own phase-model
+    # classes (observability.roofline.refine_phase_model); no tile-
+    # message comm model — the factor's traffic is the inner op's
+    "posv_ir": "posv_ir", "gesv_ir": "gesv_ir", "gels_ir": "gels_ir",
 }
 
 

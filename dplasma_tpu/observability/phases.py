@@ -48,19 +48,31 @@ class PhaseLedger:
     def __init__(self):
         self.phases: Dict[str, dict] = {}
 
-    def add(self, name: str, seconds: float) -> None:
-        e = self.phases.setdefault(name, {"count": 0, "seconds": 0.0})
+    def add(self, name: str, seconds: float,
+            total: Optional[float] = None) -> None:
+        """Record one span: ``seconds`` is SELF time (disjoint across
+        the ledger — these sum to at most the attributed run);
+        ``total`` is the inclusive elapsed time (self + enclosed child
+        spans, defaulting to ``seconds`` for leaf spans) — the wall
+        time of the whole region, which is what a rate computed from
+        an ENCLOSING span (e.g. the IR solvers' ``factor``, wrapping
+        the inner sweep's panel/lookahead/... spans) must divide by."""
+        e = self.phases.setdefault(
+            name, {"count": 0, "seconds": 0.0, "total": 0.0})
         e["count"] += 1
         e["seconds"] += float(seconds)
+        e["total"] += float(seconds if total is None else total)
 
     def total(self) -> float:
         return sum(e["seconds"] for e in self.phases.values())
 
     def summary(self) -> List[dict]:
         """Phases as JSON-able rows, heaviest first (ties: by name, so
-        two identical runs serialize identically)."""
+        two identical runs serialize identically). ``measured_s`` is
+        self time; ``total_s`` the inclusive elapsed (== measured_s
+        for leaf spans)."""
         return [{"phase": name, "count": e["count"],
-                 "measured_s": e["seconds"]}
+                 "measured_s": e["seconds"], "total_s": e["total"]}
                 for name, e in sorted(self.phases.items(),
                                       key=lambda kv:
                                       (-kv[1]["seconds"], kv[0]))]
@@ -107,23 +119,44 @@ class _NoopSink:
 _NOOP = _NoopSink()
 
 
+#: enclosing-span child-time stack: spans may NEST (the IR solvers'
+#: ``factor`` span wraps the whole inner factorization, whose own
+#: sweep emits panel/lookahead/... spans) — each span records its
+#: SELF time (elapsed minus enclosed spans), so the ledger's phase
+#: seconds stay disjoint and sum to at most the attributed run
+_nest: List[float] = []
+
+
 @contextlib.contextmanager
 def span(name: str):
     """Time one phase region. Yields a sink; values the region passes
     to the sink are fenced at exit *only when profiling is active* —
-    otherwise the whole thing is a no-op (no fencing, no timing)."""
+    otherwise the whole thing is a no-op (no fencing, no timing).
+    Nested spans attribute self-time only (child seconds are
+    subtracted from the enclosing span)."""
     led = _active
     if led is None:
         yield _NOOP
         return
     sink = _Sink()
+    _nest.append(0.0)
     t0 = time.perf_counter()
     try:
         yield sink
     finally:
-        if sink.values:
-            _fence(sink.values)
-        led.add(name, time.perf_counter() - t0)
+        try:
+            if sink.values:
+                _fence(sink.values)
+        finally:
+            # balance _nest even when the fence raises (a poisoned
+            # array's block_until_ready — the failure the driver
+            # degrades to a warning): a leaked entry would corrupt
+            # every later span's child-time subtraction process-wide
+            elapsed = time.perf_counter() - t0
+            child = _nest.pop()
+            if _nest:
+                _nest[-1] += elapsed
+            led.add(name, max(elapsed - child, 0.0), total=elapsed)
 
 
 @contextlib.contextmanager

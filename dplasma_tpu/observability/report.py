@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 6``).
+"""The versioned JSON run-report (``"schema": 7``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -51,6 +51,9 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                     "relation", "expected",
                     "diagnostics": [{"kind", "message", "kernel",
                                      "detail"}]}],         # (v6)
+     "refine": [{"op", "precision", "iterations",
+                 "backward_errors": [...], "converged",
+                 "escalated", "tol"}],                     # (v7)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
@@ -61,9 +64,13 @@ lookahead/aggregation shape of the pipelined factorization sweeps);
 (--phase-profile / --peaks-file performance attribution,
 observability.phases + observability.roofline) plus the ``nruns``
 timing field; 6 adds ``"spmdcheck"`` (--spmdcheck collective-schedule
-verification of the traced SPMD program, analysis.spmdcheck). All
+verification of the traced SPMD program, analysis.spmdcheck);
+7 adds ``"refine"`` (the mixed-precision iterative-refinement
+solvers' per-solve record — working precision, iteration count,
+per-iteration normwise backward error, converged/escalated outcome,
+ops.refine). All
 additive — v1 readers of the other keys are unaffected; this reader
-accepts <= 6 (:func:`load_report` tolerates every v1-v6 vintage,
+accepts <= 7 (:func:`load_report` tolerates every v1-v7 vintage,
 filling the always-present keys).
 """
 from __future__ import annotations
@@ -76,7 +83,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 6
+REPORT_SCHEMA = 7
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -109,6 +116,7 @@ class RunReport:
         self.resilience: List[dict] = []  # per-op ladder summaries
         self.dagcheck: List[dict] = []  # --dagcheck verification (v3)
         self.spmdcheck: List[dict] = []  # --spmdcheck verification (v6)
+        self.refine: List[dict] = []    # IR-solver records (v7)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
         self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
@@ -158,6 +166,12 @@ class RunReport:
         self.spmdcheck.append(entry)
         return entry
 
+    def add_refine(self, summary: dict) -> dict:
+        """Record one mixed-precision IR solve (schema v7; see
+        ops.refine.summarize)."""
+        self.refine.append(summary)
+        return summary
+
     def add_roofline(self, entry: dict) -> dict:
         """Record one per-op roofline ledger entry (schema v5; see
         observability.roofline.op_roofline)."""
@@ -189,6 +203,8 @@ class RunReport:
             doc["dagcheck"] = self.dagcheck
         if self.spmdcheck:
             doc["spmdcheck"] = self.spmdcheck
+        if self.refine:
+            doc["refine"] = self.refine
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
         if self.roofline:
@@ -223,7 +239,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v6) loads: the schema history is purely
+    Every older vintage (v1-v7) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

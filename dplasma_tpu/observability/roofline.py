@@ -56,6 +56,40 @@ _BENCH_MXU_KEY = {"s": "f32_highest_gflops", "c": "f32_highest_gflops",
                   "d": "f64equiv_bound_gflops",
                   "z": "f64equiv_bound_gflops"}
 
+#: probed per-precision rates carried alongside the canonical keys so
+#: the mixed-precision IR phase pricing can rate each phase at ITS
+#: precision's peak (resolve_peaks keeps them when the source has them)
+_AUX_RATE_KEYS = ("f32_highest_gflops", "bf16_gflops", "int8_gops",
+                  "f64equiv_bound_gflops", "f32x2_gflops")
+
+#: MXU-rate resolution for the IR working precisions: the probed
+#: peaks key when the source carries it, else a conservative multiple
+#: of the run precision's ``mxu_gflops`` (the dd f64-equivalent bound
+#: on d-precision runs). Ratios follow the probed BENCH_r05 peaks —
+#: ~31/177/~21 TFLOP/s f32/bf16/f32x2-rung against the 8.7 TFLOP/s
+#: f64-equivalent bound — floored well below the hardware ratios so
+#: the expectation stays a lower bound.
+WP_MXU = {"bf16": ("bf16_gflops", 16.0),
+          "f32": ("f32_highest_gflops", 3.0),
+          "f32x2": ("f32x2_gflops", 2.0)}
+
+#: op classes of the mixed-precision iterative-refinement solvers
+REFINE_CLASSES = ("posv_ir", "gesv_ir", "gels_ir")
+
+
+def wp_mxu_gflops(peaks: Optional[dict], precision: str) -> float:
+    """MXU rate (GFlop/s) of an IR working precision: the probed key
+    from the peaks dict when present, else the conservative ratio of
+    ``mxu_gflops`` — always strictly above the dd rate, so a factor
+    phase priced here expects strictly less time than the dd route
+    for the same flops."""
+    p = peaks or DEFAULT_PEAKS
+    key, ratio = WP_MXU.get(precision, WP_MXU["f32"])
+    v = p.get(key)
+    if isinstance(v, (int, float)) and v > 0:
+        return float(v)
+    return ratio * float(p["mxu_gflops"])
+
 
 def resolve_peaks(path: Optional[str] = None,
                   prec: str = "s") -> Tuple[dict, str]:
@@ -82,6 +116,9 @@ def resolve_peaks(path: Optional[str] = None,
         # degrade-to-defaults contract (Driver._peaks catches it)
         raise ValueError(f"{path}: peaks section is not a JSON object")
     for key in DEFAULT_PEAKS:
+        if isinstance(raw.get(key), (int, float)):
+            peaks[key] = float(raw[key])
+    for key in _AUX_RATE_KEYS:
         if isinstance(raw.get(key), (int, float)):
             peaks[key] = float(raw[key])
     if not isinstance(raw.get("mxu_gflops"), (int, float)):
@@ -131,9 +168,62 @@ def _panel_cost(op_class: str, m: int, nb: int, itemsize: int):
     return fl, 2.0 * m * nb * itemsize
 
 
+def refine_phase_model(op_class: str, M: int, N: int, nrhs: int,
+                       itemsize: int, precision: str,
+                       peaks: Optional[dict] = None
+                       ) -> Dict[str, dict]:
+    """Per-phase demands of the mixed-precision IR solvers
+    (:mod:`dplasma_tpu.ops.refine`): the O(n³) ``factor`` and the
+    per-iteration ``solve``/``correct`` triangular sweeps priced at
+    the WORKING-precision MXU rate (f32 storage bytes), the
+    per-iteration ``residual`` at the dd f64-equivalent rate
+    (``peaks["mxu_gflops"]`` on a d-precision run) with f64 bytes.
+    ``solve``/``residual``/``correct`` are per-dispatch demands
+    (``per_count``): :func:`attribute_phases` scales them by the
+    measured span count, so the expectation tracks the iterations the
+    engine actually ran rather than a guessed budget."""
+    wp = wp_mxu_gflops(peaks, precision)
+    n3 = float(N) ** 3
+    if op_class == "posv_ir":
+        fac = n3 / 3.0
+    elif op_class == "gesv_ir":
+        fac = 2.0 * n3 / 3.0
+    else:   # gels_ir: QR of the M x N operand
+        fac = 2.0 * float(M) * N * N - 2.0 * n3 / 3.0
+    # one correction solve: two triangular sweeps against the cached
+    # factor (gels' semi-normal solves are two N x N sweeps too)
+    solve_fl = 2.0 * float(N) * N * nrhs
+    # one residual r = b - A x (gels adds the A^T r projection)
+    resid_fl = (2.0 if op_class != "gels_ir" else 4.0) \
+        * float(M) * N * nrhs
+    wp_item = 4.0   # the working factor/operands live in f32 storage
+    return {
+        # inclusive: the factor span ENCLOSES the inner factorization
+        # sweep (whose panel/lookahead/... child spans hold the work),
+        # so its n^3 demand must be judged against the inclusive wall
+        # time, not the thin self-time wrapper
+        "factor": {"flops": fac, "hbm_bytes": float(M) * N * wp_item,
+                   "mxu_gflops": wp, "inclusive": True},
+        "solve": {"flops": solve_fl, "mxu_gflops": wp,
+                  "hbm_bytes": (float(N) * N
+                                + 2.0 * N * nrhs) * wp_item,
+                  "per_count": True},
+        "correct": {"flops": solve_fl, "mxu_gflops": wp,
+                    "hbm_bytes": (float(N) * N
+                                  + 2.0 * N * nrhs) * wp_item,
+                    "per_count": True},
+        "residual": {"flops": resid_fl,
+                     "hbm_bytes": (float(M) * N
+                                   + 2.0 * M * nrhs) * itemsize,
+                     "per_count": True},
+    }
+
+
 def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
                 itemsize: int, lookahead: int = 1,
-                agg_depth: int = 1) -> Optional[Dict[str, list]]:
+                agg_depth: int = 1, nrhs: int = 1,
+                peaks: Optional[dict] = None
+                ) -> Optional[Dict[str, list]]:
     """Per-phase ``{name: [flops, hbm_bytes, dispatches]}`` demands.
 
     Mirrors the control flow of :func:`dplasma_tpu.ops._sweep.
@@ -143,8 +233,17 @@ def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
     code emits (``panel`` / ``lookahead`` / ``far_flush`` / ``catchup``
     / ``assemble``). The total flops across phases is invariant in the
     pipeline shape (the split moves work between phases, never creates
-    it). Unmodelled op classes return None.
+    it). The mixed-precision IR op classes route to
+    :func:`refine_phase_model` (dict-valued demands carrying per-phase
+    MXU-rate overrides), with the working precision resolved from the
+    live MCA ``ir.*`` configuration — the same source the solver
+    reads. Unmodelled op classes return None.
     """
+    if op_class in REFINE_CLASSES:
+        from dplasma_tpu.ops import refine as _refine
+        prec_w, _, _ = _refine.ir_params()
+        return refine_phase_model(op_class, M, N, max(int(nrhs), 1),
+                                  itemsize, prec_w, peaks)
     if op_class not in ("getrf", "geqrf", "potrf") or nb <= 0:
         return None
     la = max(int(lookahead), 0)
@@ -244,12 +343,32 @@ def attribute_phases(ledger, model: Optional[dict],
 
     Phases the model doesn't know get a latency-only expectation (the
     dispatch count is still a real lower bound), so every measured
-    span carries a bound label."""
+    span carries a bound label. A dict-valued demand
+    (:func:`refine_phase_model`) may scale per measured dispatch
+    (``per_count``), override the MXU rate (``mxu_gflops`` — how
+    the IR factor phase gets priced at the WORKING-precision peak
+    while the residual stays at the dd rate), and declare itself
+    ``inclusive``: its demand covers the whole region INCLUDING
+    enclosed child spans (the IR ``factor`` span wraps the inner
+    factorization sweep, whose panel/lookahead/... spans carry the
+    actual work), so achieved_frac divides by the ledger's inclusive
+    ``total_s`` instead of the self ``measured_s``."""
     out = []
     for row in ledger.summary():
         name, meas = row["phase"], row["measured_s"]
         demand = (model or {}).get(name)
-        if demand is not None:
+        if isinstance(demand, dict):
+            scale = row["count"] if demand.get("per_count") else 1
+            pk = dict(peaks or DEFAULT_PEAKS)
+            if demand.get("mxu_gflops"):
+                pk["mxu_gflops"] = demand["mxu_gflops"]
+            exp, bound, _ = expected_seconds(
+                flops=demand.get("flops", 0.0) * scale,
+                hbm_bytes=demand.get("hbm_bytes", 0.0) * scale,
+                dispatches=row["count"], peaks=pk)
+            if demand.get("inclusive"):
+                meas = row.get("total_s", meas)
+        elif demand is not None:
             exp, bound, _ = expected_seconds(
                 flops=demand[0], hbm_bytes=demand[1],
                 dispatches=row["count"], peaks=peaks)

@@ -1,7 +1,7 @@
 from dplasma_tpu.ops import (aux, blas3, checks, eig, gemm, generators,
                              hqr, info, ldl, lu, map as map_ops, matgen,
-                             norms, potrf, qr, rbt)
+                             norms, potrf, qr, rbt, refine)
 
 __all__ = ["aux", "blas3", "checks", "eig", "gemm", "generators", "hqr",
            "info", "ldl", "lu", "map_ops", "matgen", "norms", "potrf",
-           "qr", "rbt"]
+           "qr", "rbt", "refine"]

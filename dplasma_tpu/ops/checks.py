@@ -68,6 +68,45 @@ def check_axmb(A0: TileMatrix, b: TileMatrix, x: TileMatrix,
     return float(val), bool(val < THRESHOLD)
 
 
+def check_solve(A0: TileMatrix, b: TileMatrix, x: TileMatrix,
+                uplo: str | None = None, scale: float = 100.0):
+    """Normwise backward error ``||b - A x|| / (||A|| ||x|| + ||b||)``
+    against a dtype-scaled threshold (``scale * eps``, default the
+    100·u floor the mixed-precision IR solvers converge to) — the
+    measure the IR convergence test itself uses, unlike
+    :func:`check_axmb`'s eps·N-scaled residual. ``uplo`` set means A0
+    stores a Hermitian triangle. Max-norms throughout (consistent with
+    the engine's test); the ``_tiny`` clamp keeps a zero-norm system
+    finite, never 0/0."""
+    if uplo:
+        a = norms._sym_full(A0, uplo, conj=True)
+    else:
+        a = A0.to_dense()
+    bd = b.to_dense()
+    xd = x.to_dense()
+    r = bd - blas.dot(a, xd)
+    den = (jnp.max(jnp.abs(a)) * jnp.max(jnp.abs(xd))
+           + jnp.max(jnp.abs(bd)))
+    val = jnp.max(jnp.abs(r)) / jnp.maximum(den, _tiny(A0.dtype))
+    return float(val), bool(val < scale * _eps(A0.dtype))
+
+
+def check_gels(A0: TileMatrix, b: TileMatrix, xd):
+    """Least-squares optimality ``||A^H (A x - b)|| / (||A||_F^2 ||x||_F
+    eps max(M,N))`` — the gels testers' normal-equations gate (the LS
+    residual itself does not vanish; its projection onto range(A)
+    must). ``xd`` is the dense N-row solution; rows of ``b`` beyond
+    A's M are ignored (the workspace rows of the gels contract)."""
+    Ad = A0.to_dense()
+    M, N = A0.desc.M, A0.desc.N
+    res = blas.dot(Ad, xd[:N]) - b.to_dense()[:M]
+    res = blas.dot(Ad, res, ta=True, conj_a=True)
+    nrm = jnp.linalg.norm(Ad) ** 2 * jnp.linalg.norm(xd[:N])
+    den = nrm * _eps(A0.dtype) * max(M, N)
+    val = jnp.linalg.norm(res) / jnp.maximum(den, _tiny(A0.dtype))
+    return float(val), bool(val < THRESHOLD)
+
+
 def check_gemm(Cref, C):
     """Relative max-norm discrepancy between two tile matrices."""
     a = Cref.to_dense()

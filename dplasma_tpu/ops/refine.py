@@ -1,0 +1,592 @@
+"""Mixed-precision iterative-refinement solvers: factor low, refine to
+f64-equivalent accuracy.
+
+The bench ladder (BENCH_r05) shows the f64-equivalent routes paying the
+full Ozaki dd-GEMM cost (~36 int8 products per matmul) for every flop
+of the O(n^3) factorization, while the probed peaks leave a 5-20x
+ceiling on the table (bf16/int8 MXU rates vs the f64-equiv bound).
+Mixed-precision iterative refinement (Carson & Higham's three-precision
+analysis; Haidar et al.'s tensor-core IR solvers, SC'18) inverts that
+cost structure: factor A ONCE in a cheap working precision at the MXU
+rate, then recover an f64-accurate *solution* by looping the O(n^2)
+refinement step
+
+    r = b - A x          (f64-equivalent, kernels.dd.gemm_residual)
+    d = solve(F_w, r)    (cached low-precision factors)
+    x = x + d            (x carried in f64 / dd representation)
+
+until the normwise backward error ||r|| / (||A|| ||x|| + ||b||) reaches
+the ~100*u_f64 floor. Only the residual pays dd cost; the factorization
+runs at the working-precision rate.
+
+Working precisions (MCA ``ir.precision``, default ``f32``):
+
+* ``bf16`` — operands and factors are *rounded through bf16 storage*
+  (compute accumulates in f32, exactly the MXU's bf16-input contract);
+  error contracts ~kappa*u_bf16 per step, so more iterations;
+* ``f32``  — plain f32 factorization (one MXU pass per product);
+* ``f32x2`` — double-single: the f32 factor takes ONE extra
+  refinement step whose residual rides :func:`kernels.dd.gemm_residual`
+  at ``bits=32`` (the nl=5 limb ladder rung, ~2.4x the full-dd rate),
+  giving ~2x f32 factor accuracy and near-one-iteration convergence.
+
+Solves ride the EXISTING blocked paths (``ops.potrf.potrs``,
+``ops.lu.getrs``, ``ops.blas3.trsm``) at the factor's dtype;
+``gels_ir`` refines least-squares via semi-normal equations on the QR
+``R`` factor (Bjorck: R^T R d = A^T r — no Q needed per iteration).
+
+Control flow is dual-mode, like every dd route in the repo:
+
+* **eager** (concrete inputs — the bench path and the driver's
+  ``--phase-profile`` attributed pass): a host loop with an early exit
+  on convergence, divergence detection (non-finite or stalled backward
+  error), and escalation by actually *running* the full-precision
+  route (the dd factorization on MXU backends);
+* **traced** (inside ``jax.jit`` — the drivers' timed loop): exactly
+  ``max_iters`` masked refinement steps (converged solutions stop
+  updating via ``where``), with escalation as a ``lax.cond`` over the
+  full-precision solve so divergence still produces a correct answer
+  in one executable.
+
+Non-convergence *reclassifies* rather than fails: the escalation rung
+re-solves with the full f64-equivalent factorization (the route the
+repo already trusts), mirroring the PR 2 remediation ladder's
+algorithm-escalation step — and the driver bodies additionally wire
+that same escape as a ladder ``fallbacks`` rung, so a run whose IR
+output is unhealthy walks the ladder like any other fault. The
+non-finite census on the backward error doubles as the convergence
+guard (a NaN residual is divergence, not a verdict).
+
+Every stage carries a phase span (``factor`` / ``solve`` /
+``residual`` / ``correct`` / ``escalate``) for the PR 5 attribution
+ledger; :func:`dplasma_tpu.observability.roofline.refine_phase_model`
+prices ``factor`` at the working-precision MXU rate and ``residual``
+at the dd rate.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+from dplasma_tpu import utils
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.kernels import dd as _dd
+from dplasma_tpu.observability import phases
+from dplasma_tpu.ops import blas3, norms
+from dplasma_tpu.utils import config as _cfg
+
+#: supported working precisions, cheapest-to-strongest
+PRECISIONS = ("bf16", "f32", "f32x2")
+
+_cfg.mca_register(
+    "ir.precision", "f32",
+    "Working precision of the mixed-precision IR solvers "
+    "(posv_ir/gesv_ir/gels_ir): bf16 (operands/factors rounded "
+    "through bf16 storage — the MXU's native input width), f32, or "
+    "f32x2 (double-single: the f32 factor takes one extra refinement "
+    "step on the kernels.dd bits=32 limb ladder rung).")
+_cfg.mca_register(
+    "ir.max_iters", "10",
+    "Refinement-iteration budget of the IR solvers; a solve that has "
+    "not reached ir.tol within the budget escalates to the full "
+    "f64-equivalent factorization route.")
+_cfg.mca_register(
+    "ir.tol", "0",
+    "Normwise-backward-error convergence target of the IR solvers "
+    "(||b-Ax|| / (||A|| ||x|| + ||b||)); 0 = auto, 100x the f64 unit "
+    "roundoff (the check_solve acceptance floor).")
+
+
+def ir_params(precision=None, max_iters=None, tol=None, eps=None):
+    """Resolve the IR configuration: explicit args win, else the MCA
+    ``ir.*`` tier. Returns ``(precision, max_iters, tol)`` with the
+    auto tolerance expanded to ``100*eps`` (``eps`` defaults to f64
+    unit roundoff)."""
+    p = (precision if precision is not None
+         else (_cfg.mca_get("ir.precision") or "f32")).lower()
+    if p not in PRECISIONS:
+        raise ValueError(
+            f"ir.precision {p!r} not in {PRECISIONS}")
+    n = max_iters if max_iters is not None \
+        else _cfg.mca_get_int("ir.max_iters", 10)
+    t = tol
+    if t is None:
+        try:
+            t = float(_cfg.mca_get("ir.tol", "0"))
+        except ValueError:
+            t = 0.0
+    if t <= 0:
+        t = 100.0 * (2.0 ** -52 if eps is None else eps)
+    return p, max(int(n), 1), float(t)
+
+
+def _round_wp(x, precision: str):
+    """Round an array through the working precision's STORAGE width.
+
+    bf16 rounds through bfloat16 (then holds f32 for the compute
+    kernels — the MXU accumulates bf16 inputs in f32); f32/f32x2 cast
+    to f32 (the f32x2 extra accuracy comes from the factor-refinement
+    step, not the storage)."""
+    f32 = jnp.float32
+    if precision == "bf16":
+        return x.astype(jnp.bfloat16).astype(f32)
+    return x.astype(f32)
+
+
+def _tile(dense, like: TileMatrix) -> TileMatrix:
+    return TileMatrix.from_dense(dense, like.desc.mb, like.desc.nb,
+                                 like.desc.dist)
+
+
+def _maxabs(x):
+    return jnp.max(jnp.abs(x))
+
+
+# ---------------------------------------------------------------------
+# The refinement engine
+# ---------------------------------------------------------------------
+
+def ir_solve(x, *, residual, correct, backward, escalate, tol: float,
+             max_iters: int, eager=None):
+    """The generic iterative-refinement engine every solver here rides
+    (and the extension point for new workloads): ``residual(x) -> r``
+    (f64-equivalent), ``correct(r) -> d`` (working-precision solve,
+    f64 out), ``backward(r, x) -> scalar`` (normwise backward error),
+    ``escalate() -> x`` (full-precision route; None disables).
+
+    Eager mode (the default when ``x`` is concrete) runs a host loop
+    with early exit + divergence detection (non-finite or
+    non-contracting backward error); traced mode runs exactly
+    ``max_iters`` masked steps and folds escalation into a
+    ``lax.cond``. Returns ``(x, info)`` with ``info`` a pytree of
+    arrays: ``backward_errors`` (fixed length ``max_iters + 1``,
+    padded with -1 past the executed iterations — a FINITE "no
+    verdict" sentinel, never NaN: the driver's resilience health scan
+    censuses non-finites across the whole output pytree, and a healthy
+    early-converging solve must not trip it; a non-finite measured
+    error also records as -1, the divergence story lives in
+    ``converged``/``escalated``), ``iterations``, ``converged``,
+    ``escalated``."""
+    if eager is None:
+        eager = utils.is_concrete(x)
+    pad = jnp.asarray(-1.0, x.dtype)
+    if eager:
+        bwds = []
+        converged = False
+        nsolves = 0
+        prev = None
+        for _ in range(max_iters):
+            with phases.span("residual") as _f:
+                r = _f(residual(x))
+            bwd = float(backward(r, x))
+            bwds.append(bwd)
+            if bwd <= tol:
+                converged = True
+                break
+            if bwd != bwd or (prev is not None and bwd >= prev):
+                # divergence guard (the ABFT-style non-finite census
+                # plus a no-progress check): stop burning iterations,
+                # the escalation rung owns this solve now
+                break
+            prev = bwd
+            with phases.span("correct") as _f:
+                x = _f(x + correct(r))
+            nsolves += 1
+        else:
+            # budget exhausted right after a correction: that corrected
+            # x deserves its convergence verdict before the (expensive)
+            # escalation rung re-factors — a solve converging at exactly
+            # max_iters steps is a convergence, not a divergence
+            with phases.span("residual") as _f:
+                r = _f(residual(x))
+            bwd = float(backward(r, x))
+            bwds.append(bwd)
+            converged = bwd <= tol
+        escalated = False
+        if not converged and escalate is not None:
+            # the escalated x is the trusted full-precision route's
+            # answer; its quality is the testers' -x check's business,
+            # not an IR iteration — the history keeps the fixed
+            # max_iters+1 layout of the traced mode
+            with phases.span("escalate") as _f:
+                x = _f(escalate())
+            escalated = True
+        hist = [jnp.asarray(b if math.isfinite(b) else -1.0, x.dtype)
+                for b in bwds]
+        hist += [pad] * (max_iters + 1 - len(hist))
+        info = {"backward_errors": jnp.stack(hist),
+                "iterations": jnp.asarray(nsolves, jnp.int32),
+                "converged": jnp.asarray(converged),
+                "escalated": jnp.asarray(escalated)}
+        return x, info
+    # traced: fixed-trip masked loop (the timed driver path). Work
+    # after convergence is masked, not skipped — the executable's
+    # shape is data-independent.
+    done = jnp.asarray(False)
+    iters = jnp.asarray(0, jnp.int32)
+    hist = []
+    for _ in range(max_iters):
+        r = residual(x)
+        bwd = backward(r, x)
+        hist.append(jnp.where(done | ~jnp.isfinite(bwd), pad,
+                              bwd.astype(x.dtype)))
+        newly = bwd <= tol
+        d = correct(r)
+        x = jnp.where(done | newly, x, x + d)
+        iters = iters + jnp.where(done | newly, 0, 1).astype(jnp.int32)
+        done = done | newly
+    # the budget's final correction gets its convergence verdict too
+    # (one O(n^2) residual — without it a solve converging at exactly
+    # max_iters steps would take the full-factorization escalation)
+    r = residual(x)
+    bwd = backward(r, x)
+    hist.append(jnp.where(done | ~jnp.isfinite(bwd), pad,
+                          bwd.astype(x.dtype)))
+    done = done | (bwd <= tol)
+    if escalate is not None:
+        x = lax.cond(done, lambda op: op, lambda op: escalate(), x)
+    info = {"backward_errors": jnp.stack(hist), "iterations": iters,
+            "converged": done,
+            "escalated": (jnp.asarray(escalate is not None) & ~done)}
+    return x, info
+
+
+def _backward_fn(anorm, bnorm, tiny):
+    def backward(r, x):
+        return _maxabs(r) / jnp.maximum(
+            anorm * _maxabs(x) + bnorm, tiny)
+    return backward
+
+
+def _factor_refine_chol(af, L32, f64t):
+    """One f64-equivalent refinement step of a whole-matrix Cholesky
+    factor on the dd bits=32 ladder rung: E = A - L L^T exact,
+    correction L <- L (I + Phi(L^-1 E L^-T)) in f32 (second order) —
+    the :func:`kernels.dd._potrf_tile_ir` step at matrix scale. This
+    IS the f32x2 working-precision factorization."""
+    f32 = jnp.float32
+    n = L32.shape[0]
+    L = jnp.tril(L32).astype(f64t)
+    E = _dd.gemm_residual(af.astype(f64t), L, L.T, bits=32)
+    Li = lax.linalg.triangular_solve(
+        jnp.tril(L32), jnp.eye(n, dtype=f32), left_side=True,
+        lower=True)
+    M = jnp.matmul(jnp.matmul(Li, E.astype(f32),
+                              preferred_element_type=f32),
+                   Li.T, preferred_element_type=f32)
+    phi = jnp.tril(M, -1) + 0.5 * jnp.diag(jnp.diag(M))
+    corr = jnp.matmul(jnp.tril(L32), phi, preferred_element_type=f32)
+    return jnp.tril(L + corr.astype(f64t))
+
+
+def _factor_refine_r(ad, R32, f64t):
+    """One bits=32 refinement step of the QR ``R`` factor via its Gram
+    identity R^T R = A^T A (the CholeskyQR2 correction, upper form):
+    E = G - R^T R exact on the dd bits=32 rung, correction
+    R <- (I + Phi(R^-T E R^-1)) R in f32 — the f32x2 working-precision
+    R for the semi-normal-equation solves."""
+    f32 = jnp.float32
+    n = R32.shape[0]
+    R = jnp.triu(R32).astype(f64t)
+    G = _dd.gemm_f64(ad.T, ad, bits=32)
+    E = _dd.gemm_residual(G, R.T, R, bits=32)
+    Ri = lax.linalg.triangular_solve(
+        jnp.triu(R32), jnp.eye(n, dtype=f32), left_side=True,
+        lower=False)
+    M = jnp.matmul(jnp.matmul(Ri.T, E.astype(f32),
+                              preferred_element_type=f32),
+                   Ri, preferred_element_type=f32)
+    phi = jnp.triu(M, 1) + 0.5 * jnp.diag(jnp.diag(M))
+    corr = jnp.matmul(phi, jnp.triu(R32), preferred_element_type=f32)
+    return jnp.triu(R + corr.astype(f64t))
+
+
+def _require_f64(A: TileMatrix, who: str):
+    import jax
+    if A.dtype != jnp.float64:
+        raise TypeError(f"{who} refines to f64-equivalent accuracy: "
+                        f"input must be float64, got {A.dtype}")
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            f"{who} requires jax_enable_x64 (the dd residuals would "
+            "silently truncate to f32)")
+
+
+# ---------------------------------------------------------------------
+# User-facing solvers
+# ---------------------------------------------------------------------
+
+def posv_ir(A: TileMatrix, B: TileMatrix, uplo: str = "L", *,
+            precision=None, max_iters=None, tol=None,
+            escalate: bool = True):
+    """SPD solve A X = B by Cholesky in a low working precision +
+    iterative refinement to f64-equivalent backward error.
+
+    ``A`` stores the ``uplo`` triangle (posv contract); returns
+    ``(X, info)`` with ``X`` f64 and ``info`` the refinement record
+    (:func:`summarize` turns it into the run-report ``"refine"``
+    entry). ``escalate=False`` disables the full-precision fallback
+    (the caller owns divergence)."""
+    from dplasma_tpu.ops import potrf as potrf_mod
+    _require_f64(A, "posv_ir")
+    prec, iters, tol_ = ir_params(precision, max_iters, tol)
+    f64t = A.dtype
+    af = norms._sym_full(A, uplo, conj=True)
+    bd = B.to_dense().astype(f64t)
+    tiny = float(jnp.finfo(f64t).tiny)
+    eager = utils.is_concrete(A.data)
+
+    with phases.span("factor") as _f:
+        Aw = _tile(_round_wp(af, prec), A)
+        Lw = potrf_mod.potrf(Aw, "L")
+        if prec == "bf16":
+            Lw = Lw.like(_round_wp(Lw.data, prec))
+        elif prec == "f32x2":
+            Lw = _tile(_factor_refine_chol(af, Lw.to_dense(), f64t), A)
+        _f(Lw.data)
+
+    def solve_w(rhs):
+        out = potrf_mod.potrs(Lw, _tile(_round_wp(rhs, prec)
+                                        if prec != "f32x2" else rhs,
+                                        B), "L")
+        return out.to_dense().astype(f64t)
+
+    with phases.span("solve") as _f:
+        x = _f(solve_w(bd))
+    backward = _backward_fn(_maxabs(af), _maxabs(bd), tiny)
+
+    def escalate_fn():
+        _, X = potrf_mod.posv(A, B, uplo)
+        return X.to_dense().astype(f64t)
+
+    x, info = ir_solve(
+        x,
+        residual=lambda xv: _dd.gemm_residual(bd, af, xv),
+        correct=solve_w, backward=backward,
+        escalate=escalate_fn if escalate else None,
+        tol=tol_, max_iters=iters, eager=eager)
+    return _tile(x, B), info
+
+
+def gesv_ir(A: TileMatrix, B: TileMatrix, *, precision=None,
+            max_iters=None, tol=None, escalate: bool = True):
+    """General solve A X = B by pivoted LU in a low working precision +
+    iterative refinement to f64-equivalent backward error. Returns
+    ``(X, info)`` (see :func:`posv_ir`).
+
+    The factor rides :func:`~dplasma_tpu.ops.lu.getrf_ptgpanel`: under
+    an active device mesh that is the realized distributed panel (the
+    grid-correct pivoted route); single-process grids take the
+    identical-contract :func:`~dplasma_tpu.ops.lu.getrf_1d` path."""
+    from dplasma_tpu.ops import lu as lu_mod
+    _require_f64(A, "gesv_ir")
+    prec, iters, tol_ = ir_params(precision, max_iters, tol)
+    f64t = A.dtype
+    ad = A.to_dense().astype(f64t)
+    bd = B.to_dense().astype(f64t)
+    tiny = float(jnp.finfo(f64t).tiny)
+    eager = utils.is_concrete(A.data)
+
+    with phases.span("factor") as _f:
+        Aw = _tile(_round_wp(ad, prec), A)
+        LUw, perm = lu_mod.getrf_ptgpanel(Aw)
+        if prec == "bf16":
+            LUw = LUw.like(_round_wp(LUw.data, prec))
+        elif prec == "f32x2":
+            # refine L, U for the FIXED pivot order on the bits=32
+            # rung (kernels.dd.lu_ir with a pinned single-step ladder)
+            pk = LUw.data
+            r_ = jnp.arange(pk.shape[0])
+            L32 = jnp.tril(pk, -1).astype(f64t).at[
+                r_, r_].set(jnp.ones((), f64t))
+            U32 = jnp.triu(pk).astype(f64t)
+            pp = A.pad_diag().data.astype(f64t)[perm]
+            L, U = _dd.lu_ir(pp, L32, U32, refine=1, bits=32)
+            LUw = LUw.like(jnp.triu(U) + jnp.tril(L, -1))
+        _f(LUw.data)
+
+    def solve_w(rhs):
+        out = lu_mod.getrs("N", LUw, perm,
+                           _tile(_round_wp(rhs, prec)
+                                 if prec != "f32x2" else rhs, B))
+        return out.to_dense().astype(f64t)
+
+    with phases.span("solve") as _f:
+        x = _f(solve_w(bd))
+    backward = _backward_fn(_maxabs(ad), _maxabs(bd), tiny)
+
+    def escalate_fn():
+        # eager: the grid-correct distributed panel. Traced: this body
+        # lands inside ir_solve's lax.cond, whose branches must carry
+        # NO explicit collectives (analysis.spmdcheck's rank-divergent-
+        # cond rule would reject the program --spmdcheck verifies) —
+        # the 1-D route is GSPMD-partitioned, so its schedule belongs
+        # to XLA and the cond stays structurally uniform
+        if eager:
+            F, p = lu_mod.getrf_ptgpanel(A)
+        else:
+            F, p = lu_mod.getrf_1d(A)
+        X = lu_mod.getrs("N", F, p, B)
+        return X.to_dense().astype(f64t)
+
+    x, info = ir_solve(
+        x,
+        residual=lambda xv: _dd.gemm_residual(bd, ad, xv),
+        correct=solve_w, backward=backward,
+        escalate=escalate_fn if escalate else None,
+        tol=tol_, max_iters=iters, eager=eager)
+    return _tile(x, B), info
+
+
+def gels_ir(A: TileMatrix, B: TileMatrix, *, precision=None,
+            max_iters=None, tol=None, escalate: bool = True):
+    """Overdetermined least squares min ||A X - B|| (M >= N) by QR in a
+    low working precision + iterative refinement via SEMI-NORMAL
+    equations on the R factor: each correction solves
+    R^T R d = A^T r with two triangular sweeps — no Q application per
+    iteration (Bjorck's corrected semi-normal equations; the one
+    bits=32-refined R of the f32x2 precision is exactly the CSNE
+    stabilizer). Convergence is measured on the PROJECTED residual
+    ||A^T r|| / (||A|| (||A|| ||x|| + ||b||)) — the LS residual itself
+    does not vanish. Returns ``(X, info)`` with ``X`` N-row f64."""
+    from dplasma_tpu.ops import qr as qr_mod
+    _require_f64(A, "gels_ir")
+    assert A.desc.M >= A.desc.N, \
+        "gels_ir: overdetermined (M >= N) only; use ops.qr.gels"
+    prec, iters, tol_ = ir_params(precision, max_iters, tol)
+    f64t = A.dtype
+    N = A.desc.N
+    ad = A.to_dense().astype(f64t)
+    bd = B.to_dense().astype(f64t)[:A.desc.M]
+    tiny = float(jnp.finfo(f64t).tiny)
+    eager = utils.is_concrete(A.data)
+
+    with phases.span("factor") as _f:
+        Aw = _tile(_round_wp(ad, prec), A)
+        Afw, Tfw = qr_mod.geqrf(Aw)
+        r32 = jnp.triu(Afw.to_dense()[:N, :N])
+        if prec == "bf16":
+            r32 = _round_wp(r32, prec)
+        if prec == "f32x2":
+            Rw = _tile(_factor_refine_r(ad, r32, f64t), A)
+        else:
+            Rw = _tile(r32, A)
+        _f(Rw.data)
+
+    def snd_solve(s):
+        """d = R^{-1} R^{-T} s via the existing blocked trsm path."""
+        St = _tile(s if prec == "f32x2" else _round_wp(s, prec), Rw)
+        y = blas3.trsm(1.0, Rw, St, side="L", uplo="U", trans="T")
+        d = blas3.trsm(1.0, Rw, y, side="L", uplo="U", trans="N")
+        return d.to_dense().astype(f64t)
+
+    with phases.span("solve") as _f:
+        # x0 from the semi-normal equations directly (R^T R x = A^T b)
+        x = _f(snd_solve(_dd.gemm_f64(ad.T, bd)))
+    anorm = _maxabs(ad)
+    bnorm = _maxabs(bd)
+
+    def residual(xv):
+        # projected residual s = A^T (b - A x), both products
+        # f64-equivalent (dd limb GEMMs)
+        r = _dd.gemm_residual(bd, ad, xv)
+        return _dd.gemm_f64(ad.T, r)
+
+    def backward(s, xv):
+        return _maxabs(s) / jnp.maximum(
+            anorm * (anorm * _maxabs(xv) + bnorm), tiny)
+
+    def escalate_fn():
+        X = qr_mod.gels(A, B)
+        return X.to_dense().astype(f64t)[:N]
+
+    x, info = ir_solve(
+        x, residual=residual, correct=snd_solve, backward=backward,
+        escalate=escalate_fn if escalate else None,
+        tol=tol_, max_iters=iters, eager=eager)
+    return _tile(x, B), info
+
+
+# ---------------------------------------------------------------------
+# Reporting helpers
+# ---------------------------------------------------------------------
+
+def summarize(info, *, op: str, precision=None, tol=None) -> dict:
+    """Fold a (concrete) refinement ``info`` pytree into the
+    run-report schema-v7 ``"refine"`` entry."""
+    import numpy as np
+    prec, _, tol_ = ir_params(precision, None, tol)
+    # -1 is the engine's finite "no verdict" padding (and the record
+    # of a non-finite measurement); real backward errors are >= 0
+    hist = [float(v) for v in np.asarray(info["backward_errors"])
+            if v >= 0]
+    return {"op": op, "precision": prec,
+            "iterations": int(np.asarray(info["iterations"])),
+            "backward_errors": hist,
+            "converged": bool(np.asarray(info["converged"])),
+            "escalated": bool(np.asarray(info["escalated"])),
+            "tol": tol_}
+
+
+# ---------------------------------------------------------------------
+# Analytic DAG (factor + solve + refine task structure)
+# ---------------------------------------------------------------------
+
+def dag(A: TileMatrix, kind: str = "posv", recorder=None, *,
+        iterations=None):
+    """Record the IR solver's task structure — ``factor`` (the
+    working-precision factorization), ``solve`` (the initial
+    low-precision solve), then per refinement iteration ``residual(i)``
+    (f64-equivalent r = b - A x) and ``correct(i)`` (the cached-factor
+    correction solve) — with operand-tagged tile declarations
+    (``A``/``B``/``F``/``X``/``R``) so :mod:`dplasma_tpu.analysis.
+    dagcheck` proves the chain race-free, flow-covered and
+    owner-consistent.
+
+    The granularity is deliberately the XLA dispatch level (each stage
+    is a handful of fused executables, not a tile sweep — the factor's
+    own tile DAG is the underlying op's ``dag()``); ``iterations``
+    defaults to the MCA ``ir.max_iters`` budget, the trace-time trip
+    count of the compiled masked loop."""
+    from dplasma_tpu import native
+    from dplasma_tpu.utils import profiling
+    rec = recorder if recorder is not None else profiling.recorder
+    if iterations is None:
+        _, it_budget, _ = ir_params()
+    else:
+        it_budget = max(int(iterations), 1)
+    MT, NT = A.desc.MT, A.desc.NT
+    ranks = native.rank_grid(A.desc.dist, MT, NT)
+    rank0 = int(ranks[0, 0])
+    a_tiles = [("A", i, j) for i in range(MT) for j in range(NT)]
+    f_tiles = [("F", i, j) for i in range(MT) for j in range(NT)]
+    x_tiles = [("X", i, 0) for i in range(MT)]
+    b_tiles = [("B", i, 0) for i in range(MT)]
+    r_tiles = [("R", i, 0) for i in range(MT)]
+    if getattr(rec, "meta", None) is not None:
+        rec.meta["refine"] = {"kind": kind, "iterations": it_budget}
+
+    pri = 3 * (it_budget + 1)
+    fac = rec.task("factor", 0, priority=pri + 2, rank=rank0,
+                   reads=a_tiles, writes=f_tiles)
+    sol = rec.task("solve", 0, priority=pri + 1, rank=rank0,
+                   reads=f_tiles + b_tiles, writes=x_tiles)
+    rec.edge(fac, sol, "F")
+    prev_x = sol
+    for i in range(it_budget):
+        rt = rec.task("residual", i, priority=pri - 3 * i,
+                      rank=rank0,
+                      reads=a_tiles + b_tiles + x_tiles,
+                      writes=r_tiles)
+        rec.edge(prev_x, rt, "X")
+        ct = rec.task("correct", i, priority=pri - 3 * i - 1,
+                      rank=rank0,
+                      reads=f_tiles + r_tiles + x_tiles,
+                      writes=x_tiles)
+        rec.edge(rt, ct, "R")
+        rec.edge(fac, ct, "F")
+        rec.edge(prev_x, ct, "X")
+        prev_x = ct
+    return rec

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 6
+    assert doc["schema"] == REPORT_SCHEMA == 7
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -118,6 +118,11 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
             "roofline": []},
         6: {"schema": 6, "name": "v6", "ops": [], "metrics": [],
             "spmdcheck": []},
+        7: {"schema": 7, "name": "v7", "ops": [], "metrics": [],
+            "refine": [{"op": "testing_dposv_ir", "precision": "f32",
+                        "iterations": 2, "backward_errors": [1e-8],
+                        "converged": True, "escalated": False,
+                        "tol": 2.2e-14}]},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -368,7 +373,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 6
+    assert doc["schema"] == 7
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

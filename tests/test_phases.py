@@ -59,6 +59,61 @@ def test_profiling_nests_and_restores():
     assert "a" in outer.phases
 
 
+def test_nested_spans_self_vs_inclusive_time():
+    """An enclosing span (the IR solvers' ``factor`` wrapping the
+    inner sweep) records SELF time disjoint from its children —
+    the ledger still sums to at most the wall time — while its
+    ``total_s`` keeps the inclusive elapsed, which is what rates for
+    the whole region must divide by."""
+    import time as _time
+    with phases.profiling() as led:
+        with phases.span("factor"):
+            with phases.span("panel"):
+                _time.sleep(0.02)
+            with phases.span("panel"):
+                _time.sleep(0.02)
+    fac, pan = led.phases["factor"], led.phases["panel"]
+    assert pan["count"] == 2
+    # child time subtracted from the parent: self < inclusive
+    assert fac["seconds"] < fac["total"]
+    assert fac["total"] >= pan["seconds"] >= 0.04 - 1e-3
+    # ledger stays disjoint: self seconds sum to <= inclusive elapsed
+    assert led.total() <= fac["total"] + 1e-6
+    rows = {r["phase"]: r for r in led.summary()}
+    assert rows["factor"]["measured_s"] == pytest.approx(
+        fac["seconds"])
+    assert rows["factor"]["total_s"] == pytest.approx(fac["total"])
+    # leaf spans: inclusive == self
+    assert rows["panel"]["total_s"] == pytest.approx(
+        rows["panel"]["measured_s"])
+
+
+def test_span_fence_failure_keeps_nest_balanced(monkeypatch):
+    """A raising fence (poisoned array's block_until_ready — the
+    failure --phase-profile degrades to a warning) must not leak the
+    nested-span child-time stack: later spans in the same process
+    still attribute self-time correctly."""
+    def boom(values):
+        raise RuntimeError("poisoned")
+    monkeypatch.setattr(phases, "_fence", boom)
+    with phases.profiling() as led:
+        with pytest.raises(RuntimeError):
+            with phases.span("factor"):
+                with phases.span("panel") as f:
+                    f("x")          # registered value -> fence fires
+    assert not phases._nest          # stack fully unwound
+    # the raising span and its parent still landed in the ledger
+    assert led.phases["panel"]["count"] == 1
+    assert led.phases["factor"]["count"] == 1
+    monkeypatch.setattr(phases, "_fence", lambda values: None)
+    with phases.profiling() as led2:
+        with phases.span("a"):
+            with phases.span("b") as f:
+                f("y")
+    assert not phases._nest
+    assert led2.phases["a"]["total"] >= led2.phases["a"]["seconds"]
+
+
 def test_sweep_engine_spans_match_phase_model(monkeypatch):
     """Eager getrf_nopiv under an active ledger emits exactly the
     span counts the analytic roofline model predicts (the model
@@ -197,7 +252,7 @@ def test_driver_phase_profile_acceptance(tmp_path, capsys, prog):
     overhead) to the attributed run time."""
     doc = _phase_run(tmp_path, prog)
     out = capsys.readouterr().out
-    assert doc["schema"] == 6
+    assert doc["schema"] == 7
     (op,) = doc["ops"]
     ph = op["phases"]
     spans = ph["spans"]

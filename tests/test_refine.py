@@ -1,0 +1,567 @@
+"""Mixed-precision iterative-refinement solvers (ops.refine): factor
+in a low working precision, refine the O(n^2) residual on the dd limb
+rungs to f64-equivalent backward error.
+
+Covers the ISSUE 7 acceptance: posv_ir/gesv_ir (and gels_ir) converge
+to the 100*u_f64 normwise-backward-error floor within <= 10 iterations
+for every working precision (bf16/f32/f32x2) on the 1-device and
+2x2-grid routes; a deterministic ill-conditioned divergence escalates
+to a correct dd-route solve; the analytic refine DAG verifies under
+--dagcheck; --phase-profile attributes factor/solve/residual/correct
+spans with the factorization priced at the WORKING-precision peak
+(strictly cheaper than the dd rate for the same flops); run-report
+schema v7 carries the "refine" section; and perfdiff gates the bench
+ladder's lower-better iteration counts.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dplasma_tpu.descriptors import Dist, TileMatrix
+from dplasma_tpu.observability import roofline
+from dplasma_tpu.ops import checks, generators, refine
+from dplasma_tpu.utils import config as _cfg
+
+PRECS = ("bf16", "f32", "f32x2")
+
+
+def _spd(n, nb, cond=None, seed=5):
+    """SPD test matrix: diagonally-dominant generator (well
+    conditioned, f32-representable), or a controlled-spectrum
+    Q diag(logspace) Q^T when ``cond`` is given."""
+    if cond is None:
+        return generators.plghe(float(n), n, nb, seed=seed,
+                                dtype=jnp.float64)
+    rng = np.random.default_rng(seed)
+    Q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    d = np.logspace(0.0, -np.log10(cond), n)
+    return TileMatrix.from_dense(jnp.asarray((Q * d) @ Q.T,
+                                             jnp.float64), nb, nb)
+
+
+def _gen(m, n, nb, seed=6, shift=0.0):
+    A = generators.plrnt(m, n, nb, nb, seed=seed, dtype=jnp.float64)
+    if shift:
+        return A.like(A.data + shift * jnp.eye(*A.data.shape,
+                                               dtype=jnp.float64))
+    return A
+
+
+@pytest.fixture
+def ir_iters3():
+    """Cap the traced-loop budget so driver e2e traces stay small."""
+    _cfg.mca_set("ir.max_iters", 3)
+    yield
+    _cfg.mca_unset("ir.max_iters")
+
+
+# ------------------------------------------------------------- config
+
+def test_ir_params_resolution():
+    p, n, t = refine.ir_params()
+    assert p == "f32" and n == 10
+    assert t == pytest.approx(100.0 * 2.0 ** -52)
+    assert refine.ir_params("bf16", 4, 1e-10) == ("bf16", 4, 1e-10)
+    _cfg.mca_set("ir.precision", "f32x2")
+    _cfg.mca_set("ir.tol", "1e-12")
+    try:
+        p, _, t = refine.ir_params()
+        assert p == "f32x2" and t == 1e-12
+    finally:
+        _cfg.mca_unset("ir.precision")
+        _cfg.mca_unset("ir.tol")
+    with pytest.raises(ValueError, match="ir.precision"):
+        refine.ir_params("f16")
+
+
+def test_ir_requires_f64():
+    A = TileMatrix.from_dense(jnp.eye(8, dtype=jnp.float32), 4, 4)
+    B = TileMatrix.from_dense(jnp.ones((8, 1), jnp.float32), 4, 4)
+    with pytest.raises(TypeError, match="float64"):
+        refine.posv_ir(A, B)
+
+
+# ------------------------------------- convergence (eager, 1 device)
+
+@pytest.mark.parametrize("prec", PRECS)
+def test_posv_ir_converges(prec):
+    A = _spd(32, 8)
+    B = _gen(32, 3, 8)
+    X, info = refine.posv_ir(A, B, precision=prec)
+    s = refine.summarize(info, op="posv_ir", precision=prec)
+    assert s["converged"] and not s["escalated"]
+    assert 1 <= s["iterations"] <= 10
+    assert s["backward_errors"][-1] <= s["tol"]
+    assert X.dtype == jnp.float64
+    r, ok = checks.check_solve(A, B, X, uplo="L")
+    assert ok, r
+
+
+@pytest.mark.parametrize("prec", PRECS)
+def test_gesv_ir_converges(prec):
+    A = _gen(32, 32, 8, seed=3, shift=32.0)
+    B = _gen(32, 3, 8)
+    X, info = refine.gesv_ir(A, B, precision=prec)
+    s = refine.summarize(info, op="gesv_ir", precision=prec)
+    assert s["converged"] and not s["escalated"]
+    assert 1 <= s["iterations"] <= 10
+    r, ok = checks.check_solve(A, B, X)
+    assert ok, r
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("prec", PRECS)
+def test_gels_ir_converges(prec):
+    A = _gen(32, 16, 8, seed=4)
+    B = _gen(32, 2, 8, seed=5)
+    X, info = refine.gels_ir(A, B, precision=prec)
+    s = refine.summarize(info, op="gels_ir", precision=prec)
+    assert s["converged"] and not s["escalated"]
+    assert 1 <= s["iterations"] <= 10
+    # least-squares optimality: A^T (A x - b) ~ 0 at f64 scale
+    Ad, Xd = A.to_dense(), X.to_dense()
+    res = Ad.T @ (Ad @ Xd - B.to_dense())
+    den = (jnp.linalg.norm(Ad) ** 2 * jnp.linalg.norm(Xd)
+           * jnp.finfo(jnp.float64).eps * 32)
+    assert float(jnp.linalg.norm(res) / den) < 60
+
+
+@pytest.mark.slow
+def test_bf16_needs_more_iterations_than_f32():
+    """The precision ladder is real: the bf16 factor's per-step
+    contraction is ~kappa*u_bf16, so it takes strictly more refinement
+    steps than the f32 factor on the same system."""
+    A = _spd(32, 8)
+    B = _gen(32, 2, 8)
+    _, i_bf = refine.posv_ir(A, B, precision="bf16")
+    _, i_f32 = refine.posv_ir(A, B, precision="f32")
+    assert int(i_bf["iterations"]) > int(i_f32["iterations"])
+
+
+# -------------------------------------------------- traced (jit) path
+
+def test_posv_ir_traced_matches_eager(ir_iters3):
+    A = _spd(16, 8, seed=9)
+    B = _gen(16, 2, 8, seed=10)
+
+    @jax.jit
+    def run(a, b):
+        X, info = refine.posv_ir(TileMatrix(a, A.desc),
+                                 TileMatrix(b, B.desc),
+                                 escalate=False)
+        return X.data, info
+
+    xd, info = run(A.data, B.data)
+    Xe, info_e = refine.posv_ir(A, B, escalate=False)
+    assert bool(info["converged"]) and not bool(info["escalated"])
+    assert int(info["iterations"]) == int(info_e["iterations"])
+    np.testing.assert_allclose(np.asarray(xd), np.asarray(Xe.data),
+                               rtol=0, atol=1e-12)
+    # masked fixed-trip loop: history is padded with the finite -1
+    # "no verdict" sentinel past the executed iterations (the
+    # resilience health scan must stay clean) and summarize drops it
+    s = refine.summarize(info, op="posv_ir")
+    assert len(s["backward_errors"]) == s["iterations"] + 1
+    assert json.loads(json.dumps(s)) == s
+
+
+def test_ir_converges_at_exact_budget_no_escalation():
+    """A solve converging at exactly max_iters corrections is a
+    convergence, not a divergence: the budget's final correction gets
+    its own verdict (eager AND traced), so the escalation rung never
+    re-factors an already-solved system."""
+    A = _spd(32, 8)
+    B = _gen(32, 2, 8)
+    _, info = refine.posv_ir(A, B, precision="bf16", escalate=False)
+    k = int(info["iterations"])
+    assert k >= 2   # bf16 needs real refinement steps here
+    X, info2 = refine.posv_ir(A, B, precision="bf16", max_iters=k)
+    s = refine.summarize(info2, op="posv_ir", precision="bf16")
+    assert s["converged"] and not s["escalated"]
+    assert s["iterations"] == k
+    r, ok = checks.check_solve(A, B, X, uplo="L")
+    assert ok, r
+
+    @jax.jit
+    def run(a, b):
+        _, i = refine.posv_ir(TileMatrix(a, A.desc),
+                              TileMatrix(b, B.desc),
+                              precision="bf16", max_iters=k)
+        return i
+
+    it = run(A.data, B.data)
+    assert bool(it["converged"]) and not bool(it["escalated"])
+    assert int(it["iterations"]) == k
+
+
+# ------------------------------------------- divergence & escalation
+
+def test_posv_ir_escalates_to_dd_route():
+    """Deterministic divergence: at cond ~1e9 the bf16 factor cannot
+    contract (kappa * u_bf16 >> 1); the escalation rung must hand back
+    the full-precision route's correct solve."""
+    A = _spd(24, 8, cond=1e9, seed=11)
+    B = _gen(24, 2, 8, seed=12)
+    X, info = refine.posv_ir(A, B, precision="bf16", max_iters=4)
+    s = refine.summarize(info, op="posv_ir", precision="bf16")
+    assert s["escalated"] and not s["converged"]
+    # the post-escalation solve is the trusted dd-route answer
+    r, ok = checks.check_solve(A, B, X, uplo="L")
+    assert ok, r
+
+
+@pytest.mark.slow
+def test_posv_ir_no_escalate_leaves_divergence():
+    """escalate=False leaves divergence to the caller: same diverging
+    input, no rescue, and the solution does NOT meet the f64 floor."""
+    A = _spd(24, 8, cond=1e9, seed=11)
+    B = _gen(24, 2, 8, seed=12)
+    X0, info0 = refine.posv_ir(A, B, precision="bf16", max_iters=4,
+                               escalate=False)
+    assert not bool(info0["escalated"]) and not bool(info0["converged"])
+    r0, ok0 = checks.check_solve(A, B, X0, uplo="L")
+    assert not ok0, r0
+
+
+@pytest.mark.slow
+def test_gesv_ir_escalates_to_dd_route():
+    rng = np.random.default_rng(13)
+    U, _ = np.linalg.qr(rng.standard_normal((24, 24)))
+    V, _ = np.linalg.qr(rng.standard_normal((24, 24)))
+    d = np.logspace(0.0, -9.0, 24)
+    A = TileMatrix.from_dense(jnp.asarray((U * d) @ V, jnp.float64),
+                              8, 8)
+    B = _gen(24, 2, 8, seed=14)
+    X, info = refine.gesv_ir(A, B, precision="bf16", max_iters=4)
+    assert bool(info["escalated"])
+    r, ok = checks.check_solve(A, B, X)
+    assert ok, r
+
+
+# ------------------------------------------------------ analytic DAG
+
+def test_refine_dag_verifies_clean():
+    from dplasma_tpu.analysis.dagcheck import (check_comm, check_dag,
+                                               rank_of_dist)
+    from dplasma_tpu.utils.profiling import DagRecorder
+    for dist in (Dist(), Dist(P=2, Q=2)):
+        A = TileMatrix.zeros(24, 24, 8, 8, dist=dist)
+        for kind, op in (("posv", "posv_ir"), ("gesv", "gesv_ir"),
+                         ("gels", "gels_ir")):
+            rec = DagRecorder(enabled=True)
+            refine.dag(A, kind, rec, iterations=3)
+            # factor + solve + 3x (residual + correct)
+            assert len(rec.tasks) == 2 + 2 * 3
+            assert rec.meta["refine"] == {"kind": kind,
+                                          "iterations": 3}
+            res = check_dag(rec, rank_of=rank_of_dist(dist))
+            check_comm(rec, op, 24, 24, 1, 8, 8, dist, res)
+            assert res.ok, res.format(op)
+
+
+def test_refine_dag_mutation_caught():
+    """Dropping the residual->correct flow edge leaves the correction
+    reading R unordered against its writer — a race diagnostic naming
+    the task pair. The verifier actually guards this DAG, it doesn't
+    rubber-stamp it."""
+    from dplasma_tpu.analysis.dagcheck import check_dag
+    from dplasma_tpu.utils.profiling import DagRecorder
+    A = TileMatrix.zeros(16, 16, 8, 8)
+    rec = DagRecorder(enabled=True)
+    refine.dag(A, "posv", rec, iterations=2)
+    victim = next(e for e in rec.edges if e[2] == "R")
+    rec.edges.remove(victim)
+    res = check_dag(rec)
+    assert not res.ok
+    assert any(d.kind in ("war", "missing-flow")
+               and "residual" in d.message and "correct" in d.message
+               for d in res.diagnostics)
+
+
+# ------------------------------------------------- roofline pricing
+
+def test_refine_phase_model_prices_factor_at_wp_peak():
+    peaks = dict(roofline.DEFAULT_PEAKS)
+    model = roofline.phase_model("posv_ir", 512, 512, 64, 8, nrhs=4,
+                                 peaks=peaks)
+    assert set(model) == {"factor", "solve", "residual", "correct"}
+    fac = model["factor"]
+    # default f32 working precision: the conservative ratio over the
+    # dd rate; probed keys win when the peaks carry them
+    assert fac["mxu_gflops"] == pytest.approx(
+        roofline.WP_MXU["f32"][1] * peaks["mxu_gflops"])
+    assert roofline.wp_mxu_gflops(
+        dict(peaks, bf16_gflops=1234.0), "bf16") == 1234.0
+    # residual has NO rate override: it runs at the dd rate
+    assert "mxu_gflops" not in model["residual"]
+    # strictly-below contract: the factor expects less time at the wp
+    # rate than the same flops at the dd rate
+    exp_wp, _, _ = roofline.expected_seconds(
+        flops=fac["flops"], peaks=dict(peaks,
+                                       mxu_gflops=fac["mxu_gflops"],
+                                       latency_us=0.0))
+    exp_dd, _, _ = roofline.expected_seconds(
+        flops=fac["flops"], peaks=dict(peaks, latency_us=0.0))
+    assert exp_wp < exp_dd
+
+
+def test_attribute_phases_per_count_scaling():
+    from dplasma_tpu.observability import phases
+    led = phases.PhaseLedger()
+    led.add("residual", 0.5)
+    led.add("residual", 0.5)
+    led.add("factor", 1.0)
+    peaks = dict(roofline.DEFAULT_PEAKS, latency_us=0.0)
+    model = {"residual": {"flops": 1e9, "per_count": True},
+             "factor": {"flops": 1e9, "mxu_gflops": 1000.0}}
+    by = {s["phase"]: s
+          for s in roofline.attribute_phases(led, model, peaks)}
+    # per_count: 2 dispatches -> twice the single-dispatch expectation
+    assert by["residual"]["expected_s"] == pytest.approx(
+        2e9 / (peaks["mxu_gflops"] * 1e9))
+    # rate override: priced at 1000 GF/s, not the dd mxu_gflops
+    assert by["factor"]["expected_s"] == pytest.approx(1e9 / 1e12)
+
+
+def test_nested_spans_attribute_self_time_only():
+    """The IR factor span wraps the whole inner factorization (which
+    emits its own sweep spans): the ledger records self-time, so
+    phase seconds stay disjoint."""
+    import time as _time
+
+    from dplasma_tpu.observability import phases
+    with phases.profiling() as led:
+        with phases.span("outer"):
+            _time.sleep(0.02)
+            with phases.span("inner"):
+                _time.sleep(0.05)
+    by = {r["phase"]: r["measured_s"] for r in led.summary()}
+    assert by["inner"] >= 0.05
+    assert by["outer"] < 0.05   # the inner sleep is NOT double-counted
+
+
+# --------------------------------------------------- driver e2e (CPU)
+
+def _run_driver(prog, args, capsys):
+    from dplasma_tpu.drivers import main
+    rc = main(args, prog=prog)
+    out = capsys.readouterr().out
+    return rc, out
+
+
+def test_driver_posv_ir_acceptance(tmp_path, capsys, ir_iters3):
+    """The ISSUE acceptance: a --phase-profile dposv_ir run attributes
+    factor/solve/residual/correct spans summing (within out-of-span
+    harness work) to the attributed run, with the factorization phase
+    priced at the working-precision peak — factor expected_s strictly
+    below the dd-route expectation for the same flops."""
+    peaks = tmp_path / "peaks.json"
+    # tiny latency + huge hbm so the mxu term binds even at N=64
+    peaks.write_text(json.dumps({
+        "f64equiv_bound_gflops": 10.0, "f32_highest_gflops": 100.0,
+        "hbm_gbps": 1e6, "latency_us": 0.001}))
+    rj = str(tmp_path / "r.json")
+    rc, out = _run_driver(
+        "testing_dposv_ir",
+        ["-N", "64", "-t", "32", "-K", "2", "-x", "--dagcheck",
+         "--phase-profile", f"--peaks-file={peaks}",
+         f"--report={rj}", "-v=2"], capsys)
+    assert rc == 0, out
+    assert "[SUCCESS] POSV_IR backward error" in out
+    assert "#+ refine[testing_dposv_ir]" in out
+    doc = json.load(open(rj))
+    assert doc["schema"] == 7
+    # v7 refine section: the solve's convergence record
+    (ref,) = doc["refine"]
+    assert ref["op"] == "testing_dposv_ir"
+    assert ref["precision"] == "f32" and ref["converged"]
+    assert not ref["escalated"]
+    assert 1 <= ref["iterations"] <= 3
+    assert ref["backward_errors"][-1] <= ref["tol"]
+    # dagcheck verified the refine DAG before execution
+    (dc,) = doc["dagcheck"]
+    assert dc["ok"] and dc["tasks"] == 2 + 2 * 3
+    # phase attribution: the IR spans are present and sum within the
+    # attributed run
+    ph = doc["ops"][0]["phases"]
+    names = {s["phase"] for s in ph["spans"]}
+    assert {"factor", "solve", "residual", "correct"} <= names
+    assert ph["sum_s"] <= ph["attributed_run_s"]
+    by = {s["phase"]: s for s in ph["spans"]}
+    # factor priced at the f32 peak (100 GF/s), strictly below the
+    # dd-route pricing (10 GF/s) of the same flops
+    fac_fl = 64.0 ** 3 / 3.0
+    assert by["factor"]["expected_s"] == pytest.approx(
+        fac_fl / (100.0 * 1e9), rel=0.05)
+    assert by["factor"]["expected_s"] < fac_fl / (10.0 * 1e9)
+    assert by["factor"]["bound"] == "mxu"
+    # refine metrics ride along
+    assert any(m["name"] == "refine_iterations"
+               for m in doc["metrics"])
+
+
+def test_driver_posv_ir_resilience_scan_clean(tmp_path, capsys,
+                                              ir_iters3):
+    """A healthy early-converging IR solve under an armed resilience
+    ladder (--run-timeout enables the post-run non-finite health scan
+    over the whole (X, info) output) must classify CLEAN: the
+    history's unused budget slots are a finite -1 sentinel, never NaN
+    — a NaN pad would misread as a numerical fault and walk every
+    healthy solve down the remediation ladder to the dd fallback."""
+    rj = str(tmp_path / "r.json")
+    rc, out = _run_driver(
+        "testing_dposv_ir",
+        ["-N", "64", "-t", "32", "-K", "2", "-x", "--run-timeout=300",
+         f"--report={rj}", "-v=2"], capsys)
+    assert rc == 0, out
+    assert "[SUCCESS] POSV_IR backward error" in out
+    doc = json.load(open(rj))
+    (res,) = doc["resilience"]
+    assert res["outcome"] == "clean", res
+    assert len(res["attempts"]) == 1 and res["attempts"][0]["ok"]
+    assert res["attempts"][0]["health"]["nan"] == 0
+    (ref,) = doc["refine"]
+    # early convergence: unused (padded) budget slots really existed
+    assert ref["converged"] and ref["iterations"] < 3
+
+
+def test_driver_gesv_ir_grid_2x2(tmp_path, capsys, ir_iters3):
+    """gesv_ir on the 2x2-grid route, with the v7 refine record —
+    under --spmdcheck: the traced program carries the cyclic LU
+    factor's collectives at top level, while the escalation lax.cond
+    stays collective-free (its traced branch takes the GSPMD 1-D
+    route), so the rank-divergent-cond rule passes a healthy run."""
+    rj = str(tmp_path / "r.json")
+    rc, out = _run_driver(
+        "testing_dgesv_ir",
+        ["-N", "64", "-t", "16", "-K", "2", "-P", "2", "-Q", "2",
+         "-x", "--spmdcheck", f"--report={rj}"], capsys)
+    assert rc == 0, out
+    assert "[SUCCESS] GESV_IR backward error" in out
+    doc = json.load(open(rj))
+    (ref,) = doc["refine"]
+    assert ref["converged"] and not ref["escalated"]
+    (sc,) = doc["spmdcheck"]
+    assert sc["ok"], sc
+
+
+@pytest.mark.slow
+def test_driver_gels_ir_e2e(capsys, ir_iters3):
+    rc, out = _run_driver(
+        "testing_dgels_ir",
+        ["-M", "64", "-N", "48", "-t", "16", "-K", "2", "-x"], capsys)
+    assert rc == 0, out
+    assert "[SUCCESS] GELS_IR normal eq" in out
+
+
+def test_driver_posv_ir_grid_2x2(capsys, ir_iters3):
+    """The 2x2-grid route: same convergence contract under an active
+    device mesh (GSPMD partitions the factor/solve sweeps)."""
+    rc, out = _run_driver(
+        "testing_dposv_ir",
+        ["-N", "64", "-t", "16", "-K", "2", "-P", "2", "-Q", "2",
+         "-x", "-v=2"], capsys)
+    assert rc == 0, out
+    assert "[SUCCESS] POSV_IR backward error" in out
+    assert "PxQxg=   2 2" in out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("prec", PRECS)
+@pytest.mark.parametrize("kind", ["posv", "gesv"])
+def test_ir_converges_on_grid_all_precisions(kind, prec, devices8):
+    """The full acceptance matrix on the 2x2-grid route: every working
+    precision converges under an active device mesh with sharded
+    inputs through the JITTED path (the route where GSPMD partitions
+    the dd residual — the regression surface of the kernels.dd
+    concat-axis sharding pin)."""
+    from dplasma_tpu.parallel import mesh
+    m = mesh.make_mesh(2, 2, devices8[:4])
+    if kind == "posv":
+        A = _spd(32, 8)
+        call = lambda a, b: refine.posv_ir(a, b, "L", precision=prec,  # noqa: E731
+                                           max_iters=8, escalate=False)
+    else:
+        A = _gen(32, 32, 8, seed=3, shift=32.0)
+        call = lambda a, b: refine.gesv_ir(a, b, precision=prec,  # noqa: E731
+                                           max_iters=8, escalate=False)
+    B = _gen(32, 2, 8)
+    with mesh.use_grid(m):
+        ad = mesh.device_put2d(A.data)
+        bd = mesh.device_put2d(B.data)
+
+        @jax.jit
+        def run(a, b):
+            X, info = call(TileMatrix(a, A.desc), TileMatrix(b, B.desc))
+            return X.data, info
+
+        xd, info = run(ad, bd)
+        xd.block_until_ready()
+    assert bool(info["converged"]), (kind, prec)
+    X = TileMatrix(jnp.asarray(xd), B.desc)
+    r, ok = checks.check_solve(A, B, X,
+                               uplo="L" if kind == "posv" else None)
+    assert ok, (r, kind, prec)
+
+
+@pytest.mark.slow
+def test_driver_posv_ir_bf16_knob(capsys, ir_iters3):
+    """MCA ir.precision selects the working precision end-to-end."""
+    _cfg.mca_set("ir.precision", "bf16")
+    try:
+        rc, out = _run_driver(
+            "testing_dposv_ir",
+            ["-N", "64", "-t", "32", "-x", "-v=2"], capsys)
+    finally:
+        _cfg.mca_unset("ir.precision")
+    assert rc == 0, out
+    assert "precision=bf16" in out
+    assert "[SUCCESS]" in out
+
+
+# ------------------------------------------------- perfdiff IR gating
+
+def test_perfdiff_gates_iteration_regressions():
+    """Ladder entries may declare lower-better ("better": "lower"):
+    an iteration-count increase is a convergence regression the bench
+    gate must flag, while a decrease passes."""
+    from tools import perfdiff
+    old = {"ladder": [{"metric": "dposv_ir_f64equiv_iters_n4096",
+                       "value": 2, "better": "lower"}]}
+    worse = {"ladder": [{"metric": "dposv_ir_f64equiv_iters_n4096",
+                         "value": 4, "better": "lower"}]}
+    better = {"ladder": [{"metric": "dposv_ir_f64equiv_iters_n4096",
+                          "value": 1, "better": "lower"}]}
+    res = perfdiff.compare(old, worse)
+    assert not res["ok"]
+    assert res["worst"]["metric"] == "dposv_ir_f64equiv_iters_n4096"
+    assert perfdiff.compare(old, better)["ok"]
+    # default direction unchanged: GFlop/s-style entries still gate on
+    # decreases
+    o = {"ladder": [{"metric": "x_gflops", "value": 100.0}]}
+    n = {"ladder": [{"metric": "x_gflops", "value": 50.0}]}
+    assert not perfdiff.compare(o, n)["ok"]
+
+
+def test_perfdiff_zero_iteration_baseline_still_gates():
+    """A 0 baseline is legitimate for lower-better counts (an IR solve
+    converging at the initial solve records 0 iterations); growth from
+    it must still register as a regression rather than being skipped
+    the way a 0 GFlop/s baseline is."""
+    from tools import perfdiff
+    zero = {"ladder": [{"metric": "it_n64",
+                        "value": 0, "better": "lower"}]}
+    grew = {"ladder": [{"metric": "it_n64",
+                        "value": 3, "better": "lower"}]}
+    res = perfdiff.compare(zero, grew)
+    assert not res["ok"]
+    assert res["worst"]["metric"] == "it_n64"
+    # 0 -> 0 passes, and a 0 higher-better baseline stays skipped
+    # (nothing comparable -> vacuously ok)
+    assert perfdiff.compare(zero, zero)["ok"]
+    gf0 = {"ladder": [{"metric": "g_gflops", "value": 0.0}]}
+    gf1 = {"ladder": [{"metric": "g_gflops", "value": 5.0}]}
+    assert perfdiff.compare(gf0, gf1)["ok"]

@@ -17,8 +17,9 @@ Six passes, in increasing cost order:
    maps covering the grid, the VMEM budget, the precision contract;
 5. a ``dplasma_tpu.analysis.dagcheck`` smoke pass — the analytic tile
    DAGs of all four ops (potrf/lu/qr/gemm) at 3x3 tiles on 1x1 and
-   2x2 grids must verify clean, with the comm-model reconciliation
-   exact for the owner-computes classes;
+   2x2 grids, plus the IR solvers' factor+solve+refine DAGs
+   (posv_ir/gesv_ir, ops.refine.dag), must verify clean, with the
+   comm-model reconciliation exact for the owner-computes classes;
 6. a ``dplasma_tpu.analysis.spmdcheck`` smoke pass — the cyclic
    shard_map kernels (potrf/getrf/geqrf/gemm) traced on tiny shapes
    over 1x1/2x2/1x4 grids must verify clean with the collective
@@ -79,7 +80,7 @@ def run_perfdiff_smoke() -> int:
 
     import perfdiff
 
-    base = {"schema": 6, "name": "perfdiff-smoke",
+    base = {"schema": 7, "name": "perfdiff-smoke",
             "ops": [{"label": "testing_dpotrf", "prec": "d",
                      "gflops": 100.0,
                      "timings": {"nruns": 3, "median_s": 0.010,
@@ -165,6 +166,18 @@ def run_dagcheck_smoke() -> int:
             sys.stderr.write(res.format(
                 f"gemm {dist.P}x{dist.Q}") + "\n")
             bad += len(res.diagnostics)
+        # the IR solvers' factor+solve+refine DAG (ops.refine.dag):
+        # verify-before-execute holds for the new solve workload too
+        from dplasma_tpu.ops import refine
+        for kind, op in (("posv", "posv_ir"), ("gesv", "gesv_ir")):
+            rec = DagRecorder(enabled=True)
+            refine.dag(A, kind, rec, iterations=2)
+            res = check_dag(rec, rank_of=rank_of_dist(dist))
+            check_comm(rec, op, N, N, 1, nb, nb, dist, res)
+            if not res.ok:
+                sys.stderr.write(res.format(
+                    f"{op} {dist.P}x{dist.Q}") + "\n")
+                bad += len(res.diagnostics)
     return bad
 
 

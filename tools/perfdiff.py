@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v6), the bench
+(``--report`` from any driver, any schema vintage v1-v7), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -19,7 +19,8 @@ Comparable metrics extracted from each document:
   lower is better) and achieved ``<label>.gflops`` (higher is
   better) from a run-report's ``ops`` section;
 * bench ladder entries (``<metric>`` GFlop/s values, higher is
-  better) from ``entries``/``ladder``.
+  better unless the entry declares ``"better": "lower"`` — e.g. the
+  IR solvers' iteration counts) from ``entries``/``ladder``.
 
 Exit codes: 0 = no regression, 1 = regression past threshold,
 2 = unusable input / nothing comparable.
@@ -96,8 +97,14 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
     for e in (doc.get("entries") or []) + (doc.get("ladder") or []):
         if isinstance(e, dict) and isinstance(e.get("metric"), str) \
                 and isinstance(e.get("value"), (int, float)):
+            # entries may declare their direction ("better": "lower" —
+            # the IR solvers' iteration counts, where growth is a
+            # convergence regression); GFlop/s-style default is higher
+            better = e.get("better")
             out[e["metric"]] = {"value": float(e["value"]),
-                                "better": "higher"}
+                                "better": better
+                                if better in ("lower", "higher")
+                                else "higher"}
     return out
 
 
@@ -120,10 +127,19 @@ def compare(old_doc: dict, new_doc: dict,
     rows = []
     for name in sorted(set(old_m) & set(new_m)):
         ov, nv = old_m[name]["value"], new_m[name]["value"]
-        if ov <= 0:
-            continue
         better = new_m[name]["better"]
-        ratio = (nv - ov) / ov if better == "lower" else (ov - nv) / ov
+        if ov <= 0:
+            if not (better == "lower" and ov == 0 and nv >= 0):
+                continue
+            # a 0 baseline is legitimate for lower-better counts (an
+            # IR solve converging at the initial solve records 0
+            # iterations); growth from it is still a regression the
+            # gate must see — ratio against a unit denominator
+            # instead of skipping the metric
+            ratio = float(nv)
+        else:
+            ratio = (nv - ov) / ov if better == "lower" \
+                else (ov - nv) / ov
         th = per_metric.get(
             name, per_metric.get(name.rsplit(".", 1)[-1], threshold))
         rows.append({"metric": name, "old": ov, "new": nv,
