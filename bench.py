@@ -603,7 +603,9 @@ def main(argv=None) -> int:
         prev = None
         if os.path.exists(history):
             try:
-                prev = perfdiff.latest_ledger_entry(history)
+                # newest entry of THIS bench family (the ledger may
+                # interleave servebench docs with no common metrics)
+                prev = perfdiff.latest_comparable_entry(history, doc)
             except (OSError, ValueError) as exc:
                 print(f"#! cannot read bench history: {exc}",
                       file=sys.stderr)
@@ -621,13 +623,22 @@ def main(argv=None) -> int:
                                        threshold=ns.gate_threshold)
                 for line in perfdiff.format_result(res):
                     print(line, file=sys.stderr)
-                if res["compared"] == 0:
+                if res["compared"] == 0 and not res.get("new"):
                     # every ladder entry errored/skipped: a gate that
                     # cannot compare anything must not pass vacuously
                     print("# bench gate: nothing comparable against "
                           "the prior entry; failing the gate",
                           file=sys.stderr)
                     rc = 1
+                elif res["compared"] == 0:
+                    # this run measured fine but the newest prior
+                    # entry is a different bench family (e.g. a
+                    # servebench serving.* doc sharing the ledger):
+                    # informational, this entry seeds the next gate
+                    print("# bench gate: prior entry shares no "
+                          "metrics (different bench family); this "
+                          "run seeds the next comparison",
+                          file=sys.stderr)
                 elif not res["ok"]:
                     rc = 1
     return rc

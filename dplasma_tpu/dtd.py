@@ -126,12 +126,15 @@ class TaskPool:
     # -- introspection -------------------------------------------------
     def record_dag(self, rec) -> None:
         """Feed the tracked task DAG into a DagRecorder (--dot). The
-        full flattened ref index keys each node — same-named tasks with
-        different tile sets must not collide."""
+        flattened ref index plus the insertion id key each node: DTD
+        legally inserts the same task class on the same tiles twice
+        (two updates of one tile), and the recorder would otherwise
+        dedupe them into one node and turn their ordering edge into a
+        self-loop."""
         ids = []
-        for t in self.tasks:
+        for tid, t in enumerate(self.tasks):
             ix = tuple(x for r in t.refs for x in (r.i, r.j))
-            ids.append(rec.task(t.name, *ix))
+            ids.append(rec.task(t.name, *ix, tid))
         for s, d in self.edges:
             rec.edge(ids[s], ids[d])
 

@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 7``).
+"""The versioned JSON run-report (``"schema": 8``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -54,6 +54,13 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
      "refine": [{"op", "precision", "iterations",
                  "backward_errors": [...], "converged",
                  "escalated", "tol"}],                     # (v7)
+     "serving": [{"requests", "batches", "mean_batch",
+                  "latency_s": {"p50", "p99", "max"},
+                  "cache": {"entries", "capacity", "hits", "misses",
+                            "evictions", "invalidations", "hit_rate",
+                            "compile_s"},
+                  "remediated", "failed", "retries",
+                  "escalations", ...}],                    # (v8)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
@@ -68,9 +75,12 @@ verification of the traced SPMD program, analysis.spmdcheck);
 7 adds ``"refine"`` (the mixed-precision iterative-refinement
 solvers' per-solve record — working precision, iteration count,
 per-iteration normwise backward error, converged/escalated outcome,
-ops.refine). All
+ops.refine); 8 adds ``"serving"`` (the solver-as-a-service layer's
+throughput/latency/cache record — request and batch counts, p50/p99
+latency, executable-cache economics, per-request remediation
+outcomes, dplasma_tpu.serving + tools/servebench.py). All
 additive — v1 readers of the other keys are unaffected; this reader
-accepts <= 7 (:func:`load_report` tolerates every v1-v7 vintage,
+accepts <= 8 (:func:`load_report` tolerates every v1-v8 vintage,
 filling the always-present keys).
 """
 from __future__ import annotations
@@ -83,7 +93,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 7
+REPORT_SCHEMA = 8
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -117,6 +127,7 @@ class RunReport:
         self.dagcheck: List[dict] = []  # --dagcheck verification (v3)
         self.spmdcheck: List[dict] = []  # --spmdcheck verification (v6)
         self.refine: List[dict] = []    # IR-solver records (v7)
+        self.serving: List[dict] = []   # serving-layer records (v8)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
         self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
@@ -172,6 +183,12 @@ class RunReport:
         self.refine.append(summary)
         return summary
 
+    def add_serving(self, summary: dict) -> dict:
+        """Record one serving-layer lifetime summary (schema v8; see
+        serving.service.SolverService.summary)."""
+        self.serving.append(summary)
+        return summary
+
     def add_roofline(self, entry: dict) -> dict:
         """Record one per-op roofline ledger entry (schema v5; see
         observability.roofline.op_roofline)."""
@@ -205,6 +222,8 @@ class RunReport:
             doc["spmdcheck"] = self.spmdcheck
         if self.refine:
             doc["refine"] = self.refine
+        if self.serving:
+            doc["serving"] = self.serving
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
         if self.roofline:

@@ -8,6 +8,12 @@ TPU-native design: instead of a taskpool applying an operator per tile,
 we reshape the padded global array into a (MT, NT, mb, nb) tile tensor and
 ``vmap`` the tile operator over the tile grid — one fused XLA op, fully
 batched onto the VPU/MXU, sharding-preserving.
+
+The tile reshape helpers (:func:`to_tiles` / :func:`from_tiles`) accept
+arbitrary leading batch axes ``(..., Mp, Np) <-> (..., MT, NT, mb, nb)``
+— the lift that lets :mod:`dplasma_tpu.serving.batched` vmap whole
+factorizations over a stacked problem batch without re-deriving the
+tile layout (the original helpers hard-coded the 2-D case).
 """
 from __future__ import annotations
 
@@ -16,19 +22,40 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.descriptors import TileDesc, TileMatrix
+
+
+def to_tiles(data: jax.Array, desc: TileDesc) -> jax.Array:
+    """``(..., Mp, Np) -> (..., MT, NT, mb, nb)`` tile tensor view.
+
+    Leading axes are preserved untouched (a stacked problem batch maps
+    each element independently)."""
+    lead = data.shape[:-2]
+    assert data.shape[-2:] == (desc.Mp, desc.Np), \
+        (data.shape, desc.Mp, desc.Np)
+    t = data.reshape(*lead, desc.MT, desc.mb, desc.NT, desc.nb)
+    nl = len(lead)
+    perm = tuple(range(nl)) + (nl, nl + 2, nl + 1, nl + 3)
+    return t.transpose(perm)
+
+
+def from_tiles(tiles: jax.Array, desc: TileDesc) -> jax.Array:
+    """Inverse of :func:`to_tiles`: ``(..., MT, NT, mb, nb) ->
+    (..., Mp, Np)``."""
+    lead = tiles.shape[:-4]
+    assert tiles.shape[-4:] == (desc.MT, desc.NT, desc.mb, desc.nb), \
+        (tiles.shape, desc)
+    nl = len(lead)
+    perm = tuple(range(nl)) + (nl, nl + 2, nl + 1, nl + 3)
+    return tiles.transpose(perm).reshape(*lead, desc.Mp, desc.Np)
 
 
 def _to_tiles(A: TileMatrix) -> jax.Array:
-    d = A.desc
-    return (A.data.reshape(d.MT, d.mb, d.NT, d.nb)
-            .transpose(0, 2, 1, 3))
+    return to_tiles(A.data, A.desc)
 
 
 def _from_tiles(tiles: jax.Array, A: TileMatrix) -> TileMatrix:
-    d = A.desc
-    data = tiles.transpose(0, 2, 1, 3).reshape(d.Mp, d.Np)
-    return A.like(data)
+    return A.like(from_tiles(tiles, A.desc).astype(A.dtype))
 
 
 def map_tiles(A: TileMatrix,
@@ -36,13 +63,18 @@ def map_tiles(A: TileMatrix,
               ) -> TileMatrix:
     """Apply ``op(i, j, tile) -> tile`` to every tile (dplasma_map).
 
-    ``i``/``j`` are traced scalars (tile coordinates); ``op`` must be
-    vmappable. Runs as one batched XLA computation.
+    ``i``/``j`` are traced int32 scalars (tile coordinates — pinned so
+    coordinate arithmetic folded into the tile values is independent of
+    the ``jax_enable_x64`` setting); ``op`` must be vmappable. Runs as
+    one batched XLA computation. The result is cast back to ``A``'s
+    dtype: the reference's map writes into A's own tiles, so an
+    operator whose arithmetic promotes (e.g. mixing f64 coordinates
+    into f32 tiles) must not silently widen the matrix storage.
     """
     d = A.desc
     tiles = _to_tiles(A)
-    ii = jnp.arange(d.MT)
-    jj = jnp.arange(d.NT)
+    ii = jnp.arange(d.MT, dtype=jnp.int32)
+    jj = jnp.arange(d.NT, dtype=jnp.int32)
     f = jax.vmap(jax.vmap(op, in_axes=(None, 0, 0)), in_axes=(0, None, 0))
     out = f(ii, jj, tiles)
     return _from_tiles(out, A)
@@ -51,11 +83,22 @@ def map_tiles(A: TileMatrix,
 def map2_tiles(A: TileMatrix, B: TileMatrix,
                op: Callable[[jax.Array, jax.Array, jax.Array, jax.Array],
                             jax.Array]) -> TileMatrix:
-    """Apply ``op(i, j, tileA, tileB) -> tileB`` pairwise (dplasma_map2)."""
-    assert A.desc.MT == B.desc.MT and A.desc.NT == B.desc.NT
+    """Apply ``op(i, j, tileA, tileB) -> tileB`` pairwise (dplasma_map2).
+
+    Both operands must share the full tile geometry — equal tile
+    *counts* alone are not enough (tile (i, j) of differently-tiled
+    matrices covers different global regions, so pairing them is
+    meaningless; the original helper silently accepted it). The result
+    takes ``B``'s dtype (map2 writes B's tiles in place in the
+    reference; operator dtype promotion must not widen B's storage).
+    """
+    assert A.desc.MT == B.desc.MT and A.desc.NT == B.desc.NT, \
+        (A.desc, B.desc)
+    assert A.desc.mb == B.desc.mb and A.desc.nb == B.desc.nb, \
+        ("map2_tiles needs matching tile shapes", A.desc, B.desc)
     ta, tb = _to_tiles(A), _to_tiles(B)
-    ii = jnp.arange(A.desc.MT)
-    jj = jnp.arange(A.desc.NT)
+    ii = jnp.arange(A.desc.MT, dtype=jnp.int32)
+    jj = jnp.arange(A.desc.NT, dtype=jnp.int32)
     f = jax.vmap(jax.vmap(op, in_axes=(None, 0, 0, 0)),
                  in_axes=(0, None, 0, 0))
     out = f(ii, jj, ta, tb)

@@ -35,8 +35,9 @@ from typing import List, Optional
 
 KINDS = ("bitflip", "nan", "inf", "zero")
 
-#: stage names with a tap in the kernel layer (``any`` matches all)
-STAGES = ("gemm", "trsm", "potrf", "getrf", "any")
+#: stage names with a tap in the kernel layer, plus the serving
+#: front-end's per-request response tap (``any`` matches all)
+STAGES = ("gemm", "trsm", "potrf", "getrf", "serving", "any")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,30 @@
+"""Solver-as-a-service: batched execution paths, an executable cache,
+and a request front-end over the repo's factor/solve workloads.
+
+Production traffic is many medium-size problems, not one N=16k matrix
+(ROADMAP). This subsystem turns the existing solvers into a
+high-throughput, latency-measured service:
+
+* :mod:`~dplasma_tpu.serving.batched` — vmapped single-device variants
+  of potrf/potrs, getrf/getrs, and the mixed-precision IR solvers: one
+  compiled executable factors/solves a stacked ``(B, n, n)`` batch,
+  with per-problem convergence masks for iterative refinement;
+* :mod:`~dplasma_tpu.serving.cache` — a compiled-executable cache
+  keyed by (op, shape bucket, dtype, batch bucket, nrhs bucket, grid,
+  pipeline shape, ir precision), with ragged inputs identity/zero-
+  padded into power-of-two-ish buckets and an LRU bound;
+* :mod:`~dplasma_tpu.serving.service` — :class:`SolverService`:
+  ``submit() -> future`` handles, a batching scheduler
+  (``serving.max_batch`` / ``serving.max_wait_ms``), result scatter,
+  and a per-request resilience ladder (classify -> retry -> escalate)
+  that heals a failed request without poisoning its batch-mates.
+
+``tools/servebench.py`` drives a synthetic open-loop workload through
+the service and records solves/sec + p50/p99 latency + cache hit-rate
+into the run-report schema v8 ``"serving"`` section, gated by
+``tools/perfdiff.py``.
+"""
+from dplasma_tpu.serving import batched, cache, service
+from dplasma_tpu.serving.service import SolveFuture, SolverService
+
+__all__ = ["batched", "cache", "service", "SolverService", "SolveFuture"]

@@ -1,0 +1,559 @@
+"""The request front-end: ``SolverService`` — submit/future handles,
+a batching scheduler, and a per-request resilience ladder.
+
+Requests (``submit(op, A, b) -> SolveFuture``) are grouped by their
+executable-cache key (op, shape bucket, dtype, nrhs bucket, grid,
+pipeline shape, IR precision — :func:`dplasma_tpu.serving.cache.
+make_key`); a group dispatches as ONE batched executable when it
+reaches ``serving.max_batch``, when ``serving.max_wait_ms`` expires,
+when the caller blocks on a pending future, or on ``flush()``. Results
+scatter back per request (each sliced to its exact pre-padding shape)
+and are verified: a non-finite census plus a normwise backward-error
+gate (and the per-element convergence mask for the IR solvers).
+
+A failed request walks the PR 2 remediation ladder
+(:class:`dplasma_tpu.resilience.guard.Ladder`) **individually** —
+classify -> retry (a solo re-solve, clean under
+``inject.suppressed``, exactly like the driver ladder's retry rung) ->
+kernel fallback -> algorithm escalation (posv -> pivoted LU, gesv ->
+QR least squares, the IR ops -> their trusted full-precision routes).
+Batch-mates are untouched: their futures resolve from the batched
+dispatch while the failed request heals on the side.
+
+Fault injection: the serving layer adds a per-request ``"serving"``
+tap (:mod:`dplasma_tpu.resilience.inject`) on each scattered result —
+the soft-error model for a corrupted response slot, and the hook the
+``--inject``/``DPLASMA_INJECT`` e2e path exercises. Kernel-stage taps
+(gemm/trsm/...) fire at trace time inside the batched executable; the
+cache marks such executables tainted and the service drops them after
+dispatch, so retries re-compile clean (the serving analogue of
+``inject.disarm`` clearing jax's trace caches).
+
+Conventions: ``A`` is the full matrix (posv reads the lower triangle
+of a full symmetric operand); ``b`` may be 1-D (a single right-hand
+side — the result is returned 1-D) or ``(n, nrhs)``. The IR ops
+require float64 inputs (their contract in :mod:`dplasma_tpu.ops.
+refine`).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import types
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dplasma_tpu.observability.metrics import MetricsRegistry
+from dplasma_tpu.resilience import guard, inject
+from dplasma_tpu.serving import batched
+from dplasma_tpu.serving import cache as cache_mod
+from dplasma_tpu.utils import config as _cfg
+
+_cfg.mca_register(
+    "serving.max_batch", "16",
+    "Batching bound of the SolverService scheduler: a compatible "
+    "request group dispatches as one batched executable when it "
+    "reaches this many requests.")
+_cfg.mca_register(
+    "serving.max_wait_ms", "5",
+    "Batching window of the SolverService scheduler: an incomplete "
+    "request group dispatches at most this many milliseconds after "
+    "its first request arrived.")
+_cfg.mca_register(
+    "serving.max_retries", "1",
+    "Per-request retry budget of the serving resilience ladder (the "
+    "solo re-solve rung; fallback rungs are one-shot on top).")
+
+#: residual gate scale of the per-request verification (check_axmb
+#: style: THRESHOLD * eps * n)
+_GATE = 60.0
+
+
+def percentile(sorted_vals, p: float):
+    """Nearest-rank percentile of an ascending list (None when empty)
+    — shared by the service summary and tools/servebench.py."""
+    if not sorted_vals:
+        return None
+    k = min(int(round(p / 100.0 * (len(sorted_vals) - 1))),
+            len(sorted_vals) - 1)
+    return sorted_vals[k]
+
+
+@dataclasses.dataclass
+class _Request:
+    op: str
+    a: np.ndarray
+    b: np.ndarray          # always (n, nrhs)
+    vec: bool              # caller passed a 1-D b
+    n: int
+    nrhs: int
+    future: "SolveFuture"
+    t_submit: float
+    kwargs: dict
+
+
+class SolveFuture:
+    """Handle for one submitted solve. ``result()`` drives the
+    scheduler if the request is still pending (a blocked caller is a
+    latency bound, not a deadlock), then returns the solution;
+    ``meta`` carries latency, batch, verification, and the resilience
+    summary when the request walked the ladder."""
+
+    def __init__(self, service: "SolverService", group):
+        self._service = service
+        self._group = group
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.meta: dict = {}
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _resolve(self, value, meta: dict) -> None:
+        self._value = value
+        self.meta.update(meta)
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.is_set():
+            self._service._drive(self._group)
+        if not self._event.wait(timeout):
+            raise TimeoutError("solve still pending")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class SolverService:
+    """Batched solver-as-a-service front-end (module docstring).
+
+    ``nb`` is the tile size every batched sweep runs at (one compiled
+    program per cache key); ``check=False`` disables the per-request
+    verification gate (dispatch-rate benchmarking — the resilience
+    ladder needs the gate on).
+    """
+
+    def __init__(self, nb: int = 8, *, max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 cache: Optional[cache_mod.ExecutableCache] = None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 max_retries: Optional[int] = None, check: bool = True):
+        self.nb = int(nb)
+        self.max_batch = max(
+            max_batch if max_batch is not None
+            else _cfg.mca_get_int("serving.max_batch", 16), 1)
+        if max_wait_ms is None:
+            try:
+                max_wait_ms = float(
+                    _cfg.mca_get("serving.max_wait_ms", "5"))
+            except ValueError:
+                max_wait_ms = 5.0
+        self.max_wait_ms = max(float(max_wait_ms), 0.0)
+        self.max_retries = max(
+            max_retries if max_retries is not None
+            else _cfg.mca_get_int("serving.max_retries", 1), 0)
+        self.metrics = metrics if metrics is not None \
+            else MetricsRegistry()
+        self.cache = cache if cache is not None \
+            else cache_mod.ExecutableCache(metrics=self.metrics)
+        self.check = bool(check)
+        self.resilience: List[dict] = []   # ladder summaries
+        self._pending: Dict[tuple, List[_Request]] = {}
+        # (op, n, nrhs, dtype, kwargs) -> CacheKey memo: the key
+        # context (grid, pipeline shape, ir precision, bucket policy)
+        # is captured when a request shape is first seen — retune MCA
+        # knobs, construct a new service
+        self._keys: Dict[tuple, cache_mod.CacheKey] = {}
+        self._timers: Dict[tuple, threading.Timer] = {}
+        self._lock = threading.RLock()
+        self._latencies: List[float] = []
+        self._batches = 0
+        self._requests = 0
+
+    # ------------------------------------------------------ submission
+    def submit(self, op: str, A, b, **kwargs) -> SolveFuture:
+        """Queue one solve ``op(A) x = b``; returns a future."""
+        if op not in ("posv", "gesv", "posv_ir", "gesv_ir"):
+            raise ValueError(f"unservable op {op!r}")
+        a = np.asarray(A)
+        bb = np.asarray(b)
+        vec = bb.ndim == 1
+        if vec:
+            bb = bb[:, None]
+        if a.ndim != 2 or a.shape[0] != a.shape[1]:
+            raise ValueError(f"A must be (n, n), got {a.shape}")
+        if bb.ndim != 2 or bb.shape[0] != a.shape[0]:
+            raise ValueError(f"b {bb.shape} does not match A {a.shape}")
+        if a.dtype != bb.dtype:
+            raise TypeError(f"A ({a.dtype}) and b ({bb.dtype}) must "
+                            "share a dtype")
+        if op.endswith("_ir") and np.dtype(a.dtype).name != "float64":
+            raise TypeError(f"{op} refines to f64-equivalent accuracy: "
+                            f"inputs must be float64, got {a.dtype}")
+        n, nrhs = a.shape[0], bb.shape[1]
+        extra = tuple(sorted(kwargs.items()))
+        memo = (op, n, nrhs, a.dtype.str, extra)
+        key = self._keys.get(memo)
+        if key is None:
+            key = cache_mod.make_key(op, n, a.dtype, 1, nrhs,
+                                     extra=extra)
+            self._keys[memo] = key
+        group = key._replace(batch=0)    # batch bucket set at dispatch
+        fut = SolveFuture(self, group)
+        req = _Request(op=op, a=a, b=bb, vec=vec, n=n, nrhs=nrhs,
+                       future=fut, t_submit=time.perf_counter(),
+                       kwargs=dict(kwargs))
+        dispatch_now = None
+        with self._lock:
+            self._requests += 1
+            self.metrics.counter("serving_requests_total", op=op).inc()
+            lst = self._pending.setdefault(group, [])
+            lst.append(req)
+            if len(lst) >= self.max_batch:
+                dispatch_now = self._pending.pop(group)
+                self._cancel_timer(group)
+            elif len(lst) == 1 and self.max_wait_ms > 0:
+                t = threading.Timer(self.max_wait_ms / 1000.0,
+                                    self._drive, args=(group,))
+                t.daemon = True
+                self._timers[group] = t
+                t.start()
+        if dispatch_now:
+            self._dispatch(group, dispatch_now)
+        return fut
+
+    def _cancel_timer(self, group) -> None:
+        t = self._timers.pop(group, None)
+        if t is not None:
+            t.cancel()
+
+    def _drive(self, group) -> None:
+        """Dispatch one group now (timer fired / caller blocked)."""
+        with self._lock:
+            reqs = self._pending.pop(group, None)
+            self._cancel_timer(group)
+        if reqs:
+            self._dispatch(group, reqs)
+
+    def flush(self) -> None:
+        """Dispatch every pending group."""
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                group = next(iter(self._pending))
+            self._drive(group)
+
+    def close(self) -> None:
+        self.flush()
+        with self._lock:
+            for t in self._timers.values():
+                t.cancel()
+            self._timers.clear()
+
+    # -------------------------------------------------------- dispatch
+    def _stack(self, key: cache_mod.CacheKey, reqs: List[_Request]):
+        """Assemble a bucket-shaped (As, bs) pair: identity everywhere
+        first, so the overwritten top-left block leaves exactly the
+        identity shape-padding (cache.pad_problem semantics) and empty
+        batch slots carry whole identity problems — host-side numpy,
+        no per-request device dispatches."""
+        nB, rB, Bc = key.n, key.nrhs, key.batch
+        dt = np.dtype(key.dtype)
+        As = np.zeros((Bc, nB, nB), dt)
+        bs = np.zeros((Bc, nB, rB), dt)
+        idx = np.arange(nB)
+        As[:, idx, idx] = 1.0
+        for i, r in enumerate(reqs):
+            As[i, :r.n, :r.n] = r.a
+            bs[i, :r.n, :r.nrhs] = r.b
+        return As, bs
+
+    def _builder(self, key: cache_mod.CacheKey, kwargs: dict):
+        """The ONE executable body both the batched and the solo paths
+        compile: solve + in-executable backward errors."""
+        nb, op, kw = self.nb, key.op, dict(kwargs)
+
+        def build():
+            def fn(a, b):
+                x, info = batched.solve_batched(op, a, b, nb, **kw)
+                bwd = batched.backward_errors(a, b, x)
+                return (x, bwd, info) if info is not None \
+                    else (x, bwd)
+            return fn
+        return build
+
+    def _run(self, key: cache_mod.CacheKey, reqs: List[_Request]):
+        """Compile-or-hit + dispatch one bucket-shaped batch; returns
+        (X, bwds, info). Tainted entries (compiled while a fault plan
+        fired — poisoned for life) are dropped so any retry
+        re-compiles clean."""
+        import jax.numpy as jnp
+        As, bs = self._stack(key, reqs)
+        Aj, bj = jnp.asarray(As), jnp.asarray(bs)   # ONE transfer
+        entry = self.cache.get(key, self._builder(key, reqs[0].kwargs),
+                               Aj, bj)
+        out = entry.fn(Aj, bj)
+        if entry.tainted:
+            self.cache.invalidate(key)
+        return (np.asarray(out[0]), np.asarray(out[1]),
+                out[2] if len(out) > 2 else None)
+
+    def _dispatch(self, group, reqs: List[_Request]) -> None:
+        import jax.numpy as jnp
+        key = group._replace(batch=cache_mod.bucket_batch(len(reqs)))
+        try:
+            X, bwds, info = self._run(key, reqs)
+        except Exception as exc:       # compile/dispatch failure:
+            for r in reqs:             # every request fails loudly
+                r.future._fail(exc)
+            raise
+        with self._lock:
+            self._batches += 1
+        self.metrics.counter("serving_batches_total").inc()
+        self.metrics.histogram("serving_batch_size").observe(len(reqs))
+        first_exc: Optional[BaseException] = None
+        nfailed = 0
+        for i, r in enumerate(reqs):
+            # per-request isolation: a raising remediation (the solo
+            # recompile, an escalation route) must fail THIS future
+            # only — the remaining batch-mates still resolve, and no
+            # caller blocks forever on an unresolved future
+            try:
+                x = X[i, :r.n, :r.nrhs]
+                if inject.armed():
+                    # per-request response tap (module docstring) —
+                    # only pay the round-trip while a plan is live
+                    x = np.asarray(inject.tap("serving",
+                                              jnp.asarray(x)))
+                meta = {"batch": len(reqs), "batched": True,
+                        "bucket": (key.n, key.nrhs, key.batch)}
+                if info is not None:
+                    meta["refine"] = self._refine_meta(info, i)
+                ok, health, verdict = self._verify(
+                    r, x, meta.get("refine"),
+                    bwd=None if inject.armed() else float(bwds[i]))
+                meta.update(verdict)
+                if not ok:
+                    x, meta = self._remediate(r, x, health, meta,
+                                              batch_key=key)
+                # latency is the user-visible submit->resolve span,
+                # INCLUDING any remediation walk this request took
+                lat = time.perf_counter() - r.t_submit
+                meta["latency_s"] = lat
+                with self._lock:
+                    self._latencies.append(lat)
+                self.metrics.histogram("serving_latency_s").observe(
+                    lat)
+                r.future._resolve(x[:, 0] if r.vec else x, meta)
+            except Exception as exc:
+                r.future._fail(exc)
+                first_exc = first_exc or exc
+                nfailed += 1
+        if first_exc is not None:
+            # delivered to the owning futures above; do NOT re-raise —
+            # dispatch may be running inside an INNOCENT batch-mate's
+            # result()/submit() call (or a timer thread), and a
+            # foreign request's failure must not surface there. One
+            # stderr note so timer-thread failures aren't invisible.
+            import sys
+            sys.stderr.write(
+                f"#! serving: {nfailed} request(s) failed in "
+                f"dispatch: {first_exc!r}\n")
+
+    @staticmethod
+    def _refine_meta(info, i: int) -> dict:
+        hist = [float(v) for v in np.asarray(info["backward_errors"])[i]
+                if v >= 0]
+        return {"converged": bool(np.asarray(info["converged"])[i]),
+                "iterations": int(np.asarray(info["iterations"])[i]),
+                "backward_errors": hist}
+
+    # ---------------------------------------------------- verification
+    def _verify(self, r: _Request, x: np.ndarray,
+                refine_meta: Optional[dict], bwd: Optional[float] = None
+                ) -> Tuple[bool, dict, dict]:
+        """Per-request health gate: non-finite census + normwise
+        backward error (and the IR convergence verdict). ``bwd`` is
+        the error the batched executable computed in-line
+        (:func:`serving.batched.backward_errors`); recomputed on the
+        host when absent (remediation rungs) or when a fault plan is
+        armed (the serving tap corrupts AFTER the executable measured
+        its error — the gate must see the corruption)."""
+        bad = int(np.size(x) - np.isfinite(x).sum())
+        health = {"nan": int(np.isnan(x).sum()),
+                  "inf": bad - int(np.isnan(x).sum()),
+                  "leaves": 1, "ok": bad == 0}
+        if not self.check:
+            return health["ok"], health, {"ok": health["ok"]}
+        verdict: dict = {}
+        ok = health["ok"]
+        if ok:
+            if bwd is None:
+                res = r.b - r.a @ x
+                den = (max(np.max(np.abs(r.a)), 1.0)
+                       * np.max(np.abs(x)) + np.max(np.abs(r.b)))
+                tiny = float(np.finfo(r.a.dtype).tiny)
+                bwd = float(np.max(np.abs(res)) / max(den, tiny))
+            verdict["backward_error"] = float(bwd)
+            gate = _GATE * float(np.finfo(r.a.dtype).eps) * r.n
+            if refine_meta is not None:
+                # the convergence mask was measured INSIDE the
+                # executable, before the response left it — a
+                # corrupted-in-flight (finite-but-wrong) IR response
+                # must still fail the host-side residual gate
+                ok = (refine_meta["converged"] and np.isfinite(bwd)
+                      and bwd <= gate)
+            else:
+                ok = bwd <= gate
+        verdict["ok"] = bool(ok)
+        return bool(ok), health, verdict
+
+    # ----------------------------------------------------- remediation
+    def _solo_key(self, r: _Request) -> cache_mod.CacheKey:
+        return cache_mod.make_key(
+            r.op, r.n, r.a.dtype, 1, r.nrhs,
+            extra=tuple(sorted(r.kwargs.items())))
+
+    def _solo(self, r: _Request):
+        """The retry rung: re-solve this one request alone (batch
+        bucket 1) through the same stack/build path as the batched
+        dispatch — a fresh executable when the batched one was dropped
+        as tainted."""
+        X, _bwds, info = self._run(self._solo_key(r), [r])
+        return X[0, :r.n, :r.nrhs], (
+            self._refine_meta(info, 0) if info is not None else None)
+
+    def _escalate(self, r: _Request):
+        """The algorithm-escalation rung: the trusted unbatched route
+        — posv -> pivoted LU, gesv -> QR least squares, the IR ops ->
+        their full-precision f64-equivalent solvers (exactly the
+        escape :mod:`dplasma_tpu.ops.refine` wires internally)."""
+        from dplasma_tpu.descriptors import TileMatrix
+        from dplasma_tpu.ops import lu as lu_mod
+        from dplasma_tpu.ops import potrf as potrf_mod
+        from dplasma_tpu.ops import qr as qr_mod
+        At = TileMatrix.from_dense(r.a, self.nb, self.nb)
+        Bt = TileMatrix.from_dense(r.b, self.nb, self.nb)
+        if r.op == "posv":
+            _, _, X = lu_mod.gesv_1d(At, Bt)
+        elif r.op == "gesv":
+            X = qr_mod.gels(At, Bt)
+        elif r.op == "posv_ir":
+            _, X = potrf_mod.posv(At, Bt, "L")
+        else:   # gesv_ir
+            _, _, X = lu_mod.gesv_1d(At, Bt)
+        return np.asarray(X.to_dense())[:r.n, :r.nrhs], None
+
+    def _remediate(self, r: _Request, x: np.ndarray, health: dict,
+                   meta: dict,
+                   batch_key: Optional[cache_mod.CacheKey] = None
+                   ) -> Tuple[np.ndarray, dict]:
+        """Walk the PR 2 ladder for ONE request (classify -> retry ->
+        kernel fallback -> algorithm escalation); batch-mates are
+        never re-dispatched."""
+        ip = types.SimpleNamespace(max_retries=self.max_retries,
+                                   inject=None, abft=False,
+                                   run_timeout=0.0)
+        ladder = guard.Ladder(ip, r.op, fallbacks=[
+            (f"{r.op}_escalate", self._escalate)])
+        cls = ladder.classify(health, None, False)
+        ladder.record(guard.ACTION_PRIMARY, f"batched[{meta['batch']}]",
+                      ok=False, classification=cls, health=health)
+        self.metrics.counter("serving_faults_total", op=r.op).inc()
+        while True:
+            nxt = ladder.next_action(cls)
+            if nxt is None:
+                break
+            action, label, fn = nxt
+            if action == guard.ACTION_KERNEL_FALLBACK:
+                guard.kernel_fallback()
+                # the demotion changes what a fresh trace compiles,
+                # but not the cache keys: drop the solo executable the
+                # retry rung cached so this rung actually re-traces on
+                # the demoted kernel set, AND the batched executable
+                # this request came from — otherwise every future
+                # batch under that key replays the distrusted program
+                # and walks the ladder forever
+                self.cache.invalidate(self._solo_key(r))
+                if batch_key is not None:
+                    self.cache.invalidate(batch_key)
+            if action == guard.ACTION_RETRY:
+                self.metrics.counter("serving_retries_total",
+                                     op=r.op).inc()
+            if action == guard.ACTION_ALGO_FALLBACK:
+                self.metrics.counter("serving_escalations_total",
+                                     op=r.op).inc()
+            # remediation runs clean, like the driver ladder's rungs
+            # (a transient fault does not recur on recompute)
+            with inject.suppressed():
+                if fn is not None:
+                    x2, rmeta = fn(r)
+                else:
+                    x2, rmeta = self._solo(r)
+            ok2, health2, verdict2 = self._verify(r, x2, rmeta)
+            ladder.record(action, label, ok2,
+                          classification=None if ok2
+                          else ladder.classify(health2, None, False),
+                          health=health2)
+            if ok2:
+                ladder.winner = label
+                x = x2
+                meta.update(verdict2)
+                if rmeta is not None:
+                    meta["refine"] = rmeta
+                break
+            cls = ladder.classify(health2, None, False)
+        summary = ladder.summary(injection=None)
+        meta["resilience"] = summary
+        meta["ok"] = summary["outcome"] != "failed"
+        with self._lock:
+            self.resilience.append(summary)
+        if summary["outcome"] == "failed":
+            self.metrics.counter("serving_failed_total", op=r.op).inc()
+        return x, meta
+
+    # --------------------------------------------------------- summary
+    def reset_stats(self) -> None:
+        """Zero the request/batch/latency/remediation records (the
+        cache and its counters stay): benches call this after a
+        warmup pass so the summary covers measured traffic only —
+        a warmup compile latency is not service latency."""
+        with self._lock:
+            self._latencies.clear()
+            self.resilience.clear()
+            self._batches = 0
+            self._requests = 0
+
+    def summary(self) -> dict:
+        """The run-report schema-v8 ``"serving"`` entry for this
+        service's lifetime (requests, batching, latency percentiles,
+        cache economics, remediation outcomes)."""
+        with self._lock:
+            lats = sorted(self._latencies)
+            batches = self._batches
+            requests = self._requests
+            res = list(self.resilience)
+        return {"requests": requests, "batches": batches,
+                "mean_batch": (requests / batches) if batches else None,
+                "latency_s": {"p50": percentile(lats, 50),
+                              "p99": percentile(lats, 99),
+                              "max": lats[-1] if lats else None},
+                "cache": self.cache.stats(),
+                "remediated": sum(1 for s in res
+                                  if s["outcome"] == "remediated"),
+                "failed": sum(1 for s in res
+                              if s["outcome"] == "failed"),
+                "retries": sum(
+                    1 for s in res for a in s["attempts"]
+                    if a["action"] == guard.ACTION_RETRY),
+                "escalations": sum(
+                    1 for s in res for a in s["attempts"]
+                    if a["action"] == guard.ACTION_ALGO_FALLBACK)}

@@ -72,3 +72,76 @@ def test_dtd_dag_recording():
     assert len(rec.edges) == len(tp.edges)
     dot = rec.to_dot("potrf_dtd")
     assert "potrf" in dot and "gemm" in dot
+
+
+def test_record_dag_same_task_same_tiles_stays_distinct():
+    """DTD legally inserts the same task class on the same tile twice
+    (two sequential updates); the recorded DAG must keep two nodes
+    and an ordering edge — not dedupe them into one node with a
+    self-loop (regression: the recorder keys on (class, index), so
+    the insertion id now disambiguates)."""
+    A = TileMatrix.zeros(8, 8, 4, 4)
+    tp = dtd.TaskPool(A)
+    t0 = tp.insert_task(lambda x: x + 1, tp.tile(0, 0, 0, dtd.INOUT),
+                        name="scale")
+    t1 = tp.insert_task(lambda x: x * 2, tp.tile(0, 0, 0, dtd.INOUT),
+                        name="scale")
+    assert (t0, t1) in tp.edges
+    rec = DagRecorder(enabled=True)
+    tp.record_dag(rec)
+    assert len(rec.tasks) == 2
+    assert len(rec.edges) == 1
+    (s, d, _lab) = rec.edges[0]
+    assert s != d, "ordering edge collapsed into a self-loop"
+    # replay still applies both updates in order
+    (out,) = tp.wait()
+    assert np.allclose(np.asarray(out.tile(0, 0)), 2.0)
+
+
+def test_record_dag_multi_matrix_refs():
+    """Tasks spanning two pool operands record with the full
+    flattened ref index (and the inferred cross-matrix flow edges)."""
+    A = TileMatrix.zeros(8, 8, 4, 4)
+    B = TileMatrix.zeros(8, 8, 4, 4)
+    tp = dtd.TaskPool(A, B)
+    t0 = tp.insert_task(lambda a: a + 3, tp.tile(0, 0, 0, dtd.INOUT),
+                        name="gen")
+    t1 = tp.insert_task(lambda a, b: a + b,
+                        tp.tile(0, 0, 0, dtd.IN),
+                        tp.tile(1, 1, 1, dtd.INOUT), name="acc")
+    assert (t0, t1) in tp.edges
+    rec = DagRecorder(enabled=True)
+    tp.record_dag(rec)
+    assert len(rec.tasks) == 2 and len(rec.edges) == 1
+    outs = tp.wait()
+    assert np.allclose(np.asarray(outs[1].tile(1, 1)), 3.0)
+
+
+def test_schedule_lookahead_path():
+    """TaskPool.schedule(lookahead=...) rides the native wavefront
+    scheduler: every lookahead produces a dependence-respecting
+    permutation, identical to calling the scheduler directly, and the
+    inserted order itself is always admissible (the DTD
+    sequential-consistency contract)."""
+    from dplasma_tpu import native
+    A = TileMatrix.zeros(16, 16, 4, 4)
+    tp = dtd.TaskPool(A)
+    t0 = tp.insert_task(lambda x: x + 1, tp.tile(0, 0, 0, dtd.INOUT))
+    t1 = tp.insert_task(lambda x: x + 1, tp.tile(0, 1, 1, dtd.INOUT))
+    t2 = tp.insert_task(lambda x, y: y + x,
+                        tp.tile(0, 0, 0, dtd.IN),
+                        tp.tile(0, 2, 2, dtd.INOUT))
+    t3 = tp.insert_task(lambda x, y: y + x,
+                        tp.tile(0, 1, 1, dtd.IN),
+                        tp.tile(0, 3, 3, dtd.INOUT))
+    t4 = tp.insert_task(lambda x: x * 2, tp.tile(0, 0, 0, dtd.INOUT))
+    assert {(t0, t2), (t1, t3), (t0, t4)} <= set(tp.edges)
+    n = len(tp.tasks)
+    for la in (0, 1, 2, 8):
+        order = list(tp.schedule(lookahead=la))
+        assert sorted(order) == list(range(n)), order
+        pos = {t: i for i, t in enumerate(order)}
+        for s, d in tp.edges:
+            assert pos[s] < pos[d], (la, s, d, order)
+        ref = native.wavefront_order(n, tp.edges, None, la)
+        assert order == list(ref), (la, order, ref)

@@ -72,11 +72,13 @@ def test_lint_cli_exit_codes(tmp_path):
 def test_lint_all_aggregate_is_clean(capsys):
     """tools/lint_all.py gates every rule with one exit code: excepts,
     jaxlint, the perfdiff smoke, the pallas contract gate, and the
-    dagcheck/spmdcheck smoke passes must all be clean on the repo."""
+    dagcheck/spmdcheck/serving smoke passes must all be clean on the
+    repo."""
     import lint_all
     rc = lint_all.main([])
     out = capsys.readouterr()
     assert rc == 0, out.err
     for gate in ("lint_excepts", "jaxlint", "perfdiff-smoke",
-                 "palcheck", "dagcheck-smoke", "spmdcheck-smoke"):
+                 "palcheck", "dagcheck-smoke", "spmdcheck-smoke",
+                 "serving-smoke"):
         assert f"# {gate}: OK" in out.out

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 7
+    assert doc["schema"] == REPORT_SCHEMA == 8
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -123,6 +123,18 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
                         "iterations": 2, "backward_errors": [1e-8],
                         "converged": True, "escalated": False,
                         "tol": 2.2e-14}]},
+        8: {"schema": 8, "name": "v8", "ops": [], "metrics": [],
+            "serving": [{"requests": 64, "batches": 6,
+                         "mean_batch": 10.7,
+                         "latency_s": {"p50": 0.004, "p99": 0.009,
+                                       "max": 0.01},
+                         "cache": {"entries": 6, "capacity": 32,
+                                   "hits": 12, "misses": 6,
+                                   "evictions": 0, "invalidations": 0,
+                                   "hit_rate": 0.667,
+                                   "compile_s": 1.5},
+                         "remediated": 0, "failed": 0, "retries": 0,
+                         "escalations": 0}]},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -373,7 +385,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 7
+    assert doc["schema"] == 8
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

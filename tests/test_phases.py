@@ -252,7 +252,7 @@ def test_driver_phase_profile_acceptance(tmp_path, capsys, prog):
     overhead) to the attributed run time."""
     doc = _phase_run(tmp_path, prog)
     out = capsys.readouterr().out
-    assert doc["schema"] == 7
+    assert doc["schema"] == 8
     (op,) = doc["ops"]
     ph = op["phases"]
     spans = ph["spans"]
@@ -430,13 +430,56 @@ def test_perfdiff_reports_vanished_baseline_metrics(tmp_path, capsys):
 
 def test_perfdiff_unusable_inputs(tmp_path, capsys):
     a = _write(tmp_path, "a.json", _report_doc())
-    other = _write(tmp_path, "o.json", _report_doc(label="elsewhere"))
-    assert perfdiff.main([a, other]) == 2        # nothing comparable
+    bare = _write(tmp_path, "bare.json",
+                  {"schema": 1, "ops": [], "metrics": []})
+    assert perfdiff.main([a, bare]) == 2         # nothing extractable
     assert perfdiff.main([a, str(tmp_path / "missing.json")]) == 2
     empty = tmp_path / "empty.jsonl"
     empty.write_text("")
     assert perfdiff.main([str(empty), a]) == 2
     assert perfdiff.main([a, a, "--metric-threshold", "oops"]) == 2
+
+
+def test_perfdiff_new_metrics_are_informational(tmp_path, capsys):
+    """Candidate metrics with no baseline counterpart exit 0 with a
+    note — the FIRST entry of a new metric family (e.g. the serving
+    layer's first v8 ledger entry against a pre-serving baseline)
+    seeds the baseline; it cannot regress, and it must not break
+    ``bench.py --gate`` / ``servebench --gate``."""
+    a = _write(tmp_path, "a.json", _report_doc())
+    other = _write(tmp_path, "o.json", _report_doc(label="elsewhere"))
+    assert perfdiff.main([a, other]) == 0
+    out = capsys.readouterr().out
+    assert "not in baseline" in out and "elsewhere.median_s" in out
+    # disjoint-but-new metrics alongside a common one still gate the
+    # common one
+    serving = _report_doc()
+    serving["entries"] = [{"metric": "serving.p50_ms", "value": 3.0,
+                           "better": "lower"}]
+    res = perfdiff.compare(_report_doc(), serving)
+    assert res["new"] == ["serving.p50_ms"] and res["ok"]
+
+
+def test_perfdiff_latest_comparable_entry(tmp_path):
+    """Gates sharing one ledger across bench families must baseline
+    against the newest SAME-FAMILY entry, or interleaved bench.py /
+    servebench runs would compare cross-family forever (compared==0,
+    informational pass) and never gate a real regression."""
+    ledger = str(tmp_path / "h.jsonl")
+    e1 = {"ladder": [{"metric": "a_gflops", "value": 10.0}]}
+    e2 = {"entries": [{"metric": "serving.p50_ms", "value": 5.0,
+                       "better": "lower"}]}
+    e3 = {"ladder": [{"metric": "a_gflops", "value": 11.0}]}
+    for e in (e1, e2, e3):
+        perfdiff.append_ledger(ledger, e)
+    cand = {"entries": [{"metric": "serving.p50_ms", "value": 6.0,
+                         "better": "lower"}]}
+    assert perfdiff.latest_comparable_entry(ledger, cand) == e2
+    candl = {"ladder": [{"metric": "a_gflops", "value": 9.0}]}
+    assert perfdiff.latest_comparable_entry(ledger, candl) == e3
+    # nothing comparable (or no metrics at all): newest raw entry,
+    # so the callers' vacuous-gate handling still engages
+    assert perfdiff.latest_comparable_entry(ledger, {"ops": []}) == e3
 
 
 def test_perfdiff_compare_api_old_schema_docs():

@@ -281,7 +281,7 @@ def test_report_pipeline_section_schema_v6(tmp_path, capsys):
     assert rc == 0
     assert "#+ pipeline: sweep.lookahead=" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 7
+    assert doc["schema"] == 8
     assert set(doc["pipeline"]) == {"sweep.lookahead", "qr.agg_depth"}
 
 

@@ -1,0 +1,594 @@
+"""The serving subsystem: batched execution paths (batched-vs-looped
+equivalence incl. ragged shapes and per-element IR convergence masks),
+the bucketed executable cache (key determinism, LRU, padding
+exactness), the SolverService front-end (batching scheduler, scatter,
+per-request resilience ladder under fault injection), the servebench
+throughput tool, and the ops.map tile-helper lift the batched paths
+ride on.
+
+The trace/compile-heavy proofs (full batched-vs-looped equivalence
+sweeps, the servebench throughput acceptance) carry the repo's
+``slow`` marker — tier-1 keeps the cheap contract tests plus the
+``tools/lint_all.py`` serving smoke (posv/gesv round-trip +
+padded-vs-exact, enforced from tests/test_lint.py); run the full set
+with ``-m slow``. The recorded throughput demonstration lives in
+``SERVEBENCH_r01.json`` (run-report schema v8)."""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.observability.metrics import MetricsRegistry
+from dplasma_tpu.ops import checks
+from dplasma_tpu.ops import lu as lu_mod
+from dplasma_tpu.ops import map as map_ops
+from dplasma_tpu.ops import potrf as potrf_mod
+from dplasma_tpu.ops import refine
+from dplasma_tpu.resilience import inject
+from dplasma_tpu.serving import SolverService, batched
+from dplasma_tpu.serving import cache as scache
+
+NB = 4
+
+#: jitted batched entries (tests run each once; the compiled programs
+#: land in the suite's persistent compile cache, like every dd route)
+_potrf_b = jax.jit(lambda A: batched.potrf_batched(A, NB))
+_potrs_b = jax.jit(lambda L, B: batched.potrs_batched(L, B, NB))
+_getrf_b = jax.jit(lambda A: batched.getrf_batched(A, NB))
+_getrs_b = jax.jit(
+    lambda F, p, B: batched.getrs_batched(F, p, B, NB))
+_gesv_b = jax.jit(lambda A, B: batched.gesv_batched(A, B, NB))
+_ir_b = {
+    "posv_ir": jax.jit(
+        lambda A, B: batched.posv_ir_batched(A, B, NB, max_iters=4)),
+    "gesv_ir": jax.jit(
+        lambda A, B: batched.gesv_ir_batched(A, B, NB, max_iters=4)),
+}
+_posv_ir_b2 = jax.jit(
+    lambda A, B: batched.posv_ir_batched(A, B, NB, max_iters=2))
+
+
+def _spd(rng, B, n, dtype=np.float32):
+    a = rng.standard_normal((B, n, n)).astype(dtype)
+    return a @ a.transpose(0, 2, 1) + n * np.eye(n, dtype=dtype)
+
+
+def _gen(rng, B, n, dtype=np.float32):
+    return (rng.standard_normal((B, n, n)).astype(dtype)
+            + n * np.eye(n, dtype=dtype))
+
+
+def _rhs(rng, B, n, nrhs, dtype=np.float32):
+    return rng.standard_normal((B, n, nrhs)).astype(dtype)
+
+
+# ------------------------------------------------- batched equivalence
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [10])     # ragged tiles (nb=4); the
+# square-tile case rides the service tests (n=8) + the lint smoke
+def test_potrf_potrs_batched_match_loop(n):
+    rng = np.random.default_rng(7)
+    A = _spd(rng, 3, n)
+    b = _rhs(rng, 3, n, 2)
+    L = np.asarray(_potrf_b(jnp.asarray(A)))
+    X = np.asarray(_potrs_b(jnp.asarray(L), jnp.asarray(b)))
+    for i in range(3):
+        At = TileMatrix.from_dense(A[i], NB, NB)
+        Li = potrf_mod.potrf(At, "L")
+        Xi = potrf_mod.potrs(Li, TileMatrix.from_dense(b[i], NB, NB))
+        assert np.allclose(np.tril(L[i]),
+                           np.tril(np.asarray(Li.to_dense())),
+                           atol=1e-5)
+        assert np.allclose(X[i], np.asarray(Xi.to_dense()), atol=1e-4)
+        r, ok = checks.check_solve(
+            At, TileMatrix.from_dense(b[i], NB, NB),
+            TileMatrix.from_dense(X[i], NB, NB), scale=60.0 * n)
+        assert ok, f"element {i} backward error {r}"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [10])
+def test_getrf_getrs_batched_match_loop(n):
+    rng = np.random.default_rng(8)
+    A = _gen(rng, 3, n)
+    b = _rhs(rng, 3, n, 2)
+    LUp, perm = _getrf_b(jnp.asarray(A))
+    X = np.asarray(_getrs_b(LUp, perm, jnp.asarray(b)))
+    X2 = np.asarray(_gesv_b(jnp.asarray(A), jnp.asarray(b)))
+    for i in range(3):
+        Fi, pi = lu_mod.getrf_1d(TileMatrix.from_dense(A[i], NB, NB))
+        Xi = lu_mod.getrs("N", Fi, pi,
+                          TileMatrix.from_dense(b[i], NB, NB))
+        assert np.array_equal(np.asarray(perm[i]), np.asarray(pi)), \
+            f"element {i}: pivot order diverged from the unbatched op"
+        assert np.allclose(np.asarray(LUp[i]), np.asarray(Fi.data),
+                           atol=1e-5)
+        assert np.allclose(X[i], np.asarray(Xi.to_dense()), atol=1e-4)
+        assert np.allclose(X2[i], np.asarray(Xi.to_dense()), atol=1e-4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("op,gen", [("posv_ir", _spd),
+                                    ("gesv_ir", _gen)])
+def test_ir_batched_matches_loop_and_masks(op, gen):
+    """Batched IR refines each element independently (traced masked
+    loop under vmap) and matches a loop of the unbatched solver within
+    the check_solve gate."""
+    rng = np.random.default_rng(9)
+    n = 8
+    A = gen(rng, 2, n, np.float64)
+    b = _rhs(rng, 2, n, 2, np.float64)
+    X, info = _ir_b[op](jnp.asarray(A), jnp.asarray(b))
+    X = np.asarray(X)
+    assert np.asarray(info["converged"]).shape == (2,)
+    assert np.asarray(info["converged"]).all()
+    assert np.asarray(info["backward_errors"]).shape == (2, 5)
+    assert not np.asarray(info["escalated"]).any()
+    one = refine.posv_ir if op == "posv_ir" else refine.gesv_ir
+    for i in range(2):
+        At = TileMatrix.from_dense(A[i], NB, NB)
+        bt = TileMatrix.from_dense(b[i], NB, NB)
+        Xi, ii = one(At, bt, max_iters=4, escalate=False)
+        assert bool(np.asarray(ii["converged"]))
+        r, ok = checks.check_solve(
+            At, bt, TileMatrix.from_dense(X[i], NB, NB),
+            uplo=None)
+        assert ok, f"element {i} backward error {r}"
+        assert np.allclose(X[i], np.asarray(Xi.to_dense()),
+                           atol=1e-11)
+
+
+@pytest.mark.slow
+def test_ir_batched_per_element_convergence_mask():
+    """One hard element must not stop an easy batch-mate from
+    converging: the convergence mask is per element."""
+    rng = np.random.default_rng(10)
+    n = 8
+    A = _spd(rng, 2, n, np.float64)
+    # element 1: severely ill-conditioned SPD (tiny eigenvalue)
+    w, v = np.linalg.eigh(A[1])
+    w[0] = w[-1] * 1e-13
+    A[1] = (v * w) @ v.T
+    b = _rhs(rng, 2, n, 1, np.float64)
+    _, info = _posv_ir_b2(jnp.asarray(A), jnp.asarray(b))
+    conv = np.asarray(info["converged"])
+    assert bool(conv[0]), "well-conditioned mate must converge"
+    iters = np.asarray(info["iterations"])
+    # the hard element kept refining (or hit the budget) without
+    # blocking the converged one
+    assert iters[0] <= iters[1] or not conv[1]
+
+
+# --------------------------------------------------- cache + bucketing
+
+def test_bucket_ladders():
+    assert [scache.bucket_dim(v) for v in (1, 8, 9, 12, 13, 17, 25)] \
+        == [8, 8, 12, 12, 16, 24, 32]
+    assert scache.bucket_dim(5, floor=scache.MIN_NRHS_BUCKET) == 6
+    assert scache.bucket_dim(9, policy="pow2") == 16
+    assert scache.bucket_dim(9, policy="exact") == 9
+    assert [scache.bucket_batch(v) for v in (1, 2, 3, 9)] == [1, 2, 4,
+                                                              16]
+
+
+def test_make_key_deterministic_and_bucketed():
+    k1 = scache.make_key("posv", 10, np.float32, 3, 2)
+    k2 = scache.make_key("posv", 10, np.float32, 3, 2)
+    assert k1 == k2 and hash(k1) == hash(k2)
+    assert k1.n == scache.bucket_dim(10)
+    assert k1.batch == 4 and k1.dtype == "float32"
+    # shapes in the same bucket share the key
+    assert scache.make_key("posv", 9, np.float32, 3, 2) == k1
+    # IR ops carry the working precision
+    assert scache.make_key("posv_ir", 10, np.float64, 3, 2).precision \
+        in refine.PRECISIONS
+    assert k1.precision == ""
+
+
+@pytest.mark.slow   # the padded-vs-exact contract also gates tier-1
+# through the lint_all serving smoke (tests/test_lint.py)
+def test_padding_does_not_perturb_solution():
+    rng = np.random.default_rng(11)
+    n, nrhs = 6, 2
+    A = _spd(rng, 2, n)
+    b = _rhs(rng, 2, n, nrhs)
+    nB, rB = scache.bucket_dim(n), scache.bucket_dim(
+        nrhs, floor=scache.MIN_NRHS_BUCKET)
+    Ap = np.asarray(scache.pad_problem(jnp.asarray(A), nB))
+    bp = np.asarray(scache.pad_rhs(jnp.asarray(b), nB, rB))
+    assert Ap.shape == (2, nB, nB) and bp.shape == (2, nB, rB)
+    idx = np.arange(n, nB)
+    assert np.array_equal(Ap[:, idx, idx], np.ones((2, nB - n),
+                                                   np.float32))
+    assert np.all(bp[:, n:, :] == 0) and np.all(bp[:, :, nrhs:] == 0)
+    posv_j = jax.jit(lambda a, rhs: batched.posv_batched(a, rhs, NB))
+    X = np.asarray(posv_j(jnp.asarray(A), jnp.asarray(b)))
+    Xp = np.asarray(posv_j(jnp.asarray(Ap), jnp.asarray(bp)))
+    assert np.allclose(Xp[:, :n, :nrhs], X, atol=1e-4)
+    assert np.allclose(Xp[:, n:, :], 0.0)   # identity block: x pad = 0
+
+
+def test_executable_cache_lru_and_metrics():
+    reg = MetricsRegistry()
+    c = scache.ExecutableCache(capacity=2, metrics=reg)
+    calls = []
+
+    def build_for(tag):
+        def build():
+            calls.append(tag)
+            return lambda x: x + 1
+        return build
+
+    x = jnp.zeros((2, 2), jnp.float32)
+    k = [scache.make_key("posv", 8 * (i + 1), np.float32, 1, 1)
+         for i in range(3)]
+    e0 = c.get(k[0], build_for(0), x)
+    assert not e0.tainted and e0.compile_s >= 0
+    assert c.get(k[0], build_for(0), x) is e0      # hit
+    c.get(k[1], build_for(1), x)
+    c.get(k[2], build_for(2), x)                   # evicts k[0] (LRU)
+    assert k[0] not in c and k[1] in c and k[2] in c
+    assert calls == [0, 1, 2]
+    s = c.stats()
+    assert s["hits"] == 1 and s["misses"] == 3 and s["evictions"] == 1
+    assert s["hit_rate"] == pytest.approx(0.25)
+    assert s["compile_s"] > 0
+    assert c.invalidate(k[1]) and not c.invalidate(k[1])
+    assert json.loads(json.dumps(s)) == s
+
+
+# -------------------------------------------------------- the service
+
+def test_service_batches_and_scatters_ragged():
+    """Compatible ragged requests (same bucket, different exact n and
+    nrhs) ride ONE batched executable and scatter back exactly."""
+    rng = np.random.default_rng(12)
+    svc = SolverService(nb=NB, max_batch=8, max_wait_ms=0)
+    sizes = [(10, 1), (9, 2), (12, 3)]    # all bucket to n=12, nrhs=4
+    reqs = []
+    for n, nrhs in sizes:
+        a = _spd(rng, 1, n)[0]
+        b = _rhs(rng, 1, n, nrhs)[0]
+        reqs.append((a, b, svc.submit("posv", a, b)))
+    svc.flush()
+    for a, b, fut in reqs:
+        x = fut.result(60.0)
+        assert x.shape == b.shape
+        assert fut.meta["batch"] == 3 and fut.meta["batched"]
+        assert fut.meta["bucket"][0] == 12
+        xr = np.linalg.solve(a.astype(np.float64),
+                             b.astype(np.float64))
+        assert np.allclose(x, xr, atol=1e-3)
+        assert fut.meta["ok"]
+    assert svc.summary()["batches"] == 1
+    assert svc.cache.stats()["misses"] == 1
+
+
+def test_service_max_batch_triggers_dispatch_and_cache_hits():
+    rng = np.random.default_rng(13)
+    svc = SolverService(nb=NB, max_batch=2, max_wait_ms=0)
+    a = _spd(rng, 4, 8)
+    b = _rhs(rng, 4, 8, 1)
+    f0 = svc.submit("posv", a[0], b[0])
+    assert not f0.done()
+    f1 = svc.submit("posv", a[1], b[1])     # fills the batch
+    assert f0.done() and f1.done()          # dispatched synchronously
+    # second pair: same key -> executable cache hit
+    f2 = svc.submit("posv", a[2], b[2])
+    f3 = svc.submit("posv", a[3], b[3])
+    assert f3.done()
+    st = svc.cache.stats()
+    assert st["misses"] == 1 and st["hits"] == 1
+    for i, f in enumerate((f0, f1, f2, f3)):
+        xr = np.linalg.solve(a[i].astype(np.float64),
+                             b[i].astype(np.float64))
+        assert np.allclose(f.result(1.0), xr, atol=1e-3)
+
+
+def test_service_result_drives_pending_group():
+    """A caller blocking on a pending future dispatches its group —
+    no timer needed (max_wait_ms=0 disables the window)."""
+    rng = np.random.default_rng(14)
+    svc = SolverService(nb=NB, max_batch=8, max_wait_ms=0)
+    a = _spd(rng, 1, 8)[0]
+    b = _rhs(rng, 1, 8, 1)[0][:, 0]        # 1-D rhs round-trips 1-D
+    fut = svc.submit("posv", a, b)
+    assert not fut.done()
+    x = fut.result(60.0)
+    assert x.shape == b.shape
+    assert np.allclose(x, np.linalg.solve(a.astype(np.float64),
+                                          b.astype(np.float64)),
+                       atol=1e-3)
+
+
+def test_service_wait_window_dispatches(monkeypatch):
+    """The max_wait_ms timer flushes an incomplete group."""
+    rng = np.random.default_rng(15)
+    svc = SolverService(nb=NB, max_batch=8, max_wait_ms=30.0)
+    a = _spd(rng, 1, 8)[0]
+    b = _rhs(rng, 1, 8, 1)[0]
+    fut = svc.submit("posv", a, b)
+    fut._event.wait(10.0)                  # timer must fire on its own
+    assert fut.done()
+    svc.close()
+
+
+def test_service_submit_validation():
+    svc = SolverService(nb=NB)
+    ok_a = np.eye(8, dtype=np.float32)
+    ok_b = np.ones((8, 1), np.float32)
+    with pytest.raises(ValueError):
+        svc.submit("potrs", ok_a, ok_b)          # not servable
+    with pytest.raises(ValueError):
+        svc.submit("posv", ok_a[:4], ok_b)       # non-square A
+    with pytest.raises(ValueError):
+        svc.submit("posv", ok_a, ok_b[:4])       # shape mismatch
+    with pytest.raises(TypeError):
+        svc.submit("posv", ok_a, ok_b.astype(np.float64))
+    with pytest.raises(TypeError):
+        svc.submit("posv_ir", ok_a, ok_b)        # IR wants f64
+
+
+def test_service_ir_request_reports_refinement():
+    rng = np.random.default_rng(16)
+    a = _spd(rng, 1, 8, np.float64)[0]
+    b = _rhs(rng, 1, 8, 1, np.float64)[0]
+    svc = SolverService(nb=NB, max_batch=4, max_wait_ms=0)
+    fut = svc.submit("posv_ir", a, b, max_iters=3)
+    x = fut.result(120.0)
+    assert fut.meta["refine"]["converged"]
+    assert fut.meta["ok"]
+    assert np.allclose(x, np.linalg.solve(a, b), atol=1e-9)
+    # a FINITE corruption of an IR response must fail the residual
+    # gate and remediate: the convergence mask alone was measured
+    # inside the executable, BEFORE the response left it
+    with inject.active(inject.parse_plan("bitflip@serving:1:1")):
+        fut2 = svc.submit("posv_ir", a, b, max_iters=3)
+        x2 = fut2.result(120.0)
+    assert fut2.meta["resilience"]["outcome"] == "remediated"
+    assert fut2.meta["ok"]
+    assert np.allclose(x2, np.linalg.solve(a, b), atol=1e-9)
+
+
+# ------------------------------------------- resilience (e2e, --inject)
+
+def test_injected_fault_heals_without_poisoning_batchmates():
+    """THE serving resilience contract: a single injected-fault
+    request (the DPLASMA_INJECT/--inject serving tap) retries through
+    the PR 2 ladder and succeeds while its batch-mates' results are
+    untouched by remediation."""
+    rng = np.random.default_rng(17)
+    n = 8
+    A = _spd(rng, 3, n)
+    b = _rhs(rng, 3, n, 2)
+    svc = SolverService(nb=NB, max_batch=8, max_wait_ms=0)
+    plan = inject.parse_plan("nan@serving:1:1")
+    with inject.active(plan) as faults:
+        futs = [svc.submit("posv", A[i], b[i]) for i in range(3)]
+        svc.flush()
+        xs = [f.result(120.0) for f in futs]
+    assert len(faults) == 1 and faults[0]["stage"] == "serving"
+    # request 0 took the fault and walked the ladder
+    res0 = futs[0].meta["resilience"]
+    assert res0["outcome"] == "remediated"
+    actions = [a["action"] for a in res0["attempts"]]
+    assert actions[0] == "primary" and "retry" in actions
+    assert not res0["attempts"][0]["ok"]
+    assert res0["attempts"][0]["classification"] == "numerical"
+    # batch-mates: clean, no ladder walked
+    for i in (1, 2):
+        assert "resilience" not in futs[i].meta
+        assert futs[i].meta["ok"]
+    # everyone's answer is right
+    for i in range(3):
+        xr = np.linalg.solve(A[i].astype(np.float64),
+                             b[i].astype(np.float64))
+        assert np.allclose(xs[i], xr, atol=1e-3), f"request {i}"
+    s = svc.summary()
+    assert s["remediated"] == 1 and s["failed"] == 0
+    assert s["retries"] == 1
+
+
+def test_kernel_stage_fault_taints_executable_and_heals():
+    """A kernel-stage fault poisons the batched TRACE: the cache entry
+    is dropped (tainted) and every affected request heals solo."""
+    rng = np.random.default_rng(18)
+    A = _spd(rng, 2, 8)
+    b = _rhs(rng, 2, 8, 1)
+    svc = SolverService(nb=NB, max_batch=8, max_wait_ms=0)
+    with inject.active(inject.parse_plan("nan@trsm:1:1")):
+        futs = [svc.submit("posv", A[i], b[i]) for i in range(2)]
+        svc.flush()
+        xs = [f.result(120.0) for f in futs]
+    assert svc.cache.stats()["invalidations"] >= 1
+    for i in range(2):
+        assert futs[i].meta["ok"]
+        xr = np.linalg.solve(A[i].astype(np.float64),
+                             b[i].astype(np.float64))
+        assert np.allclose(xs[i], xr, atol=1e-3)
+
+
+def test_batchmate_remediation_failure_stays_isolated(capsys):
+    """A request whose remediation ITSELF raises fails only its own
+    future: batch-mates resolve normally, and the exception does not
+    propagate out of an innocent caller's result()/flush()."""
+    rng = np.random.default_rng(21)
+    A = _spd(rng, 2, 8)
+    b = _rhs(rng, 2, 8, 1)
+    svc = SolverService(nb=NB, max_batch=8, max_wait_ms=0,
+                        max_retries=0)
+    # force every remediation rung to blow up
+    svc._solo = svc._escalate = lambda r: (_ for _ in ()).throw(
+        RuntimeError("remediation exploded"))
+    with inject.active(inject.parse_plan("nan@serving:1:1")):
+        futs = [svc.submit("posv", A[i], b[i]) for i in range(2)]
+        svc.flush()                      # must NOT raise
+        x1 = futs[1].result(60.0)        # innocent mate resolves
+    xr = np.linalg.solve(A[1].astype(np.float64),
+                         b[1].astype(np.float64))
+    assert np.allclose(x1, xr, atol=1e-3)
+    with pytest.raises(RuntimeError, match="remediation exploded"):
+        futs[0].result(60.0)             # owner sees its own failure
+
+
+def test_silent_wrong_answer_escalates_per_request():
+    """A finite-but-wrong response (bitflip) fails the backward-error
+    gate and walks to remediation; with retries exhausted the
+    algorithm-escalation rung answers."""
+    rng = np.random.default_rng(19)
+    a = _spd(rng, 1, 8)[0]
+    b = _rhs(rng, 1, 8, 1)[0]
+    svc = SolverService(nb=NB, max_batch=4, max_wait_ms=0,
+                        max_retries=0)
+    with inject.active(inject.parse_plan("bitflip@serving:1:1")):
+        fut = svc.submit("posv", a, b)
+        x = fut.result(120.0)
+    res = fut.meta["resilience"]
+    assert res["outcome"] in ("remediated", "clean")
+    xr = np.linalg.solve(a.astype(np.float64), b.astype(np.float64))
+    assert np.allclose(x, xr, atol=1e-3)
+
+
+# ----------------------------------------- report schema v8 + servebench
+
+def test_run_report_serving_section(tmp_path):
+    from dplasma_tpu.observability.report import (REPORT_SCHEMA,
+                                                  RunReport,
+                                                  load_report)
+    rng = np.random.default_rng(20)
+    svc = SolverService(nb=NB, max_batch=4, max_wait_ms=0)
+    fut = svc.submit("posv", _spd(rng, 1, 8)[0], _rhs(rng, 1, 8, 1)[0])
+    fut.result(60.0)
+    rep = RunReport("serving-test")
+    rep.add_serving(svc.summary())
+    p = str(tmp_path / "r.json")
+    rep.write(p)
+    doc = load_report(p)
+    assert doc["schema"] == REPORT_SCHEMA == 8
+    (s,) = doc["serving"]
+    assert s["requests"] == 1 and s["batches"] == 1
+    assert s["cache"]["misses"] == 1
+    assert s["latency_s"]["p50"] is not None
+
+
+@pytest.mark.slow
+def test_servebench_e2e_throughput_and_gate(tmp_path):
+    """The acceptance run: batched serving sustains >= 2x the
+    one-at-a-time loop on the synthetic workload, latency/cache
+    metrics land in the v8 report + ledger, and the perfdiff gate
+    accepts both the first (informational) and a repeat entry."""
+    import sys
+    sys.path.insert(0, str(tmp_path.parent))
+    from tools import servebench
+    hist = str(tmp_path / "hist.jsonl")
+    rep = str(tmp_path / "report.json")
+    rc = servebench.main(["--requests", "64", "--sizes", "12,16",
+                          "--max-nrhs", "2", "--reps", "4",
+                          "--history", hist, "--report", rep,
+                          "--gate"])
+    assert rc == 0
+    doc = json.load(open(rep))
+    assert doc["schema"] == 8
+    (s,) = doc["serving"]
+    assert s["speedup_vs_loop"] >= 2.0, \
+        f"batched speedup {s['speedup_vs_loop']} < 2x"
+    assert s["measured_latency_s"]["p50"] > 0
+    assert s["measured_latency_s"]["p99"] >= s["measured_latency_s"]["p50"]
+    assert s["cache"]["hit_rate"] > 0
+    assert s["failed"] == 0
+    metrics = {e["metric"]: e for e in doc["entries"]}
+    assert metrics["serving.p50_ms"]["better"] == "lower"
+    assert metrics["serving.p99_ms"]["better"] == "lower"
+    assert metrics["serving.solves_per_s"]["value"] > 0
+    # ledger got the entry; a repeat entry gates against it through
+    # perfdiff's ledger path (self-compare: no regression)
+    with open(hist) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(lines) == 1 and lines[0]["bench"] == "servebench"
+    from tools import perfdiff
+    assert perfdiff.main([hist, rep]) == 0
+
+
+@pytest.mark.slow
+def test_servebench_gate_tolerates_serving_free_baseline(tmp_path):
+    """The first serving entry against a pre-serving ledger (bench.py
+    vintage) gates informationally — exit 0, not 'unusable'."""
+    import sys
+    sys.path.insert(0, str(tmp_path.parent))
+    from tools import perfdiff, servebench
+    hist = str(tmp_path / "hist.jsonl")
+    perfdiff.append_ledger(hist, {
+        "bench": "dplasma-tpu",
+        "ladder": [{"metric": "sgemm_n4096", "value": 100.0}]})
+    rc = servebench.main(["--requests", "6", "--sizes", "12",
+                          "--max-nrhs", "2", "--ops", "posv",
+                          "--reps", "1", "--history", hist,
+                          "--gate"])
+    assert rc == 0
+
+
+# ------------------------------------------- ops.map lift (regressions)
+
+def test_to_from_tiles_batch_axes_roundtrip():
+    """The batched lift: the tile reshape helpers accept leading batch
+    axes (the original helpers hard-coded 2-D data — found lifting
+    them under serving/batched)."""
+    A = TileMatrix.zeros(10, 6, 4, 3)
+    d = A.desc
+    data = jnp.arange(5 * d.Mp * d.Np, dtype=jnp.float32).reshape(
+        5, d.Mp, d.Np)
+    t = map_ops.to_tiles(data, d)
+    assert t.shape == (5, d.MT, d.NT, 4, 3)
+    # tile (i, j) of element k is the right slice
+    assert np.array_equal(np.asarray(t[2, 1, 1]),
+                          np.asarray(data[2, 4:8, 3:6]))
+    back = map_ops.from_tiles(t, d)
+    assert np.array_equal(np.asarray(back), np.asarray(data))
+    # 2-D still works (the original contract)
+    t2 = map_ops.to_tiles(data[0], d)
+    assert t2.shape == (d.MT, d.NT, 4, 3)
+    assert np.array_equal(np.asarray(map_ops.from_tiles(t2, d)),
+                          np.asarray(data[0]))
+
+
+def test_map_tiles_dtype_stable_under_x64():
+    """Folding the (int) tile coordinates into f32 tile values must
+    not widen the storage dtype — the coordinates are pinned int32
+    and the result is cast back to A's dtype (found lifting map.py:
+    under jax_enable_x64 the arange coordinates came out int64 and an
+    operator mixing them through jnp.float64 scratch promoted the
+    whole matrix)."""
+    A = TileMatrix.zeros(8, 8, 4, 4, dtype=jnp.float32)
+
+    def op(i, j, t):
+        # deliberately promote through f64 scratch under x64
+        return t + (i.astype(jnp.float64) + j) * 2.0
+
+    out = map_ops.map_tiles(A, op)
+    assert out.dtype == jnp.float32
+    assert float(np.asarray(out.tile(1, 1))[0, 0]) == 4.0
+    assert float(np.asarray(out.tile(0, 1))[0, 0]) == 2.0
+
+
+def test_map2_tiles_rejects_mismatched_tile_shapes():
+    """Equal tile counts with different tile shapes pair meaningless
+    regions — now an assertion, not a silent wrong answer."""
+    A = TileMatrix.zeros(8, 8, 4, 4)
+    B = TileMatrix.zeros(4, 4, 2, 2)     # also 2x2 tiles of 2x2
+    assert (A.desc.MT, A.desc.NT) == (B.desc.MT, B.desc.NT)
+    with pytest.raises(AssertionError):
+        map_ops.map2_tiles(A, B, lambda i, j, a, b: b)
+
+
+def test_map2_tiles_result_keeps_B_dtype():
+    """map2 writes B's tiles (the dplasma_map2 contract): an operator
+    promoting through A's wider dtype must not widen B's storage."""
+    A = TileMatrix.zeros(8, 8, 4, 4, dtype=jnp.float64)
+    B = TileMatrix.zeros(8, 8, 4, 4, dtype=jnp.float32)
+    out = map_ops.map2_tiles(A, B, lambda i, j, a, b: a + b + 1.0)
+    assert out.dtype == jnp.float32
+    assert np.allclose(np.asarray(out.data), 1.0)

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Six passes, in increasing cost order:
+Seven passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
@@ -25,7 +25,10 @@ Six passes, in increasing cost order:
    over 1x1/2x2/1x4 grids must verify clean with the collective
    counts EXACTLY reconciling the analytic comm model, and the
    canonical ring schedule must drain deadlock-free in the abstract
-   simulator.
+   simulator;
+7. a ``dplasma_tpu.serving`` smoke pass — tiny batched posv/gesv
+   round-trips within the backward-error gate, cache-key determinism,
+   and padded-vs-exact solution equivalence on CPU.
 
 Usage: ``python tools/lint_all.py`` — prints ``file:line: message``
 per violation / one line per failed smoke case, exits nonzero on any.
@@ -80,7 +83,7 @@ def run_perfdiff_smoke() -> int:
 
     import perfdiff
 
-    base = {"schema": 7, "name": "perfdiff-smoke",
+    base = {"schema": 8, "name": "perfdiff-smoke",
             "ops": [{"label": "testing_dpotrf", "prec": "d",
                      "gflops": 100.0,
                      "timings": {"nruns": 3, "median_s": 0.010,
@@ -249,6 +252,80 @@ def run_spmdcheck_smoke() -> int:
     return bad
 
 
+def run_serving_smoke() -> int:
+    """The serving layer's correctness floor, CPU-fast: a tiny batched
+    posv/gesv round-trip (backward error within the check_solve gate),
+    cache-key determinism (the scheduler groups by the key — a drifty
+    key silently unbatches everything), and padded-vs-exact
+    equivalence (bucket padding must not perturb the solution)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dplasma_tpu.serving import batched
+    from dplasma_tpu.serving import cache as scache
+
+    # ride the same persistent compile cache the test suite uses (a
+    # no-op under pytest where conftest already configured it)
+    if not jax.config.jax_compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(_ROOT / ".jax_cache"))
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def _solve(op, a, b, nb):
+        x, _ = batched.solve_batched(op, a, b, nb)
+        return x, batched.backward_errors(a, b, x)
+
+    bad = 0
+    rng = np.random.default_rng(3872)
+    n, nb, nrhs = 6, 4, 2
+    g = rng.standard_normal((2, n, n)).astype(np.float32)
+    spd = g @ g.transpose(0, 2, 1) + n * np.eye(n, dtype=np.float32)
+    ge = g + n * np.eye(n, dtype=np.float32)
+    b = rng.standard_normal((2, n, nrhs)).astype(np.float32)
+    gate = 60.0 * np.finfo(np.float32).eps * n
+    for op, a in (("posv", spd), ("gesv", ge)):
+        x, bwd = _solve(op, jnp.asarray(a), jnp.asarray(b), nb)
+        bwd = np.asarray(bwd)
+        if not np.all(np.isfinite(np.asarray(x))) or np.any(bwd > gate):
+            sys.stderr.write(f"serving-smoke: batched {op} round-trip "
+                             f"failed the backward-error gate "
+                             f"({bwd})\n")
+            bad += 1
+        # padded-vs-exact: identity/zero bucket padding must not
+        # perturb the solution
+        nB = scache.bucket_dim(n)
+        rB = scache.bucket_dim(nrhs, floor=scache.MIN_NRHS_BUCKET)
+        ap = np.asarray(scache.pad_problem(jnp.asarray(a), nB))
+        bp = np.asarray(scache.pad_rhs(jnp.asarray(b), nB, rB))
+        xp, _ = _solve(op, jnp.asarray(ap), jnp.asarray(bp), nb)
+        diff = np.max(np.abs(np.asarray(xp)[:, :n, :nrhs]
+                             - np.asarray(x)))
+        scale = max(float(np.max(np.abs(np.asarray(x)))), 1.0)
+        if diff > 100.0 * np.finfo(np.float32).eps * n * scale:
+            sys.stderr.write(f"serving-smoke: padded {op} deviates "
+                             f"from the exact-shape solve by "
+                             f"{diff}\n")
+            bad += 1
+    k1 = scache.make_key("posv", n, np.float32, 2, nrhs)
+    k2 = scache.make_key("posv", n, np.float32, 2, nrhs)
+    if k1 != k2 or hash(k1) != hash(k2):
+        sys.stderr.write("serving-smoke: cache key not "
+                         "deterministic\n")
+        bad += 1
+    if (k1.n != scache.bucket_dim(n)
+            or k1.batch != scache.bucket_batch(2)
+            or scache.make_key("posv", n + 1, np.float32, 2,
+                               nrhs) != k1._replace(
+                                   n=scache.bucket_dim(n + 1))):
+        sys.stderr.write("serving-smoke: cache key bucketing "
+                         "drifted from the bucket functions\n")
+        bad += 1
+    return bad
+
+
 def main(argv=None) -> int:
     pkg = _ROOT / "dplasma_tpu"
     bad = 0
@@ -257,7 +334,8 @@ def main(argv=None) -> int:
                      ("perfdiff-smoke", run_perfdiff_smoke),
                      ("palcheck", run_palcheck),
                      ("dagcheck-smoke", run_dagcheck_smoke),
-                     ("spmdcheck-smoke", run_spmdcheck_smoke)):
+                     ("spmdcheck-smoke", run_spmdcheck_smoke),
+                     ("serving-smoke", run_serving_smoke)):
         n = fn()
         print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")
         bad += n

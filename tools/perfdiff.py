@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v7), the bench
+(``--report`` from any driver, any schema vintage v1-v8), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -23,7 +23,10 @@ Comparable metrics extracted from each document:
   IR solvers' iteration counts) from ``entries``/``ladder``.
 
 Exit codes: 0 = no regression, 1 = regression past threshold,
-2 = unusable input / nothing comparable.
+2 = unusable input (unreadable doc, or a candidate with no
+extractable metrics at all). Candidate metrics ABSENT from the
+baseline are informational — noted, never gated (the first entry of a
+new metric family, e.g. serving.*, seeds the next comparison).
 
 Standalone by design: stdlib-only (no jax import), so the gate runs
 anywhere — including the repo lint aggregate (``tools/lint_all.py``)
@@ -49,6 +52,32 @@ def latest_ledger_entry(path: str) -> Optional[dict]:
             if line.strip():
                 last = line
     return json.loads(last) if last else None
+
+
+def latest_comparable_entry(path: str, doc: dict) -> Optional[dict]:
+    """Newest ledger entry sharing at least one comparable metric with
+    ``doc``. Several bench families (bench.py's ladder, servebench's
+    serving.* metrics) may share one ledger; a gate that baselines
+    against the raw newest entry would compare across families and
+    pass informationally forever. With no shared-metric entry (or a
+    candidate with no metrics at all) this falls back to the newest
+    raw entry, preserving the callers' vacuous-gate handling."""
+    want = set(extract_metrics(doc))
+    best = last = None
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(entry, dict):
+                continue
+            last = entry
+            if want & set(extract_metrics(entry)):
+                best = entry
+    return best if best is not None else last
 
 
 def append_ledger(path: str, doc: dict) -> None:
@@ -151,9 +180,14 @@ def compare(old_doc: dict, new_doc: dict,
     # regressed into failure records no timing at all — surface the
     # disappearance instead of silently shrinking the comparison
     missing = sorted(set(old_m) - set(new_m))
+    # candidate metrics with no baseline counterpart: the FIRST entry
+    # of a new metric family (e.g. the serving layer's first v8
+    # ledger entry against a pre-serving baseline) is informational —
+    # it seeds the baseline for the next run, it cannot regress
+    new_only = sorted(set(new_m) - set(old_m))
     return {"ok": not regs, "compared": len(rows), "rows": rows,
             "regressions": regs, "worst": regs[0] if regs else None,
-            "missing": missing}
+            "missing": missing, "new": new_only}
 
 
 def format_result(res: dict, verbose: bool = False) -> list:
@@ -180,8 +214,20 @@ def format_result(res: dict, verbose: bool = False) -> list:
             shown += ", ..."
         lines.append("perfdiff: note: %d baseline metric(s) absent "
                      "from candidate: %s" % (len(missing), shown))
+    new_only = res.get("new") or []
+    if new_only:
+        shown = ", ".join(new_only[:5])
+        if len(new_only) > 5:
+            shown += ", ..."
+        lines.append("perfdiff: note: %d candidate metric(s) not in "
+                     "baseline (informational, seeds the next "
+                     "comparison): %s" % (len(new_only), shown))
     if res["compared"] == 0:
-        lines.append("perfdiff: no common metrics to compare")
+        if new_only:
+            lines.append("perfdiff: OK (no common metrics; %d new "
+                         "metric(s) recorded)" % len(new_only))
+        else:
+            lines.append("perfdiff: no common metrics to compare")
     elif res["ok"]:
         lines.append("perfdiff: OK (%d metric(s) within threshold)"
                      % res["compared"])
@@ -231,7 +277,10 @@ def main(argv=None) -> int:
     for line in format_result(res, verbose=ns.verbose):
         print(line)
     if res["compared"] == 0:
-        return 2
+        # nothing in common: candidate-only metrics are informational
+        # (a new metric family's first entry must not break the gate);
+        # a candidate with NO extractable metrics at all is unusable
+        return 0 if res.get("new") else 2
     return 0 if res["ok"] else 1
 
 

@@ -1,0 +1,286 @@
+#!/usr/bin/env python3
+"""servebench: throughput/latency benchmark of the serving layer.
+
+Drives a synthetic OPEN-LOOP workload — mixed problem sizes, ragged
+right-hand-side counts, a posv/gesv op mix — through
+:class:`dplasma_tpu.serving.SolverService` and through a one-at-a-time
+loop of the same solves (exact-shape per-problem executables, warmed),
+then records:
+
+* sustained **solves/sec** for both paths and the batched/loop
+  speedup (the serving layer's reason to exist — dispatch and compile
+  amortization across a request batch);
+* per-request **latency p50/p99** (submit -> result, the user-visible
+  measure batching trades against);
+* executable **cache hit-rate** and compile seconds.
+
+Everything lands in a run-report schema v8 ``"serving"`` section
+(``--report``), in the ``bench_history.jsonl`` ledger (``--history`` /
+``DPLASMA_BENCH_HISTORY``), and — with ``--gate`` — is compared
+against the newest prior ledger entry by ``tools/perfdiff.py``
+(latency entries declare ``"better": "lower"``; a baseline predating
+the serving metrics gates informationally).
+
+``--inject=KIND@STAGE[:RATE[:COUNT]]`` (or ``DPLASMA_INJECT``) arms
+the PR 2 fault injector for the measured service pass: a corrupted
+request walks the per-request remediation ladder and the outcome
+counts land in the report.
+
+Usage::
+
+    python tools/servebench.py                  # defaults, prints doc
+    python tools/servebench.py --gate           # self-gate vs ledger
+    python tools/servebench.py --inject=nan@serving:1:1 -v
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+if "jax" not in sys.modules:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def make_workload(nreq: int, seed: int, ops, sizes, max_nrhs: int):
+    """Deterministic synthetic request stream: (op, A, b) triples with
+    mixed sizes and ragged nrhs (SPD operands for posv, diagonally
+    dominated for gesv)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(nreq):
+        op = ops[i % len(ops)]
+        n = int(sizes[i % len(sizes)])
+        nrhs = int(rng.integers(1, max_nrhs + 1))
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        if op.startswith("posv"):
+            a = a @ a.T + n * np.eye(n, dtype=np.float32)
+        else:
+            a = a + n * np.eye(n, dtype=np.float32)
+        b = rng.standard_normal((n, nrhs)).astype(np.float32)
+        reqs.append((op, a, b))
+    return reqs
+
+
+def run_service(svc, reqs):
+    """One open-loop pass: submit everything, flush, gather. Returns
+    (wall_s, per-request latencies, futures)."""
+    t0 = time.perf_counter()
+    futs = [svc.submit(op, a, b) for op, a, b in reqs]
+    svc.flush()
+    for f in futs:
+        f.result(120.0)
+    wall = time.perf_counter() - t0
+    lats = [f.meta["latency_s"] for f in futs]
+    return wall, lats, futs
+
+
+def run_loop(reqs, nb: int, fns):
+    """The one-at-a-time baseline: per-problem exact-shape compiled
+    solves (``fns`` caches one jitted callable per (op, n, nrhs) — the
+    loop pays a dispatch per request, never a recompile once warm)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dplasma_tpu.serving import batched
+
+    t0 = time.perf_counter()
+    outs = []
+    for op, a, b in reqs:
+        key = (op, a.shape[0], b.shape[1])
+        fn = fns.get(key)
+        if fn is None:
+            def fn(aa, bb, _op=op):
+                x, _ = batched.solve_batched(_op, aa, bb, nb)
+                return x
+            fn = jax.jit(fn)
+            fns[key] = fn
+        outs.append(fn(jnp.asarray(a[None]), jnp.asarray(b[None])))
+    for o in outs:
+        o.block_until_ready()
+    return time.perf_counter() - t0, outs
+
+
+def _pct(sorted_vals, p):
+    from dplasma_tpu.serving.service import percentile
+    return percentile(sorted_vals, p)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="servebench", description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=64,
+                    help="workload size (default 64)")
+    ap.add_argument("--seed", type=int, default=3872)
+    ap.add_argument("--nb", type=int, default=8, help="tile size")
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--sizes", default="12,16,20,24",
+                    help="comma list of problem sizes (pre-bucket; "
+                         "CPU-fast defaults — crank up on real "
+                         "hardware)")
+    ap.add_argument("--max-nrhs", type=int, default=4)
+    ap.add_argument("--ops", default="posv,gesv",
+                    help="comma list from posv,gesv,posv_ir,gesv_ir")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="measured passes (best throughput wins)")
+    ap.add_argument("--report", default=None,
+                    help="write the v8 run-report here")
+    ap.add_argument("--history", default=None,
+                    help="bench_history.jsonl ledger (default env "
+                         "DPLASMA_BENCH_HISTORY or bench_history.jsonl)")
+    ap.add_argument("--gate", action="store_true",
+                    help="compare against the newest prior ledger "
+                         "entry with tools/perfdiff.py")
+    ap.add_argument("--gate-threshold", type=float, default=0.10)
+    ap.add_argument("--inject", default=None,
+                    help="fault spec KIND@STAGE[:RATE[:COUNT]] for the "
+                         "measured service pass (default env "
+                         "DPLASMA_INJECT)")
+    ap.add_argument("-v", "--verbose", action="count", default=0)
+    ns = ap.parse_args(argv)
+
+    from dplasma_tpu.observability.report import RunReport
+    from dplasma_tpu.resilience import inject
+    from dplasma_tpu.serving import SolverService
+    from dplasma_tpu.serving.cache import ExecutableCache
+
+    ops = [o.strip() for o in ns.ops.split(",") if o.strip()]
+    sizes = [int(s) for s in ns.sizes.split(",") if s.strip()]
+    reqs = make_workload(ns.requests, ns.seed, ops, sizes, ns.max_nrhs)
+    if any(o.endswith("_ir") for o in ops):
+        import jax
+        if not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
+        reqs = [(op, a.astype("float64"), b.astype("float64"))
+                if op.endswith("_ir") else (op, a, b)
+                for op, a, b in reqs]
+
+    report = RunReport("servebench")
+    svc = SolverService(nb=ns.nb, max_batch=ns.max_batch,
+                        max_wait_ms=0.0,
+                        cache=ExecutableCache(metrics=None))
+    svc.metrics = report.metrics
+    svc.cache.metrics = report.metrics
+
+    # warmup: populate the executable cache (service) and the
+    # per-shape jit cache (loop) — steady-state is what we measure.
+    # The warmup's latencies are compile time, not service latency:
+    # reset the service's stats so summary() covers measured traffic
+    run_service(svc, reqs)
+    fns: dict = {}
+    run_loop(reqs, ns.nb, fns)
+    svc.reset_stats()
+
+    spec = ns.inject or os.environ.get("DPLASMA_INJECT")
+    plan = inject.parse_plan(spec, ns.seed) if spec else None
+    best_svc = best_loop = float("inf")
+    lats = []          # POOLED over every measured rep — the gated
+    faults = []        # p50/p99 must not ride one noisy final pass
+    for _ in range(max(ns.reps, 1)):
+        if plan is not None:
+            inject.arm(plan)
+        wall, lat, _futs = run_service(svc, reqs)
+        if plan is not None:
+            faults += inject.disarm()
+        best_svc = min(best_svc, wall)
+        lats.extend(lat)
+        lwall, _ = run_loop(reqs, ns.nb, fns)
+        best_loop = min(best_loop, lwall)
+
+    nreq = len(reqs)
+    sps = nreq / best_svc
+    loop_sps = nreq / best_loop
+    speedup = sps / loop_sps if loop_sps else None
+    slat = sorted(lats)
+    p50 = _pct(slat, 50)
+    p99 = _pct(slat, 99)
+    summary = svc.summary()
+    summary.update({
+        "workload": {"requests": nreq, "ops": ops, "sizes": sizes,
+                     "max_nrhs": ns.max_nrhs, "seed": ns.seed,
+                     "nb": ns.nb, "max_batch": ns.max_batch,
+                     "reps": ns.reps},
+        "solves_per_s": sps, "loop_solves_per_s": loop_sps,
+        "speedup_vs_loop": speedup,
+        "measured_latency_s": {"p50": p50, "p99": p99},
+        "injected_faults": len(faults)})
+    report.add_serving(summary)
+    hit_rate = summary["cache"]["hit_rate"]
+    entries = [
+        {"metric": "serving.solves_per_s", "value": sps},
+        {"metric": "serving.speedup_vs_loop", "value": speedup},
+        {"metric": "serving.p50_ms", "value": 1e3 * p50,
+         "better": "lower"},
+        {"metric": "serving.p99_ms", "value": 1e3 * p99,
+         "better": "lower"},
+    ]
+    if hit_rate is not None:
+        entries.append({"metric": "serving.cache_hit_rate",
+                        "value": hit_rate})
+    report.entries.extend(entries)
+
+    doc = report.snapshot()
+    doc["bench"] = "servebench"
+    print(json.dumps({"bench": "servebench",
+                      "solves_per_s": round(sps, 2),
+                      "loop_solves_per_s": round(loop_sps, 2),
+                      "speedup_vs_loop": round(speedup, 3),
+                      "p50_ms": round(1e3 * p50, 3),
+                      "p99_ms": round(1e3 * p99, 3),
+                      "cache_hit_rate": hit_rate,
+                      "remediated": summary["remediated"],
+                      "failed": summary["failed"]}), flush=True)
+    if ns.verbose:
+        print(json.dumps(summary, indent=1, default=str))
+
+    if ns.report:
+        report.write(ns.report)
+        if ns.verbose:
+            print(f"# report written to {ns.report}")
+
+    import perfdiff
+    history = ns.history or os.environ.get("DPLASMA_BENCH_HISTORY",
+                                           "bench_history.jsonl")
+    prev = None
+    if os.path.exists(history):
+        try:
+            # newest SERVING-family entry (the ledger may interleave
+            # bench.py ladder docs with no common metrics)
+            prev = perfdiff.latest_comparable_entry(history, doc)
+        except (OSError, ValueError) as exc:
+            print(f"#! cannot read bench history: {exc}",
+                  file=sys.stderr)
+    try:
+        perfdiff.append_ledger(history, doc)
+    except OSError as exc:
+        print(f"#! cannot append bench history: {exc}",
+              file=sys.stderr)
+
+    rc = 0
+    if ns.gate:
+        if prev is None:
+            print("# servebench --gate: no prior ledger entry "
+                  "(informational first run)")
+        else:
+            res = perfdiff.compare(prev, doc,
+                                   threshold=ns.gate_threshold)
+            for line in perfdiff.format_result(res,
+                                               verbose=ns.verbose > 0):
+                print(line)
+            rc = 0 if res["ok"] else 1
+    if summary["failed"]:
+        print(f"#! {summary['failed']} request(s) failed past the "
+              "remediation ladder", file=sys.stderr)
+        rc = rc or 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
