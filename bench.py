@@ -337,10 +337,18 @@ def main(argv=None) -> int:
     peaks = report.extra.setdefault("peaks", {})
     report.extra["budget_s"] = budget_s
     # active pipeline shape of the factorization sweeps (schema v4):
-    # the ladder's getrf/geqrf/potrf entries run with THIS config
+    # the ladder's getrf/geqrf/potrf entries run with THIS config.
+    # The per-route panel-engine resolution rides along so
+    # bench_history.jsonl entries stay comparable across panel
+    # strategies (perfdiff same-family baselining; a chain-vs-tree
+    # pair is visible in the ledger, not silent).
+    from dplasma_tpu.kernels import panels as _panels
     from dplasma_tpu.ops._sweep import sweep_params
     la, agg = sweep_params()
-    pipeline = {"sweep.lookahead": la, "qr.agg_depth": agg}
+    pipeline = {"sweep.lookahead": la, "qr.agg_depth": agg,
+                "panel.kernel": _panels.panel_kernel_config(),
+                "panel.qr": _panels.panel_kernel("qr"),
+                "panel.lu": _panels.panel_kernel("lu")}
     report.pipeline = pipeline
 
     def remaining():

@@ -476,6 +476,16 @@ def _cap_pallas_lu(out: List[PallasContract]) -> None:
         pallas_lu._panel_call.__wrapped__(a, True)
 
 
+def _cap_pallas_qr(out: List[PallasContract]) -> None:
+    """kernels/pallas_qr.py: the fused blocked Householder QR panel
+    (whole-panel VMEM residency, no grid)."""
+    import jax.numpy as jnp
+    from dplasma_tpu.kernels import pallas_qr
+    a = jnp.zeros((32, 16), jnp.float32)
+    with capture("dplasma_tpu/kernels/pallas_qr.py:geqrt_panel", out):
+        pallas_qr._geqrt_call.__wrapped__(a, True)
+
+
 def _cap_pallas_dd(out: List[PallasContract]) -> None:
     """kernels/pallas_dd.py: the dd level-recombine epilogue."""
     import jax.numpy as jnp
@@ -494,6 +504,7 @@ def _cap_pallas_dd(out: List[PallasContract]) -> None:
 SITES = {
     "dplasma_tpu/kernels/pallas_kernels.py": _cap_pallas_kernels,
     "dplasma_tpu/kernels/pallas_lu.py": _cap_pallas_lu,
+    "dplasma_tpu/kernels/pallas_qr.py": _cap_pallas_qr,
     "dplasma_tpu/kernels/pallas_dd.py": _cap_pallas_dd,
 }
 

@@ -404,7 +404,11 @@ class Driver:
         wants_la = getattr(ip, "lookahead", -1) >= 0
         la, agg = sweep_params(
             lookahead=ip.lookahead if wants_la else None)
-        self.pipeline = {"sweep.lookahead": la, "qr.agg_depth": agg}
+        from dplasma_tpu.kernels import panels as _panels
+        self.pipeline = {"sweep.lookahead": la, "qr.agg_depth": agg,
+                         "panel.kernel": _panels.panel_kernel_config(),
+                         "panel.qr": _panels.panel_kernel("qr"),
+                         "panel.lu": _panels.panel_kernel("lu")}
         self._mca_prev_la = _UNSET
         self._la_override_active = False
         # resilience bookkeeping: which fn produced the last progress()
@@ -739,9 +743,11 @@ class Driver:
                         not getattr(self, "_pipe_printed", False):
                     self._pipe_printed = True
                     print("#+ pipeline: sweep.lookahead=%d "
-                          "qr.agg_depth=%d"
+                          "qr.agg_depth=%d panel.qr=%s panel.lu=%s"
                           % (self.pipeline["sweep.lookahead"],
-                             self.pipeline["qr.agg_depth"]))
+                             self.pipeline["qr.agg_depth"],
+                             self.pipeline["panel.qr"],
+                             self.pipeline["panel.lu"]))
                 # analytic DAG construction is cubic-ish in tile count;
                 # the implicit consumers (--report, -v>=3) cap it, the
                 # explicit --dot opt-in always honors the request. K

@@ -975,11 +975,17 @@ def geqrt_f64(panel):
 
     q, r1 = cholqr_pass(panel, True)
     q, r2 = cholqr_pass(q, False)
-    r = gemm_f64(r2, r1)
-    # TSQR-HR reconstruction: the sign/shift convention and packed
-    # layout are SHARED with the f32 path (kernels.householder) so the
-    # two implementations cannot drift; only the product/LU/inverse
-    # kernels differ (limb-exact here).
+    return _tsqrhr_f64(q, gemm_f64(r2, r1))
+
+
+def _tsqrhr_f64(q, r):
+    """TSQR-HR tail shared by the cholqr and tree dd panels: recover
+    compact-WY ``(packed, V, T)`` from a dd-accurate thin (q, r).  The
+    sign/shift convention and packed layout are SHARED with the f32
+    path (kernels.householder) so the two implementations cannot
+    drift; only the product/LU/inverse kernels differ (limb-exact
+    here)."""
+    m, nb = q.shape
     from dplasma_tpu.kernels import blas as _kb
     from dplasma_tpu.kernels import householder as _hh
     s, b = _hh.reconstruct_sign_shift(q)
@@ -1000,6 +1006,42 @@ def geqrt_f64(panel):
                  trans="T", unit=True)
     packed = _hh.reconstruct_pack(s, r, v, nb)
     return packed, v, t
+
+
+def geqrt_f64_tree(panel, solve_iters: int = 3):
+    """Tree-seeded dd panel QR: the TSQR/CAQR variant of
+    :func:`geqrt_f64` (MCA ``panel.kernel tree`` on the dd route).
+
+    The first limb CholeskyQR pass — two full-height exact products
+    over an ill-conditioned panel — is replaced by an R-only f32 TSQR
+    tree (:func:`dplasma_tpu.kernels.panels.tsqr` with
+    ``need_q=False``: cheap batched f32 leaf QRs + the log-depth
+    R reduction, no push-down) whose root R conditions ONE
+    exact-residual IR right-solve ``q1 R32 = panel`` (~1.4
+    full-height limb products at ``solve_iters=3`` vs the pass's 2).
+    The second (unshifted) limb CholeskyQR pass then restores
+    orthogonality at dd accuracy, and the shared TSQR-HR tail
+    recovers ``(packed, V, T)``.  Same envelope as
+    :func:`geqrt_f64`: numerically full-rank panels, cond below
+    ~1e5.
+    """
+    from dplasma_tpu.kernels import panels as _panels
+    # power-of-two COLUMN prescale keeps the f32 tree seed in range
+    # for f64 magnitudes outside f32's span (column scaling leaves Q
+    # invariant: only R unscales, exactly)
+    m_ = jnp.max(jnp.abs(panel), axis=0, keepdims=True)
+    d = 4.0 / _pow2_scale_bits(jnp.where(m_ > 0, m_, 1.0))
+    As = panel * d
+    _, r32 = _panels.tsqr(As.astype(jnp.float32), need_q=False)
+    r1 = jnp.triu(r32).astype(jnp.float64)
+    # pass 1: q1 = As r1^{-1} by exact-residual IR (f32-inverse seed)
+    q1 = trsm_f64(r1, As, side="R", lower=False, iters=solve_iters)
+    # pass 2: unshifted limb CholeskyQR on the near-orthonormal q1
+    G = gemm_f64(q1.T, q1)
+    Lg, Xg = _potrf_tile_ir(G)
+    q = gemm_f64(q1, Xg.T)
+    r = gemm_f64(Lg.T, r1) / d          # exact pow2 column unscale
+    return _tsqrhr_f64(q, r)
 
 
 def potrf_f64(A, lower: bool = True, refine: int = 3):

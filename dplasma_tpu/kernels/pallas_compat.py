@@ -1,0 +1,53 @@
+"""Version shims for the Pallas runtime API surface.
+
+The repo's kernels were written against one spelling of the Pallas
+API; jax releases have moved two pieces the kernels depend on:
+
+* ``pltpu.CompilerParams`` is spelled ``TPUCompilerParams`` before
+  jax 0.5 — :func:`compiler_params` resolves whichever exists;
+* the ``jax.enable_x64`` scope lives at ``jax.experimental.enable_x64``
+  in older releases — :func:`x64_scope` resolves it (falling back to a
+  no-op scope where neither exists: callers cast operands explicitly,
+  the scope only silences weak-type promotion noise).
+
+Centralizing the probes here is what lets the per-feature test gates
+in ``tests/conftest.py`` run the interpret-mode kernels on hosts whose
+pallas carries the old spellings (previously an all-or-nothing skip).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+try:
+    from jax.experimental import pallas as pl  # noqa: F401
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    pl = None
+    HAVE_PALLAS = False
+
+
+def interpret_default() -> bool:
+    """Kernels interpret everywhere but on a real TPU backend."""
+    return jax.default_backend() != "tpu"
+
+
+def compiler_params(**kw):
+    """A ``pltpu.CompilerParams`` under whichever name this jax
+    carries (None when the tpu namespace is absent entirely — callers
+    then omit the argument)."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:  # pragma: no cover
+        return None
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    return cls(**kw) if cls is not None else None
+
+
+def x64_scope(enable: bool):
+    """The ``jax.enable_x64`` context under whichever name exists."""
+    ctx = getattr(jax, "enable_x64", None) \
+        or getattr(jax.experimental, "enable_x64", None)
+    return ctx(enable) if ctx is not None else contextlib.nullcontext()

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dplasma_tpu.kernels import pallas_compat
+
 _ENABLED = False
 # Threshold below which pallas dispatch is not worth it (one MXU pass).
 _MIN_DIM = 256
@@ -43,7 +45,7 @@ def enabled() -> bool:
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    return pallas_compat.interpret_default()
 
 
 def _block(dim: int, want: int, quantum: int) -> int:
@@ -142,7 +144,7 @@ def gemm(a, b, c=None, *, alpha=1.0, beta=1.0, bm=512, bn=512, bk=512,
         out_shape=jax.ShapeDtypeStruct((gm * bm, gn * bn), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         interpret=_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compat.compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(*operands)
     return out[:M, :N]

@@ -35,12 +35,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
-try:
-    from jax.experimental import pallas as pl
-    HAVE_PALLAS = True
-except Exception:  # pragma: no cover
-    pl = None
-    HAVE_PALLAS = False
+from dplasma_tpu.kernels.pallas_compat import (HAVE_PALLAS,
+                                               interpret_default, pl,
+                                               x64_scope)
 
 JB = 8  # column register-block width
 
@@ -132,14 +129,24 @@ def _panel_call(a, interpret: bool):
     return out, piv
 
 
+def eligible(a) -> bool:
+    """Trace-time gate for the fused LU panel: pallas present + f32 +
+    JB-aligned width + whole panel within the VMEM residency budget
+    (the ONE home of the gate both ops.lu dispatch branches share)."""
+    from dplasma_tpu.kernels import pallas_qr
+    if not HAVE_PALLAS or a.ndim != 2 or a.dtype != jnp.float32:
+        return False
+    return pallas_qr.eligible_shape(a.shape[0], a.shape[1])
+
+
 def lu_panel(a, interpret: bool | None = None):
     """Packed L\\U + permutation of an (M, nb) f32 panel: ``a[perm] =
     L U`` (perm derived from the kernel's swap sequence). M*nb*4 bytes
     must fit VMEM (callers chunk at 8192 rows x 256 cols)."""
     a = jnp.asarray(a, jnp.float32)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    with jax.enable_x64(False):
+        interpret = interpret_default()
+    with x64_scope(False):
         packed, ipiv = _panel_call(a, interpret)
     M = a.shape[0]
     perm = jnp.arange(M, dtype=jnp.int32)

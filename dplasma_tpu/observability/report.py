@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 8``).
+"""The versioned JSON run-report (``"schema": 9``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -41,8 +41,9 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                    "comm": {...} | null, "counts": {kind: n},
                    "diagnostics": [{"kind", "message", "tasks",
                                     "tile"}]}],            # (v3)
-     "pipeline": {"sweep.lookahead": n,
-                  "qr.agg_depth": d} | absent,             # (v4)
+     "pipeline": {"sweep.lookahead": n, "qr.agg_depth": d,
+                  "panel.kernel": raw, "panel.qr": k,
+                  "panel.lu": k} | absent,    # (v4; panel.* keys v9)
      "roofline": [{"op", "op_class", "expected_s", "measured_s",
                    "achieved_frac", "bound", "components_s",
                    "peaks", "peaks_source"}],              # (v5)
@@ -78,9 +79,12 @@ per-iteration normwise backward error, converged/escalated outcome,
 ops.refine); 8 adds ``"serving"`` (the solver-as-a-service layer's
 throughput/latency/cache record — request and batch counts, p50/p99
 latency, executable-cache economics, per-request remediation
-outcomes, dplasma_tpu.serving + tools/servebench.py). All
+outcomes, dplasma_tpu.serving + tools/servebench.py); 9 adds the
+``panel.*`` keys to ``"pipeline"`` (the panel-factorization engine's
+raw knob + per-route resolution, kernels.panels — what perfdiff's
+same-family baselining keys on). All
 additive — v1 readers of the other keys are unaffected; this reader
-accepts <= 8 (:func:`load_report` tolerates every v1-v8 vintage,
+accepts <= 9 (:func:`load_report` tolerates every v1-v9 vintage,
 filling the always-present keys).
 """
 from __future__ import annotations
@@ -93,7 +97,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 8
+REPORT_SCHEMA = 9
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -258,7 +262,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v7) loads: the schema history is purely
+    Every older vintage (v1-v8) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

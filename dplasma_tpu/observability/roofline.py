@@ -163,9 +163,25 @@ def _apply_cost(op_class: str, m: int, w: int, nb: int, d: int,
     return fl, by
 
 
-def _panel_cost(op_class: str, m: int, nb: int, itemsize: int):
+def _panel_cost(op_class: str, m: int, nb: int, itemsize: int,
+                panel_kernel: str = "chain"):
+    """Per-panel demand of the panel-factorization engine's kernels.
+
+    ``chain``/``rec``/``pallas`` carry the factorization's own flops
+    (the rec panel reorganizes the SAME math into trsm/matmul levels;
+    a lower bound either way). The ``tree`` QR panel genuinely does
+    more arithmetic — leaf QRs + the push-down products + the TSQR-HR
+    reconstruction (LU + two triangular solves + the T solve), ~3x
+    the chain's 2·m·nb² — all of it matmul-shaped, which is the
+    point: the chain's latency-bound dispatch ladder becomes
+    MXU-bound work, and the priced bound shifts with it."""
     fl = (2.0 if op_class == "geqrf" else 1.0) * m * nb * nb
-    return fl, 2.0 * m * nb * itemsize
+    by = 2.0 * m * nb * itemsize
+    if op_class == "geqrf" and panel_kernel == "tree":
+        # leaves ~2mnb² + push-down ~2mnb² + reconstruction (V2
+        # solve + T solve + packing) ~2mnb²; streams the panel ~3x
+        fl, by = 3.0 * fl, 3.0 * by
+    return fl, by
 
 
 def refine_phase_model(op_class: str, M: int, N: int, nrhs: int,
@@ -222,7 +238,8 @@ def refine_phase_model(op_class: str, M: int, N: int, nrhs: int,
 def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
                 itemsize: int, lookahead: int = 1,
                 agg_depth: int = 1, nrhs: int = 1,
-                peaks: Optional[dict] = None
+                peaks: Optional[dict] = None,
+                panel_kernel: Optional[str] = None
                 ) -> Optional[Dict[str, list]]:
     """Per-phase ``{name: [flops, hbm_bytes, dispatches]}`` demands.
 
@@ -246,6 +263,13 @@ def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
                                   itemsize, prec_w, peaks)
     if op_class not in ("getrf", "geqrf", "potrf") or nb <= 0:
         return None
+    if panel_kernel is None and op_class in ("getrf", "geqrf"):
+        # resolve from the live MCA config — the same source the
+        # sweep's panel callback reads
+        from dplasma_tpu.kernels import panels as _panels
+        panel_kernel = _panels.panel_kernel(
+            "qr" if op_class == "geqrf" else "lu")
+    pker = panel_kernel or "chain"
     la = max(int(lookahead), 0)
     agg = max(int(agg_depth), 1) if op_class == "geqrf" else 1
     MT, NT = -(-M // nb), -(-N // nb)
@@ -302,7 +326,16 @@ def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
     for kk in range(KT):
         ahead.pop(0)
         m = Mp - kk * nb
-        add("panel", *_panel_cost(op_class, m, nb, itemsize))
+        pk_k = pker
+        if pk_k == "pallas" and op_class == "geqrf":
+            # the fused pallas QR panel is f32-only and VMEM-gated
+            # PER SHAPE: panels the gate rejects (non-f32 routes,
+            # tall early panels) execute the tree fallback — price
+            # what each panel actually runs
+            from dplasma_tpu.kernels.pallas_qr import eligible_shape
+            if not eligible_shape(m, nb, itemsize):
+                pk_k = "tree"
+        add("panel", *_panel_cost(op_class, m, nb, itemsize, pk_k))
         pending.append(kk)
         if ahead:
             fl = by = 0.0

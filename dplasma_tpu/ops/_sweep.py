@@ -176,7 +176,8 @@ def assemble_sweep(packs, urows, KT: int, NT: int, nb: int,
 # ---------------------------------------------------------------------
 
 def dag_pipelined(A, kind: str, recorder=None, lookahead=None,
-                  agg_depth=None, uplo: str = "L"):
+                  agg_depth=None, uplo: str = "L",
+                  panel_kernel=None):
     """Record the pipelined sweep's realized task structure — task
     classes ``panel(k)`` (factor column k), ``upd_col(k, j)`` (narrow
     lookahead update of column j by panel k), ``upd_far(k0[, d])``
@@ -190,20 +191,43 @@ def dag_pipelined(A, kind: str, recorder=None, lookahead=None,
     with its lookahead window of fresh panels kept off the aggregated
     wide update). Mirrors :func:`pipelined_sweep`'s control flow
     exactly; the pipeline shape is stamped on ``recorder.meta`` for
-    the run-report / DAG analytics."""
+    the run-report / DAG analytics.
+
+    ``panel_kernel`` pins the panel engine's kernel (None = the live
+    MCA ``panel.kernel`` resolution, the same source the sweep
+    reads). With the ``tree`` QR panel the ``panel(k)`` task expands
+    into its realized TSQR reduction: per-tile ``panel_leaf(k, i)``
+    QR tasks, an O(log) ladder of ``panel_comb(k, lvl, j)`` sibling
+    R-couple reductions, and the ``panel(k)`` root (push-down +
+    TSQR-HR reconstruction, writing the whole packed column) — the
+    O(mt) geqrt->tsqrt dependency spine of the flat JDF becomes an
+    O(log mt)-deep tree, and dagcheck proves the reduction race-free
+    and flow-covered like any other task graph. The ``rec`` LU panel
+    stays ONE fused task (that is its point: one slab op)."""
     from dplasma_tpu import native
     from dplasma_tpu.utils import profiling
     rec = recorder if recorder is not None else profiling.recorder
     la, agg = sweep_params(lookahead, agg_depth)
     if kind != "geqrf":
         agg = 1
+    pk = panel_kernel
+    if pk is None and kind in ("geqrf", "getrf"):
+        from dplasma_tpu.kernels import panels as _panels
+        pk = _panels.panel_kernel("qr" if kind == "geqrf" else "lu")
+    if pk == "pallas" and kind == "geqrf" \
+            and jnp.dtype(A.dtype).itemsize != 4:
+        # the fused pallas QR panel is f32-only: non-f32 routes (dd
+        # f64, complex) execute the tree fallback — record what runs
+        pk = "tree"
+    tree_panel = (kind == "geqrf" and pk == "tree")
     MT, NT = A.desc.MT, A.desc.NT
     KT = min(MT, NT)
     lower = uplo.upper() == "L"
     ranks = native.rank_grid(A.desc.dist, MT, NT)
     if getattr(rec, "meta", None) is not None:
         rec.meta["pipeline"] = {"kind": kind, "lookahead": la,
-                                "agg_depth": agg}
+                                "agg_depth": agg,
+                                "panel.kernel": pk or "chain"}
 
     def tile_t(i, j):
         return (i, j) if lower else (j, i)
@@ -218,6 +242,53 @@ def dag_pipelined(A, kind: str, recorder=None, lookahead=None,
         return rec.task("panel", k, priority=3 * (KT - k),
                         rank=rank_at(k, k),
                         reads=col_tiles(k, k), writes=col_tiles(k, k))
+
+    def panel_tree_t(k, prev):
+        """The tree panel's realized reduction for column k: leaves
+        factor per-tile, sibling R triangles combine pairwise (the
+        combine writes the pair's LEADING tile — where its R lives),
+        the root pushes Q down and reconstructs compact-WY over the
+        whole column. ``prev`` is the column's previous writer (its
+        last narrow/wide update), edged DIRECTLY into every leaf."""
+        rows = list(range(k, MT))
+        if len(rows) < 2:          # single tile: the flat panel task
+            pt = panel_t(k)
+            if prev is not None:
+                rec.edge(prev, pt, "Akk")
+            return pt
+        pri = 3 * (KT - k)
+        tasks = []
+        level = []
+        for i in rows:
+            lt = rec.task("panel_leaf", k, i, priority=pri,
+                          rank=rank_at(i, k),
+                          reads=[tile_t(i, k)], writes=[tile_t(i, k)])
+            if prev is not None:
+                rec.edge(prev, lt, "Akk")
+            level.append((i, lt))
+            tasks.append(lt)
+        lvl = 0
+        while len(level) > 1:
+            nxt = []
+            for j in range(0, len(level) - 1, 2):
+                (a, ta), (b, tb) = level[j], level[j + 1]
+                ct = rec.task("panel_comb", k, lvl, j // 2,
+                              priority=pri, rank=rank_at(a, k),
+                              reads=[tile_t(a, k), tile_t(b, k)],
+                              writes=[tile_t(a, k)])
+                rec.edge(ta, ct, "R1")
+                rec.edge(tb, ct, "R2")
+                nxt.append((a, ct))
+                tasks.append(ct)
+            if len(level) % 2:
+                nxt.append(level[-1])
+            level = nxt
+            lvl += 1
+        rt = rec.task("panel", k, priority=pri, rank=rank_at(k, k),
+                      reads=col_tiles(k, k), writes=col_tiles(k, k))
+        for t in tasks:
+            rec.edge(t, rt, "Q")
+        return rt
 
     def upd_col_t(s, c):
         return rec.task("upd_col", s, c, priority=2 * (KT - s),
@@ -284,11 +355,17 @@ def dag_pipelined(A, kind: str, recorder=None, lookahead=None,
 
     for kk in range(KT):
         c = ahead.pop(0)
-        pt = panel_t(kk)
-        if last.get(c) is not None:
-            # the column-update -> panel edge that makes the pipeline
-            # correct (dropping it is the canonical mutation test)
-            rec.edge(last[c], pt, "Akk")
+        if tree_panel:
+            # the column-update -> panel edges (into every leaf) are
+            # the pipeline-correctness edges, drawn inside
+            pt = panel_tree_t(kk, last.get(c))
+        else:
+            pt = panel_t(kk)
+            if last.get(c) is not None:
+                # the column-update -> panel edge that makes the
+                # pipeline correct (dropping it is the canonical
+                # mutation test)
+                rec.edge(last[c], pt, "Akk")
         panel_ids[kk] = pt
         last[c] = pt
         pending.append(kk)

@@ -116,6 +116,7 @@ def getrf_nopiv(A: TileMatrix, lookahead=None) -> TileMatrix:
     independent of the next panel. ``lookahead=0`` (or MCA
     ``sweep.lookahead 0``) is the serialized baseline, bit-identical
     op order."""
+    from dplasma_tpu.kernels import panels as _panels
     from dplasma_tpu.ops import _sweep
     assert A.desc.mb == A.desc.nb, "getrf needs square tiles"
     la, _ = _sweep.sweep_params(lookahead)
@@ -123,8 +124,14 @@ def getrf_nopiv(A: TileMatrix, lookahead=None) -> TileMatrix:
     KT = A.desc.KT
     NT = A.desc.NT
     rest = A.pad_diag().data
+    # panel engine: rec factors the whole (m, nb) slab as one
+    # blocked-recursive fused panel; chain keeps the diagonal
+    # getrf_nopiv + trsm pair (bit-identical pre-engine route)
+    pkind = _panels.panel_kernel("nopiv")
 
     def panel(col):
+        if pkind == "rec":
+            return (_panels.lu_panel_rec_nopiv(col),) * 2
         d = k.getrf_nopiv(col[:nb])
         if col.shape[0] > nb:
             pan = jnp.concatenate(
@@ -154,7 +161,7 @@ _LU_CHUNK = 8192
 _LU_IB = 0
 
 
-def _base_lu(panel, chunk: int | None = None):
+def _base_lu(panel, chunk: int | None = None, kind: str | None = None):
     """Pivoted LU of one narrow tall sub-panel: direct XLA LU when the
     panel fits the custom call's VMEM row budget, else CALU tournament
     pivoting (Grigori/Demmel CALU — also the shape of the reference's
@@ -173,14 +180,27 @@ def _base_lu(panel, chunk: int | None = None):
     has the same contract)."""
     m, ib = panel.shape
     from dplasma_tpu.utils import config as _cfg
-    if (panel.dtype == jnp.float32
-            and (_cfg.mca_get("lu.pallas_panel") or "off").lower()
-            == "on" and m * ib * 4 <= 8 * 2 ** 20 and ib % 8 == 0):
+    from dplasma_tpu.kernels import panels as _panels
+    # panel engine (kernels.panels, MCA panel.kernel): rec replaces
+    # the vendor custom call + CALU chunking with the blocked-
+    # recursive slab; pallas selects the fused VMEM kernel where the
+    # shape fits, degrading to rec. chain falls through to the
+    # pre-engine body below, bit-identical.
+    if kind is None:
+        kind = _panels.panel_kernel("lu")
+    if kind == "pallas":
+        from dplasma_tpu.kernels import pallas_lu
+        if pallas_lu.eligible(panel):
+            return pallas_lu.lu_panel(panel)
+        kind = "rec"
+    if kind == "rec":
+        return _panels.lu_panel_rec(panel)
+    if (_cfg.mca_get("lu.pallas_panel") or "off").lower() == "on":
         # blocked register-tile Pallas panel (kernels/pallas_lu.py;
         # VMEM-resident, JB-wide column blocks, rank-JB MXU updates) —
         # opt-in while the vendor custom call holds the measured edge
         from dplasma_tpu.kernels import pallas_lu
-        if pallas_lu.HAVE_PALLAS:
+        if pallas_lu.eligible(panel):
             return pallas_lu.lu_panel(panel)
     if chunk is None:
         chunk = _cfg.mca_get_int("lu.panel_chunk", _LU_CHUNK)
@@ -205,7 +225,8 @@ def _base_lu(panel, chunk: int | None = None):
     cand_glob = cand_pos + (jnp.arange(C) * chunk)[:, None]
     # recurse for the second level: C*ib candidate rows can themselves
     # exceed the custom call's VMEM row budget for very tall panels
-    lu2, perm2 = _base_lu(cands.reshape(C * ib, ib), chunk)
+    # (kind is pinned: a caller's chain pin must not re-resolve MCA)
+    lu2, perm2 = _base_lu(cands.reshape(C * ib, ib), chunk, kind)
     win_rows = cand_glob.reshape(-1)[perm2[:ib]]            # (ib,)
     # window permutation: winners first in elimination order, the rest
     # below in stable original order
@@ -272,6 +293,12 @@ def _lu_sweep(X, bw: int, panel_fn, lookahead=None,
     la, _ = _sweep.sweep_params(lookahead)
     agg = max(_cfg.mca_get_int("lu.agg_depth", 1), 1) if jit_steps \
         else 1
+    # resolve the panel-engine kernel ONCE and thread it statically
+    # into the jitted panel executable (an MCA flip between calls
+    # must re-trace, not replay a stale cached kernel choice)
+    if jit_steps:
+        from dplasma_tpu.kernels import panels as _panels
+        pkind = _panels.panel_kernel("lu")
     Mp, Np = X.shape
     KT = min(Mp, Np) // bw
     NT = -(-Np // bw)
@@ -279,7 +306,8 @@ def _lu_sweep(X, bw: int, panel_fn, lookahead=None,
     step_ids = []
 
     def panel(col):
-        pan, perm = _jit_lu_panel(col) if jit_steps else panel_fn(col)
+        pan, perm = _jit_lu_panel(col, pkind) if jit_steps \
+            else panel_fn(col)
         idsp = ids_cell[0][perm]
         step_ids.append(idsp)
         ids_cell[0] = idsp[bw:]
@@ -300,7 +328,8 @@ def _lu_sweep(X, bw: int, panel_fn, lookahead=None,
                       bw)
 
 
-def _panel_lu_dd(panel, ib: int | None = None):
+def _panel_lu_dd(panel, ib: int | None = None,
+                 kind: str | None = None):
     """d-precision panel LU: seed with the f32 pivoted panel machinery
     (including its CALU/VMEM fallbacks), then refine L and U to
     f64-equivalent accuracy for the FIXED permutation with limb-exact
@@ -316,7 +345,7 @@ def _panel_lu_dd(panel, ib: int | None = None):
     # panel*D = L*(U*D)  =>  U = U_scaled / d.
     m_ = jnp.max(jnp.abs(panel), axis=0, keepdims=True)
     d = 4.0 / _dd._pow2_scale_bits(m_)   # 2^-floor(log2 colmax)
-    pan32, perm = _panel_lu((panel * d).astype(jnp.float32), ib)
+    pan32, perm = _panel_lu((panel * d).astype(jnp.float32), ib, kind)
     # refine in the scaled coordinates (everything O(growth) there, so
     # the IR's own f32 seeds stay in range), unscale U exactly after
     L = k.tri(pan32.astype(panel.dtype), lower=True, unit=True)
@@ -329,25 +358,31 @@ def _panel_lu_dd(panel, ib: int | None = None):
     return packed, perm
 
 
-def _panel_lu(panel, ib: int | None = None):
+def _panel_lu(panel, ib: int | None = None, kind: str | None = None):
     """Pivoted LU of one nb-wide tall panel: a nested ib-wide
     shrinking-window sweep (full-height pivot search per sub-panel —
     LAPACK-blocked-getrf pivot quality) whose base case is
     :func:`_base_lu`. Keeps the slow LU custom call to O(M*ib*nb) flops
     and turns the rest of the panel into matmuls. f64 panels on the
-    dd route get an f32 seed + limb-IR (:func:`_panel_lu_dd`)."""
+    dd route get an f32 seed + limb-IR (:func:`_panel_lu_dd`).
+    ``kind`` pins the panel-engine kernel (None = live MCA
+    ``panel.kernel`` — jitted callers thread it statically so a
+    config flip never hits a stale cache)."""
     if panel.dtype == jnp.float64 and k._dd_active(panel.dtype):
-        return _panel_lu_dd(panel, ib)
+        return _panel_lu_dd(panel, ib, kind)
     m, nb = panel.shape
     if ib is None:
         from dplasma_tpu.utils import config as _cfg
         ib = _cfg.mca_get_int("lu.panel_ib", _LU_IB)
     if ib <= 0 or nb <= ib or nb % ib or m % ib:
-        return _base_lu(panel)
+        return _base_lu(panel, kind=kind)
     # the in-panel sweep stays serialized (lookahead=0): inside the
     # latency-bound panel a column split only adds narrow ops — the
-    # matrix-level sweep owns the pipeline
-    return _lu_sweep(panel, ib, _base_lu, lookahead=0)
+    # matrix-level sweep owns the pipeline. The kind pin threads into
+    # the sub-panel base cases (a chain pin must stay chain).
+    return _lu_sweep(panel, ib,
+                     lambda sub: _base_lu(sub, kind=kind),
+                     lookahead=0)
 
 
 # -- shape-cached dd LU sweep callbacks (eager) ------------------------
@@ -365,9 +400,9 @@ import functools as _functools
 import jax as _jax
 
 
-@_jax.jit
-def _jit_lu_panel(col):
-    return _panel_lu(col)
+@_functools.partial(_jax.jit, static_argnums=(1,))
+def _jit_lu_panel(col, kind: str | None = None):
+    return _panel_lu(col, kind=kind)
 
 
 @_jax.jit
@@ -810,7 +845,8 @@ def getrf_lowmem(A, nb: int = 512, budget_bytes: int | None = None):
     return Ah, jnp.asarray(perm)
 
 
-def dag(A: TileMatrix, recorder=None, *, lookahead=None):
+def dag(A: TileMatrix, recorder=None, *, lookahead=None,
+        panel_kernel=None):
     """Record the tile-level right-looking LU DAG (task classes
     getrf/trsm_l/trsm_u/gemm with block-cyclic owner ranks) into
     ``recorder`` for ``--dot`` dumps and DAG analytics.
@@ -829,7 +865,8 @@ def dag(A: TileMatrix, recorder=None, *, lookahead=None):
     from dplasma_tpu.utils import profiling
     la, _ = _sweep.sweep_params(lookahead)
     if la > 0:
-        return _sweep.dag_pipelined(A, "getrf", recorder, la)
+        return _sweep.dag_pipelined(A, "getrf", recorder, la,
+                                    panel_kernel=panel_kernel)
     rec = recorder if recorder is not None else profiling.recorder
     MT, NT = A.desc.MT, A.desc.NT
     KT = min(MT, NT)

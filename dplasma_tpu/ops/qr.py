@@ -45,9 +45,11 @@ from dplasma_tpu.parallel import mesh as pmesh
 # + one narrow column apply per step, one wide apply per agg_depth
 # steps) while streaming the far trailing matrix once per flush.
 
-@jax.jit
-def _jit_dd_qr_panel(col):
+@partial(jax.jit, static_argnums=(1,))
+def _jit_dd_qr_panel(col, kind: str = "chain"):
     from dplasma_tpu.kernels import dd as _dd
+    if kind == "tree":
+        return _dd.geqrt_f64_tree(col)
     return _dd.geqrt_f64(col)
 
 
@@ -139,6 +141,15 @@ def geqrf(A: TileMatrix, *, panel_kernel=None, lookahead=None,
     (bit-identical op order); defaults come from MCA
     ``sweep.lookahead`` / ``qr.agg_depth`` (CLI ``--lookahead``).
 
+    The panel itself factors by the panel ENGINE (kernels.panels,
+    MCA ``panel.kernel``): ``chain`` = the vendor geqrt (or the dd
+    limb CholeskyQR2 on the d route) exactly as before; ``tree`` =
+    the TSQR/CAQR binary-reduction panel (batched leaf geqrfs,
+    O(log mt) R-tree, TSQR-HR reconstruction back to compact-WY, so
+    every downstream apply is untouched); ``pallas`` = the fused
+    VMEM panel kernel where eligible. The explicit ``panel_kernel``
+    CALLABLE argument (geqrf_rec) bypasses the engine.
+
     The window is a fresh value each step — no dynamic-update-slice
     re-materialization of the full matrix (the pathology that forced
     ops.potrf left-looking)."""
@@ -183,15 +194,27 @@ def geqrf(A: TileMatrix, *, panel_kernel=None, lookahead=None,
     # cached per window shape — the monolithic trace OOM-kills the
     # compile helper > 2048
 
+    # panel-engine kernel for this sweep (kernels.panels MCA
+    # panel.kernel; chain = the pre-engine route, bit-identical). The
+    # dd route has only the tree/chain pair (the fused pallas panel
+    # is f32; pallas resolves to its tree fallback there). Resolved
+    # ONCE here and threaded as a static arg into the eager
+    # executables so a config flip never hits a stale jit cache.
+    from dplasma_tpu.kernels import panels as _panels
+    pk = _panels.panel_kernel("qr")
+    dd_kind = "tree" if pk in ("tree", "pallas") else "chain"
+
     def panel(col):
         if eager:
-            packed, v, T = _jit_dd_qr_panel(col)
+            packed, v, T = _jit_dd_qr_panel(col, dd_kind)
         elif panel_kernel is not None:
             packed, v, T = panel_kernel(col)
         elif use_dd:
-            packed, v, T = _dd.geqrt_f64(col)
+            packed, v, T = (_dd.geqrt_f64_tree(col)
+                            if dd_kind == "tree"
+                            else _dd.geqrt_f64(col))
         else:
-            packed, v, T = hh.geqrt(col, rankfull=True)
+            packed, v, T = _panels.qr_panel(col, pk)
         Ts.append(T)
         return packed, (v, T)
 
@@ -440,7 +463,7 @@ def geqrf_lowmem(A, nb: int = 512, budget_bytes: int | None = None):
 
 
 def dag(A: TileMatrix, recorder=None, *, lookahead=None,
-        agg_depth=None):
+        agg_depth=None, panel_kernel=None):
     """Record the tile-level blocked QR DAG (task classes geqrt/unmqr/
     tsqrt/tsmqr — the zgeqrf JDF's flat-tree dependence structure) into
     ``recorder`` for ``--dot`` dumps and DAG analytics.
@@ -467,7 +490,8 @@ def dag(A: TileMatrix, recorder=None, *, lookahead=None,
     from dplasma_tpu.utils import profiling
     la, agg = _sweep.sweep_params(lookahead, agg_depth)
     if la > 0 or agg > 1:
-        return _sweep.dag_pipelined(A, "geqrf", recorder, la, agg)
+        return _sweep.dag_pipelined(A, "geqrf", recorder, la, agg,
+                                    panel_kernel=panel_kernel)
     rec = recorder if recorder is not None else profiling.recorder
     MT, NT = A.desc.MT, A.desc.NT
     KT = min(MT, NT)

@@ -462,9 +462,9 @@ def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh, lookahead: int = 0):
     return f(data)
 
 
-@partial(jax.jit, static_argnums=(1, 2, 3))
+@partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh,
-                      lookahead: int = 0):
+                      lookahead: int = 0, panel: str = "chain"):
     """Distributed tournament-pivoting LU over cyclic local slabs —
     the reference's hand-distributed parallel panel
     (src/zgetrf_ptgpanel.jdf: per-rank panel elimination + pivot
@@ -512,14 +512,29 @@ def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh,
                 pan = pan_next
             panm = jnp.where(active[:, None], pan, 0)
             # 2) local candidate election (one local LU per row-rank,
-            #    concurrently across 'p' — the distributed panel)
-            _, _, cperm = jax.lax.linalg.lu(panm)
+            #    concurrently across 'p' — the distributed panel).
+            #    The panel engine selects the election/playoff kernel:
+            #    rec = the blocked-recursive fused panel (kernels.
+            #    panels, no vendor custom call), chain = lax.linalg.lu
+            #    (bit-identical pre-engine route). Local work only —
+            #    the collective schedule is IDENTICAL either way
+            #    (spmdcheck's exact-count contract holds per kernel).
+            if panel == "rec":
+                from dplasma_tpu.kernels import panels as _panels
+                _, cperm = _panels.lu_panel_rec(panm)
+            else:
+                _, _, cperm = jax.lax.linalg.lu(panm)
             cand_pos = cperm[:mb]                          # (mb,) local
             cands = panm[cand_pos]
             # 3) playoff: all_gather candidates along 'p', replicated LU
             allc = jax.lax.all_gather(cands, pmesh.ROW_AXIS)
             allid = jax.lax.all_gather(gid[cand_pos], pmesh.ROW_AXIS)
-            lu2, _, perm2 = jax.lax.linalg.lu(allc.reshape(P * mb, mb))
+            if panel == "rec":
+                lu2, perm2 = _panels.lu_panel_rec(
+                    allc.reshape(P * mb, mb))
+            else:
+                lu2, _, perm2 = jax.lax.linalg.lu(
+                    allc.reshape(P * mb, mb))
             wr = perm2[:mb]                                # stack index
             win_gids = allid.reshape(P * mb)[wr]
             top = lu2[:mb]                       # packed L11\U11 rows
@@ -609,8 +624,12 @@ def getrf_cyclic(A: CyclicMatrix):
     ms = (m.shape[pmesh.ROW_AXIS], m.shape[pmesh.COL_AXIS])
     assert ms == (A.desc.dist.P, A.desc.dist.Q), (
         f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
+    from dplasma_tpu.kernels import panels as _panels
+    pk = _panels.panel_kernel("lu")
+    if pk == "pallas":   # no fused pallas panel inside shard_map
+        pk = "rec"
     out, wins, active = _getrf_cyclic_jit(A.data, A.desc, m,
-                                          _cyclic_lookahead())
+                                          _cyclic_lookahead(), pk)
     desc = A.desc
     d = desc.dist
     mb = desc.mb

@@ -33,21 +33,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
-def _pallas_runtime_ok() -> bool:
-    """Can the repo's Pallas kernels actually run here? ``import
-    pallas`` succeeding is not enough: the kernels also need the API
-    surface they were written against (``pltpu.CompilerParams``, the
-    ``jax.enable_x64`` scope) and a working interpret-mode
-    ``pallas_call``. Probe all of it once per session — the shared
-    skip condition behind the ``requires_pallas`` marker (the
-    HAVE_PALLAS module flags only cover the bare import)."""
+def _pallas_interpret_ok() -> bool:
+    """Can interpret-mode ``pallas_call`` run here at all? This is
+    the surface the panel kernels (pallas_lu / pallas_qr / pallas_dd)
+    need: a bare pallas import plus a working interpret round-trip —
+    version differences in the tpu namespace are absorbed by
+    ``kernels.pallas_compat``, so they are NOT part of this probe."""
     try:
         from jax.experimental import pallas as pl
-        from jax.experimental.pallas import tpu as pltpu
-        if not hasattr(pltpu, "CompilerParams"):   # kernels/pallas_kernels
-            return False
-        if not hasattr(jax, "enable_x64"):         # kernels/pallas_{lu,dd}
-            return False
 
         def _ident(x_ref, o_ref):
             o_ref[...] = x_ref[...]
@@ -63,34 +56,104 @@ def _pallas_runtime_ok() -> bool:
         return False
 
 
-HAVE_PALLAS_RUNTIME = _pallas_runtime_ok()
+def _pallas_runtime_ok() -> bool:
+    """The FULL kernel surface on top of interpret mode: grids,
+    BlockSpecs, VMEM scratch and compiler params as the gridded
+    kernels (pallas_kernels) use them — probed by a tiny fused matmul
+    through the real kernel (the compat shims resolve the
+    CompilerParams spelling, so an old-but-complete pallas passes)."""
+    if not HAVE_PALLAS_INTERPRET:
+        return False
+    try:
+        import jax.numpy as jnp
+        from dplasma_tpu.kernels import pallas_kernels as pk
+        a = jnp.ones((8, 128), jnp.float32)
+        b = jnp.ones((128, 128), jnp.float32)
+        out = pk.matmul(a, b, bm=8, bn=128, bk=128)
+        return bool(abs(float(np.asarray(out)[0, 0]) - 128.0) < 1e-3)
+    except Exception:
+        return False
 
-#: shared skip for tests that execute Pallas kernels — usable both as
-#: ``@requires_pallas`` on a test and as ``pytestmark`` on a module
+
+HAVE_PALLAS_INTERPRET = _pallas_interpret_ok()
+HAVE_PALLAS_RUNTIME = _pallas_runtime_ok()
+#: real Mosaic lowering only exists on a TPU backend — interpret-mode
+#: coverage runs everywhere else
+HAVE_PALLAS_TPU = HAVE_PALLAS_RUNTIME and \
+    jax.default_backend() == "tpu"
+
+#: per-feature skips for tests that execute Pallas kernels — usable
+#: both as ``@requires_*`` on a test and as ``pytestmark`` on a module
+requires_pallas_interpret = pytest.mark.skipif(
+    not HAVE_PALLAS_INTERPRET,
+    reason="pallas interpret mode unavailable (import/round-trip "
+           "probe failed)")
 requires_pallas = pytest.mark.skipif(
     not HAVE_PALLAS_RUNTIME,
-    reason="pallas runtime unavailable (import/API-surface/interpret "
+    reason="pallas runtime unavailable (grid/scratch/compiler-params "
            "probe failed)")
+requires_pallas_tpu = pytest.mark.skipif(
+    not HAVE_PALLAS_TPU,
+    reason="no TPU backend: pallas kernels cannot lower to Mosaic "
+           "here (interpret-mode coverage runs instead)")
+
+_PALLAS_MARKERS = {
+    "requires_pallas_interpret": (
+        HAVE_PALLAS_INTERPRET,
+        "pallas interpret mode unavailable (import/round-trip probe "
+        "failed)"),
+    "requires_pallas": (
+        HAVE_PALLAS_RUNTIME,
+        "pallas runtime unavailable (grid/scratch/compiler-params "
+        "probe failed)"),
+    "requires_pallas_tpu": (
+        HAVE_PALLAS_TPU,
+        "no TPU backend: pallas kernels cannot lower to Mosaic here"),
+}
 
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
-        "requires_pallas: test executes Pallas kernels; skipped when "
-        "the session-level pallas runtime probe fails")
+        "requires_pallas: test executes gridded Pallas kernels; "
+        "skipped when the session-level runtime probe fails")
+    config.addinivalue_line(
+        "markers",
+        "requires_pallas_interpret: test executes Pallas kernels in "
+        "interpret mode; skipped when even the interpret probe fails")
+    config.addinivalue_line(
+        "markers",
+        "requires_pallas_tpu: test lowers Pallas kernels to Mosaic; "
+        "skipped off-TPU")
 
 
 def pytest_collection_modifyitems(config, items):
-    """Make ``@pytest.mark.requires_pallas`` equivalent to the shared
-    skipif (so tests outside this module need no conftest import)."""
-    if HAVE_PALLAS_RUNTIME:
-        return
-    skip = pytest.mark.skip(
-        reason="pallas runtime unavailable (import/API-surface/"
-               "interpret probe failed)")
+    """Make the ``@pytest.mark.requires_pallas*`` markers equivalent
+    to their shared skipifs (so tests outside this module need no
+    conftest import)."""
     for item in items:
-        if "requires_pallas" in item.keywords:
-            item.add_marker(skip)
+        for mark, (ok, why) in _PALLAS_MARKERS.items():
+            if mark in item.keywords and not ok:
+                item.add_marker(pytest.mark.skip(reason=why))
+
+
+import contextlib  # noqa: E402
+
+
+@contextlib.contextmanager
+def mca_overrides(kv):
+    """Scoped MCA overrides with exact save/restore of the override
+    store (shared by test_pipeline / test_panels — keep the semantics
+    in ONE place)."""
+    from dplasma_tpu.utils import config
+    saved = dict(config._MCA_OVERRIDES)
+    try:
+        for key, val in kv.items():
+            config.mca_set(key, val)
+        yield
+    finally:
+        config._MCA_OVERRIDES.clear()
+        config._MCA_OVERRIDES.update(saved)
 
 
 @pytest.fixture(scope="session")

@@ -133,7 +133,9 @@ def _rand_herm_band(N, b, seed=1, cplx=False):
         np.diag(np.real(np.diagonal(X)))
 
 
-@pytest.mark.parametrize("N,b", [(96, 32), (130, 17), (64, 63)])
+@pytest.mark.parametrize("N,b", [
+    pytest.param(96, 32, marks=pytest.mark.slow),
+    (130, 17), (64, 63)])
 def test_herm_sbr_scan_exact(N, b):
     """Pipelined SBR band->tridiag preserves eigenvalues exactly
     (f64): the multi-bulge stage-2 replacement (ref zhbrdt.jdf role)."""

@@ -99,6 +99,7 @@ def test_potrf_cyclic_matches_global(devices8, dist, MT):
                                rtol=1e-10, atol=1e-10)
 
 
+@pytest.mark.slow
 def test_potrf_cyclic_complex(devices8):
     dist = Dist(P=2, Q=4, kp=2)
     mb, MT = 6, 5
@@ -169,7 +170,7 @@ def test_geqrf_cyclic_residual(devices8):
 
     P, Q = 2, 4
     m = mesh.make_mesh(P, Q, devices8)
-    N, nb = 48, 4
+    N, nb = 48, 8
     dist = Dist(P=P, Q=Q, kp=2, kq=2)
     with mesh.use_grid(m):
         A0 = generators.plrnt(N, N, nb, nb, seed=5, dtype=jnp.float32)
@@ -205,7 +206,7 @@ def test_a2a_conversion_matches_gather(devices8, dist):
     the parsec_redistribute role): must reproduce the gather path
     exactly and round-trip, with only O(local)-sized exchange
     buffers."""
-    MT, NT = 11, 7
+    MT, NT = 7, 5
     mb = 4
     M, N = MT * mb - 1, NT * mb - 2
     rng = np.random.default_rng(5)
@@ -397,7 +398,7 @@ def test_herbt_heev_cyclic(devices8):
     matches the dense eigensolver (ref src/zheev_wrapper.c:96-103)."""
     from dplasma_tpu.ops.norms import _sym_full
     dist = Dist(P=2, Q=4, kp=2, kq=2)
-    N, mb = 96, 8
+    N, mb = 64, 8
     A0 = generators.plghe(float(N), N, mb, seed=17, dtype=jnp.float64,
                           dist=dist)
     full = _sym_full(A0, "L", conj=True)

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 8
+    assert doc["schema"] == REPORT_SCHEMA == 9
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -135,6 +135,10 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
                                    "compile_s": 1.5},
                          "remediated": 0, "failed": 0, "retries": 0,
                          "escalations": 0}]},
+        9: {"schema": 9, "name": "v9", "ops": [], "metrics": [],
+            "pipeline": {"sweep.lookahead": 1, "qr.agg_depth": 4,
+                         "panel.kernel": "auto", "panel.qr": "tree",
+                         "panel.lu": "rec"}},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -385,7 +389,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 8
+    assert doc["schema"] == 9
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

@@ -36,10 +36,11 @@ def test_package_pallas_sites_verify_clean():
     contract passes every check."""
     res = pc.check_package()
     assert res.ok, res.format()
-    assert res.sites_found == 3          # pallas_kernels, _lu, _dd
+    assert res.sites_found == 4    # pallas_kernels, _lu, _qr, _dd
     if res.skipped is None:
-        assert res.contracts == 4        # gemm epilogue + matmul +
-        #                                # lu panel + dd recombine
+        assert res.contracts == 5        # gemm epilogue + matmul +
+        #                                # lu panel + qr panel +
+        #                                # dd recombine
 
 
 def test_every_site_is_registered():

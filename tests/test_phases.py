@@ -252,7 +252,7 @@ def test_driver_phase_profile_acceptance(tmp_path, capsys, prog):
     overhead) to the attributed run time."""
     doc = _phase_run(tmp_path, prog)
     out = capsys.readouterr().out
-    assert doc["schema"] == 8
+    assert doc["schema"] == 9
     (op,) = doc["ops"]
     ph = op["phases"]
     spans = ph["spans"]
@@ -480,6 +480,33 @@ def test_perfdiff_latest_comparable_entry(tmp_path):
     # nothing comparable (or no metrics at all): newest raw entry,
     # so the callers' vacuous-gate handling still engages
     assert perfdiff.latest_comparable_entry(ledger, {"ops": []}) == e3
+
+
+def test_perfdiff_baseline_prefers_same_pipeline(tmp_path):
+    """Same-family baselining keys on the recorded pipeline section
+    (panel-engine strategy included): a chain-panel rerun interleaved
+    after a tree-panel entry must not become the next tree run's
+    baseline; with no same-strategy entry the newest same-family
+    entry still serves (the r05 -> r06 first-comparison case)."""
+    ledger = str(tmp_path / "h.jsonl")
+    tree = {"sweep.lookahead": 1, "qr.agg_depth": 4,
+            "panel.kernel": "auto", "panel.qr": "tree",
+            "panel.lu": "rec"}
+    chain = dict(tree, **{"panel.qr": "chain", "panel.lu": "chain"})
+    e_tree = {"pipeline": tree,
+              "ladder": [{"metric": "a_gflops", "value": 10.0}]}
+    e_chain = {"pipeline": chain,
+               "ladder": [{"metric": "a_gflops", "value": 7.0}]}
+    for e in (e_tree, e_chain):
+        perfdiff.append_ledger(ledger, e)
+    cand = {"pipeline": dict(tree),
+            "ladder": [{"metric": "a_gflops", "value": 11.0}]}
+    assert perfdiff.latest_comparable_entry(ledger, cand) == e_tree
+    # no same-pipeline prior (e.g. pre-panel-key vintages): newest
+    # same-family entry remains the baseline
+    cand2 = {"pipeline": dict(tree, **{"panel.qr": "pallas"}),
+             "ladder": [{"metric": "a_gflops", "value": 11.0}]}
+    assert perfdiff.latest_comparable_entry(ledger, cand2) == e_chain
 
 
 def test_perfdiff_compare_api_old_schema_docs():

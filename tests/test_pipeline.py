@@ -9,28 +9,18 @@ compact-WY block-T aggregation and the potrf wide-vs-skinny
 accumulation reassociate sums) — for potrf/getrf/geqrf across f32 and
 the dd-f64 route, on one device and the 2x2 cyclic grid.
 """
-import contextlib
-
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import mca_overrides
 from dplasma_tpu.descriptors import Dist, TileMatrix
 from dplasma_tpu.ops import checks, generators, lu, potrf as potrf_mod
 from dplasma_tpu.ops import qr
 from dplasma_tpu.utils import config
 
 
-@contextlib.contextmanager
-def mca(kv):
-    saved = dict(config._MCA_OVERRIDES)
-    try:
-        for key, val in kv.items():
-            config.mca_set(key, val)
-        yield
-    finally:
-        config._MCA_OVERRIDES.clear()
-        config._MCA_OVERRIDES.update(saved)
+mca = mca_overrides
 
 
 def _tol(dtype):
@@ -110,11 +100,16 @@ def test_lookahead_zero_is_bit_exact_baseline():
 
 # ------------------------------------------------------- dd-f64 route
 
-@pytest.mark.parametrize("la,agg", [(1, 1), (1, 2)])
+@pytest.mark.parametrize("la,agg", [
+    pytest.param(1, 1, marks=pytest.mark.slow),  # (1,2) covers both
+    (1, 2)])
 def test_geqrf_dd_route_lookahead_equivalent(la, agg):
     """The eager dd-f64 route (per-shape jitted engine callbacks)
-    matches its serialized baseline."""
-    N, nb = 128, 32
+    matches its serialized baseline (whose own correctness is pinned
+    by test_panels' dd engine tests and the slow tier's
+    test_geqrf_f64_under_dd — the dd ungqr walk is too heavy to
+    repeat here)."""
+    N, nb = 96, 32
     A = generators.plrnt(N, N, nb, nb, seed=6, dtype=jnp.float64)
     with mca({"dd_gemm": "always", "sweep.lookahead": "0",
               "qr.agg_depth": "1"}):
@@ -122,45 +117,40 @@ def test_geqrf_dd_route_lookahead_equivalent(la, agg):
     with mca({"dd_gemm": "always", "sweep.lookahead": str(la),
               "qr.agg_depth": str(agg)}):
         B1, T1 = qr.geqrf(A)
-        Q = qr.ungqr(B1, T1).to_dense()
-        R = jnp.triu(B1.to_dense()[:N, :])
     d0 = np.asarray(B0.to_dense())
     assert np.abs(np.asarray(B1.to_dense()) - d0).max() \
         <= 1e-12 * np.abs(d0).max()
-    r, ok = checks.check_qr(A, Q, R)
-    assert ok, r
+    t0 = np.asarray(T0.data)
+    assert np.abs(np.asarray(T1.data) - t0).max() \
+        <= 1e-12 * max(np.abs(t0).max(), 1.0)
 
 
-def test_getrf_dd_eager_lookahead_equivalent():
-    """The eager dd LU route (> 8 panels) under lookahead matches the
-    serialized baseline, pivots included."""
+def test_getrf_dd_eager_lookahead_and_fused_flush():
+    """The eager dd LU route (> 8 panels): lookahead matches the
+    serialized baseline (pivots included), and lu.agg_depth's fused
+    far flushes are IDENTICAL to per-step flushes (pure dispatch
+    fusion — same op order, unlike QR's reassociating aggregation).
+    One shared 160^2 dd matrix: these factorizations cost ~10s each,
+    so the two properties share the la=1 baselines (tier-1 budget)."""
     N, nb = 160, 16
     A = generators.plrnt(N, N, nb, nb, seed=7, dtype=jnp.float64)
-    with mca({"dd_gemm": "always", "sweep.lookahead": "0"}):
+    with mca({"dd_gemm": "always", "sweep.lookahead": "0",
+              "lu.agg_depth": "1"}):
         F0, p0 = lu.getrf_1d(A)
-    with mca({"dd_gemm": "always", "sweep.lookahead": "1"}):
+    with mca({"dd_gemm": "always", "sweep.lookahead": "1",
+              "lu.agg_depth": "1"}):
         F1, p1 = lu.getrf_1d(A)
+    with mca({"dd_gemm": "always", "sweep.lookahead": "1",
+              "lu.agg_depth": "4"}):
+        F4, p4 = lu.getrf_1d(A)
     assert (np.asarray(p0) == np.asarray(p1)).all()
     d0 = np.asarray(F0.to_dense())
     assert np.abs(np.asarray(F1.to_dense()) - d0).max() \
         <= 1e-12 * max(np.abs(d0).max(), 1.0)
-
-
-def test_getrf_dd_eager_fused_flush_identical():
-    """lu.agg_depth fuses the eager route's far flushes into one
-    executable per d panels — pure dispatch fusion, so the result is
-    IDENTICAL to per-step flushes (same op order, unlike QR's
-    reassociating aggregation)."""
-    N, nb = 160, 16
-    A = generators.plrnt(N, N, nb, nb, seed=14, dtype=jnp.float64)
-    with mca({"dd_gemm": "always", "sweep.lookahead": "1",
-              "lu.agg_depth": "1"}):
-        F0, p0 = lu.getrf_1d(A)
-    with mca({"dd_gemm": "always", "sweep.lookahead": "1",
-              "lu.agg_depth": "4"}):
-        F1, p1 = lu.getrf_1d(A)
-    assert (np.asarray(p0) == np.asarray(p1)).all()
-    assert (np.asarray(F0.to_dense()) == np.asarray(F1.to_dense())).all()
+    # dispatch fusion: bit-identical to the per-step la=1 result
+    assert (np.asarray(p4) == np.asarray(p1)).all()
+    assert (np.asarray(F4.to_dense())
+            == np.asarray(F1.to_dense())).all()
 
 
 def test_potrf_dd_route_ignores_lookahead():
@@ -281,14 +271,21 @@ def test_report_pipeline_section_schema_v6(tmp_path, capsys):
     assert rc == 0
     assert "#+ pipeline: sweep.lookahead=" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 8
-    assert set(doc["pipeline"]) == {"sweep.lookahead", "qr.agg_depth"}
+    assert doc["schema"] == 9
+    assert set(doc["pipeline"]) == {"sweep.lookahead", "qr.agg_depth",
+                                    "panel.kernel", "panel.qr",
+                                    "panel.lu"}
+    # per-route panel-engine resolution is recorded, never raw "auto"
+    assert doc["pipeline"]["panel.qr"] in ("chain", "tree", "pallas")
+    assert doc["pipeline"]["panel.lu"] in ("chain", "rec", "pallas")
 
 
 def test_mca_knobs_registered():
     assert config.mca_get("sweep.lookahead") == "1"
     assert config.mca_get("qr.agg_depth") == "4"
     assert "sweep.lookahead" in config.mca_help()
+    assert config.mca_get("panel.kernel") == "auto"
+    assert "panel.kernel" in config.mca_help()
 
 
 # ------------------------------------------------ unmqr split caching

@@ -118,6 +118,7 @@ def test_potrf_rec_matches_flat():
                            np.asarray(L2.to_dense()), atol=1e-10)
 
 
+@pytest.mark.slow
 def test_potrf_lowmem_budget(rng):
     """Out-of-HBM tier (ref Testings.cmake:147 lowmem): an artificially
     tiny budget must still factor a matrix larger than the budget, with

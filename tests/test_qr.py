@@ -53,7 +53,9 @@ def test_unmqr_matches_explicit_q(side, trans):
 
 @pytest.mark.parametrize("M,N,nb", [(130, 130, 32), (93, 147, 25),
                                     (147, 93, 25)])
-@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+@pytest.mark.parametrize("dtype", [
+    jnp.float64,
+    pytest.param(jnp.complex128, marks=pytest.mark.slow)])
 def test_gelqf_residual_orthogonality(M, N, nb, dtype):
     A0 = generators.plrnt(M, N, nb, nb, seed=13, dtype=dtype)
     Af, Tf = jax.jit(qr.gelqf)(A0)

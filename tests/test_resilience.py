@@ -43,6 +43,7 @@ def _trsm_args():
     return a, b
 
 
+@pytest.mark.slow
 def test_injection_deterministic_jit_and_eager():
     """Same seed + same plan => bit-identical corruption across runs,
     on both the jit and non-jit paths."""
@@ -62,6 +63,7 @@ def test_injection_deterministic_jit_and_eager():
     assert int(np.isnan(e).sum()) == 1
 
 
+@pytest.mark.slow
 def test_bitflip_deterministic_and_significant():
     plan = inject.parse_plan("bitflip@gemm:1", seed=11)
     a, b = _trsm_args()
@@ -76,6 +78,7 @@ def test_bitflip_deterministic_and_significant():
     assert (y1 != clean).sum() == 1 and y1[i, j] != clean[i, j]
 
 
+@pytest.mark.slow
 def test_zero_tile_and_inf_kinds():
     a, b = _trsm_args()
     with inject.active(inject.parse_plan("zero@gemm:1", seed=3)):
@@ -288,7 +291,7 @@ def test_driver_inject_detect_remediate_report(tmp_path, capsys):
     assert "#+ resilience: injected nan at trsm" in out
     assert "outcome remediated" in out
     doc = json.load(open(rep))
-    assert doc["schema"] == 8
+    assert doc["schema"] == 9
     r = doc["resilience"][0]
     assert r["injection"]["plan"].startswith("nan@trsm")
     assert len(r["injection"]["faults"]) == 1
@@ -335,6 +338,7 @@ def test_driver_gemm_abft_corrects_inline(tmp_path, capsys):
     assert ab["detected"] and ab["corrected"] and len(ab["located"]) == 1
 
 
+@pytest.mark.slow
 def test_driver_env_inject_default(tmp_path, capsys, monkeypatch):
     monkeypatch.setenv("DPLASMA_INJECT", "nan@trsm:1")
     rep = tmp_path / "env.json"

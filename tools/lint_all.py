@@ -148,6 +148,18 @@ def run_dagcheck_smoke() -> int:
              "getrf", 1),
             ("qr_pipe", lambda r: qr.dag(A, r, lookahead=1,
                                          agg_depth=2), "geqrf", 1),
+            # the panel engine's task structures: the TSQR tree panel
+            # (panel_leaf -> panel_comb ladder -> panel root) and the
+            # fused rec LU panel must verify race-free/flow-covered
+            # like any flat DAG (verify-before-execute holds for the
+            # reordered panel too)
+            ("qr_tree", lambda r: qr.dag(A, r, lookahead=1,
+                                         agg_depth=2,
+                                         panel_kernel="tree"),
+             "geqrf", 1),
+            ("lu_rec", lambda r: lu.dag(A, r, lookahead=1,
+                                        panel_kernel="rec"),
+             "getrf", 1),
         ]
         for label, build, op, K in cases:
             rec = DagRecorder(enabled=True)

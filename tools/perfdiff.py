@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v8), the bench
+(``--report`` from any driver, any schema vintage v1-v9), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -59,11 +59,19 @@ def latest_comparable_entry(path: str, doc: dict) -> Optional[dict]:
     ``doc``. Several bench families (bench.py's ladder, servebench's
     serving.* metrics) may share one ledger; a gate that baselines
     against the raw newest entry would compare across families and
-    pass informationally forever. With no shared-metric entry (or a
-    candidate with no metrics at all) this falls back to the newest
-    raw entry, preserving the callers' vacuous-gate handling."""
+    pass informationally forever. Among shared-metric entries, one
+    whose ``"pipeline"`` section (lookahead/aggregation shape AND the
+    panel-engine strategy) matches the candidate's is preferred: a
+    chain-panel rerun interleaved after a tree-panel run must not
+    silently become the tree run's baseline — strategy flips compare
+    same-vs-same when the ledger has a same-strategy entry, and only
+    fall back to the newest same-family entry when it does not. With
+    no shared-metric entry (or a candidate with no metrics at all)
+    this falls back to the newest raw entry, preserving the callers'
+    vacuous-gate handling."""
     want = set(extract_metrics(doc))
-    best = last = None
+    pipe = doc.get("pipeline")
+    best = best_pipe = last = None
     with open(path) as f:
         for line in f:
             if not line.strip():
@@ -77,6 +85,11 @@ def latest_comparable_entry(path: str, doc: dict) -> Optional[dict]:
             last = entry
             if want & set(extract_metrics(entry)):
                 best = entry
+                if isinstance(pipe, dict) \
+                        and entry.get("pipeline") == pipe:
+                    best_pipe = entry
+    if best_pipe is not None:
+        return best_pipe
     return best if best is not None else last
 
 
