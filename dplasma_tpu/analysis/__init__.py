@@ -18,7 +18,9 @@ wrongness can enter:
   :func:`dplasma_tpu.utils.is_concrete`, no mutable defaults, no
   numpy on traced values in jit, no bare ``jnp.float64`` outside the
   dd-emulation modules, no nondeterminism in kernels, no hard-coded
-  mesh axis-name literals outside :mod:`dplasma_tpu.parallel.mesh`).
+  mesh axis-name literals outside :mod:`dplasma_tpu.parallel.mesh`,
+  no in-place parameter rewrite in a jitted hot-path body without
+  ``donate_argnums``).
 * :mod:`.spmdcheck` — the SPMD collective-schedule verifier for the
   shard_map execution surface: axis binding, per-rank sequence
   uniformity (deadlock freedom), ppermute bijections, collective
@@ -29,9 +31,21 @@ wrongness can enter:
   ``pl.pallas_call`` site's BlockSpec divisibility and tiling, index-
   map grid coverage, VMEM budget, and precision contract, captured
   without executing a kernel. Driven by ``tools/lint_all.py``.
+* :mod:`.hlocheck` — the compiled-artifact auditor over the
+  post-GSPMD HLO the device actually runs: per-kind collective
+  counts reconciled exactly against the jaxpr schedule and the
+  analytic comm model (a GSPMD-inserted hidden collective is named),
+  float demotions below the working precision outside the registered
+  dd/limb sites, requested-but-dropped buffer donations, peak memory
+  vs the ``hlocheck.hbm_budget`` knob, and host-callback /
+  copy-volume anti-patterns. Driven by ``--hlocheck``, the serving
+  executable cache, and ``tools/lint_all.py``.
 """
 from dplasma_tpu.analysis.dagcheck import (DagCheckError, check_dag,
                                            rank_of_dist)
+from dplasma_tpu.analysis.hlocheck import (HloCheckError,
+                                           check_executable,
+                                           verify_executable)
 from dplasma_tpu.analysis.jaxlint import lint_file as jaxlint_file
 from dplasma_tpu.analysis.jaxlint import lint_tree as jaxlint_tree
 from dplasma_tpu.analysis.palcheck import (PalCheckError,
@@ -46,4 +60,5 @@ __all__ = ["DagCheckError", "check_dag", "rank_of_dist",
            "jaxlint_file", "jaxlint_tree",
            "SpmdCheckError", "check_kernel", "check_ring",
            "extract_schedule", "simulate_ring",
-           "PalCheckError", "check_contract", "check_package"]
+           "PalCheckError", "check_contract", "check_package",
+           "HloCheckError", "check_executable", "verify_executable"]

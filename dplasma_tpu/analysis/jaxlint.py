@@ -37,6 +37,19 @@ Rules:
   must route through ``pmesh.ROW_AXIS``/``pmesh.COL_AXIS`` — the lint
   companion to spmdcheck's axis-binding check (a renamed mesh axis
   must break at the one definition site, not desynchronize silently).
+* **J009 missing-donation** — a jit-decorated function in
+  ``kernels/``, ``ops/``, or ``serving/`` that REWRITES a traced
+  parameter wholesale (``jax.lax.dynamic_update_slice(p, ...)`` or
+  ``p.at[...].set/add(...)`` on a bare parameter name) without
+  donating it (``donate_argnums``/``donate_argnames``): the rewrite
+  is the canonical donation opportunity, and a missed one carries the
+  buffer twice — input and output live simultaneously, doubling the
+  footprint of exactly the large resident operands (limb caches,
+  column blocks) the lowmem tiers exist to bound. Allowlist sites
+  whose caller genuinely reuses the operand after the call in
+  :data:`DONATE_ALLOWLIST`. The compiled-artifact twin (a donation
+  *requested* but dropped by the compiler) is
+  :mod:`dplasma_tpu.analysis.hlocheck`'s donation audit.
 
 Traced-ness is a static approximation: the parameters of a
 jit/shard_map-decorated function (minus ``static_argnums`` /
@@ -74,6 +87,17 @@ KERNEL_DIRS = ("dplasma_tpu/kernels",)
 
 #: the one module allowed to spell the mesh axis names as literals
 AXIS_NAME_ALLOWLIST = {"dplasma_tpu/parallel/mesh.py"}
+
+#: modules whose jit sites J009 polices (the hot-path packages whose
+#: operands are big enough for a missed donation to matter)
+DONATE_DIRS = ("dplasma_tpu/kernels", "dplasma_tpu/ops",
+               "dplasma_tpu/serving")
+
+#: (module, function) pairs allowed to rewrite a traced parameter
+#: without donating it — the choke points whose CALLER keeps using
+#: the operand after the call, so donation would invalidate a live
+#: buffer. Empty today: every in-package rewrite site donates.
+DONATE_ALLOWLIST: set = set()
 
 #: the mesh axis-name literals J008 polices (parallel/mesh.py owns them)
 _AXIS_LITERALS = {"p", "q"}
@@ -178,6 +202,67 @@ def _numpy_call(node: ast.Call) -> Optional[str]:
     return None
 
 
+def _donated_params(fn) -> Set[str]:
+    """Parameter names donated by a jit/partial(jax.jit, ...)
+    decorator's ``donate_argnums``/``donate_argnames``."""
+    names: Set[str] = set()
+    params = [a.arg for a in fn.args.args]
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg not in ("donate_argnums", "donate_argnames"):
+                continue
+            try:
+                v = ast.literal_eval(kw.value)
+            except ValueError:
+                continue
+            vals = v if isinstance(v, (tuple, list)) else (v,)
+            if kw.arg == "donate_argnames":
+                names |= {str(x) for x in vals}
+            else:
+                names |= {params[int(x)] for x in vals
+                          if 0 <= int(x) < len(params)}
+    return names
+
+
+def _check_donation(fn, traced: Set[str], rel: str,
+                    out: List[Violation]) -> None:
+    """J009: a traced parameter rewritten wholesale inside a jitted
+    body (dynamic_update_slice / .at[..].set) must be donated."""
+    if (rel, fn.name) in DONATE_ALLOWLIST:
+        return
+    rewritable = traced - _donated_params(fn)
+    if not rewritable:
+        return
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        hit = None
+        dn = _dotted(sub.func).rsplit(".", 1)[-1]
+        if dn == "dynamic_update_slice" and sub.args and \
+                isinstance(sub.args[0], ast.Name) and \
+                sub.args[0].id in rewritable:
+            hit = sub.args[0].id
+        elif isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in ("set", "add"):
+            v = sub.func.value
+            if isinstance(v, ast.Subscript) and \
+                    isinstance(v.value, ast.Attribute) and \
+                    v.value.attr == "at" and \
+                    isinstance(v.value.value, ast.Name) and \
+                    v.value.value.id in rewritable:
+                hit = v.value.value.id
+        if hit is not None:
+            out.append((sub.lineno, "J009",
+                        f"jitted {fn.name} rewrites parameter "
+                        f"{hit!r} in place without donating it "
+                        f"(donate_argnums): input and output carry "
+                        f"the buffer twice — donate, or allowlist "
+                        f"the site in DONATE_ALLOWLIST if the "
+                        f"caller reuses the operand"))
+
+
 def _check_jit_body(fn, traced: Set[str], out: List[Violation]) -> None:
     for sub in ast.walk(fn):
         if isinstance(sub, ast.Call):
@@ -218,6 +303,7 @@ def lint_source(src: str, rel: str) -> List[Violation]:
         return [(exc.lineno or 0, "J000", f"syntax error: {exc.msg}")]
     out: List[Violation] = []
     in_kernels = any(rel.startswith(d + "/") for d in KERNEL_DIRS)
+    in_donate = any(rel.startswith(d + "/") for d in DONATE_DIRS)
 
     # names passed by reference into a jit(..)/shard_map(..) call are
     # traced bodies too (the `f = shard_map(body, mesh=...)` idiom)
@@ -251,6 +337,12 @@ def lint_source(src: str, rel: str) -> List[Violation]:
                 traced = {a for i, a in enumerate(params)
                           if i not in spos and a not in snames}
                 _check_jit_body(node, traced, out)
+                if in_donate:
+                    # J009 reads the donation off the decorator, so it
+                    # applies to decorated sites only (a body passed by
+                    # name into jit(..) carries its donation at the
+                    # call site, out of this function's view)
+                    _check_donation(node, traced, rel, out)
             elif node.name in wrapped:
                 _check_jit_body(node, set(params), out)
         # J002: tracer isinstance outside utils.is_concrete

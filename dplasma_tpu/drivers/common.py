@@ -90,6 +90,7 @@ class IParam:
     dot: Optional[str] = None
     dagcheck: bool = False           # static dataflow verification
     spmdcheck: bool = False          # SPMD collective-schedule check
+    hlocheck: bool = False           # compiled-HLO artifact audit
     # observability outputs (--profile/--report/--jaxtrace)
     profile: Optional[str] = None    # DTPUPROF1 binary trace
     report: Optional[str] = None     # versioned JSON run-report
@@ -162,6 +163,18 @@ Optional arguments:
                      summary lands in the run-report (v6). The cyclic
                      kernels' exact collective-count contract is
                      additionally enforced by tools/lint_all.py
+ --hlocheck        : audit the COMPILED executable before the timed
+                     loop (the post-GSPMD HLO that actually runs):
+                     per-kind collective counts reconciled exactly
+                     against the traced schedule (a GSPMD-inserted
+                     hidden collective is named), float demotions
+                     below the working precision outside the
+                     registered dd/limb sites, requested buffer
+                     donations that produced no input-output alias,
+                     peak memory vs MCA hlocheck.hbm_budget, and
+                     host-callback / copy-volume anti-patterns;
+                     violations abort the run and the summary lands
+                     in the run-report (v10)
  --profile[=file]  : write the binary DTPUPROF1 run trace (convert with
                      tools/tracecat.py; default file: run.prof)
  --report[=file]   : write the versioned JSON run-report (timings,
@@ -232,6 +245,7 @@ _LONG = {
     "abft": ("abft", None), "inject": ("inject", str),
     "dagcheck": ("dagcheck", None),
     "spmdcheck": ("spmdcheck", None),
+    "hlocheck": ("hlocheck", None),
     "phase-profile": ("phase_profile", None),
     "peaks-file": ("peaks_file", str),
     "max-retries": ("max_retries", _int),
@@ -357,6 +371,39 @@ def _algo_of(name: str) -> str:
             return rest[1:]
         return rest
     return base
+
+
+#: driver algo -> priced comm-model class, ONLY where the driver's
+#: mesh path actually contains the priced cyclic kernel (so its
+#: collective floor genuinely bounds the program). OP_CLASS is too
+#: coarse here: it lumps solve-only drivers (potrs, potri, ...),
+#: kernel variants with different schedules (geqrf_hqr, getrf_incpiv,
+#: ...), and the BLAS3 ops (trsm, syrk, ...) into the same roofline
+#: classes — pricing the factorization table against those would
+#: falsely abort correct runs.
+_HLOCHECK_MODEL_ALGOS = {
+    "potrf": "potrf", "posv": "potrf",
+    "getrf_ptgpanel": "getrf",
+    "geqrf": "geqrf", "gels": "geqrf",
+    "gemm": "gemm",
+}
+
+
+def _model_op_kt(algo: str, ip) -> tuple:
+    """(op class, KT) for hlocheck's comm-model leg, or (None, 0).
+
+    The SUMMA gemm kernel prices its collectives per CONTRACTION step
+    (``ceil(K / NB)``); the factorization classes step over
+    ``ceil(min(M,N)/NB)`` panels. Only the ``_HLOCHECK_MODEL_ALGOS``
+    drivers qualify — everything else skips the model leg (the
+    jaxpr-schedule reconciliation still runs)."""
+    cls = _HLOCHECK_MODEL_ALGOS.get(algo)
+    nb = max(ip.NB, 1)
+    if cls == "gemm":
+        return "gemm", max(-(-max(ip.K, 1) // nb), 1)
+    if cls is not None:
+        return cls, max(-(-min(ip.M, ip.N) // nb), 1)
+    return None, 0
 
 
 @contextlib.contextmanager
@@ -572,6 +619,73 @@ class Driver:
             raise sp.SpmdCheckError(res)
         return res
 
+    def _hlocheck(self, lowered, compiled, fn, args, name,
+                  schedule=None):
+        """``--hlocheck``: audit the exact compiled executable the
+        timed loop is about to run (analysis.hlocheck) — per-kind
+        collective counts reconciled against the jaxpr-level schedule
+        of the same program and the analytic comm model (a dropped
+        collective or an under-implemented model class fails), float
+        demotions below the working precision outside the registered
+        dd/limb sites, requested-but-dropped buffer donations, peak
+        memory vs MCA ``hlocheck.hbm_budget``, and host-callback /
+        copy-volume anti-patterns. The summary lands in the
+        run-report (schema v10 ``"hlocheck"`` section); violations
+        raise HloCheckError so a wrong artifact never executes."""
+        from dplasma_tpu.analysis import hlocheck as hc
+        from dplasma_tpu.analysis import spmdcheck as sp
+        from dplasma_tpu.observability.xla import capture_compiled
+        ip = self.ip
+        if schedule is None:
+            # --spmdcheck hands its already-extracted schedule in;
+            # standalone --hlocheck traces the program itself
+            try:
+                schedule = sp.extract_schedule(fn, *args, kernel=name)
+            except Exception as exc:
+                # the artifact checks still run; only the jaxpr-vs-HLO
+                # reconciliation degrades (a fallback-only dtype may
+                # not re-trace the way the compiled path did)
+                sys.stderr.write(
+                    f"#! hlocheck trace failed for {name}: {exc!r}\n")
+        # the comm-model leg applies only where the model's collective
+        # structure is actually on the wire: a cyclic shard_map
+        # program (schedule has collectives) of a modelled op class
+        op, KT = None, 0
+        if schedule is not None and schedule.collectives:
+            op, KT = _model_op_kt(_algo_of(self.name), ip)
+        xla_info = capture_compiled(compiled)
+        # --report captures the same analyses after the timed loop:
+        # remember this pass so an unchanged executable isn't
+        # re-analyzed
+        self._hlo_xla_cache = (compiled, xla_info)
+        # exact-or-dominating: a driver body may wrap the cyclic
+        # kernel in GSPMD-sharded conversions whose collectives the
+        # partitioner owns — the kernel's pinned schedule must be
+        # fully implemented (dominating); the exact == contract is
+        # enforced where the program IS the kernel (tools/lint_all.py
+        # hlocheck-smoke and tests)
+        res = hc.check_executable(
+            lowered, compiled, name, schedule=schedule, exact=False,
+            op=op, KT=KT,
+            lookahead=self.pipeline["sweep.lookahead"],
+            prec=ip.prec, xla_info=xla_info)
+        self.report.add_hlocheck(name, res.summary())
+        lbl = dict(op=name, prec=ip.prec)
+        reg = self.report.metrics
+        reg.counter("hlocheck_collectives_total", **lbl).inc(
+            sum(res.counts.values()))
+        reg.counter("hlocheck_diagnostics_total", **lbl).inc(
+            len(res.diagnostics))
+        if res.hbm_peak_bytes is not None:
+            reg.gauge("hlocheck_hbm_peak_bytes", **lbl).set(
+                res.hbm_peak_bytes)
+        if ip.rank == 0 and (ip.loud >= 2 or not res.ok):
+            print(res.format(name))
+            sys.stdout.flush()
+        if not res.ok:
+            raise hc.HloCheckError(res)
+        return res
+
     def _peaks(self):
         """Resolve the roofline peaks once per driver run
         (``--peaks-file`` — a bench doc/report or raw peaks dict —
@@ -696,6 +810,7 @@ class Driver:
         cur_fn, cur_label = fn, name
         action = guard.ACTION_PRIMARY
         first_compile = True
+        spmd_res = None      # --spmdcheck schedule, reused by hlocheck
         out = None
         warm = None
         times: list = []
@@ -784,7 +899,7 @@ class Driver:
                 if getattr(ip, "spmdcheck", False):
                     # verify the traced SPMD program's collective
                     # schedule before the timed loop ever dispatches
-                    self._spmdcheck(cur_fn, args, name)
+                    spmd_res = self._spmdcheck(cur_fn, args, name)
                 if not want_dag and ip.dot:
                     # no analytic tile-DAG builder for this op: fall
                     # back to the lowered XLA program text
@@ -793,6 +908,20 @@ class Driver:
                         f.write(lowered.as_text())
                 if ip.dot and ip.rank == 0 and ip.loud >= 1:
                     print(f"#+ traced DAG written to {ip.dot}")
+            if getattr(ip, "hlocheck", False) and \
+                    getattr(self, "_hlo_audited", None) is not compiled:
+                # audit the COMPILED artifact (post-GSPMD HLO) before
+                # the timed loop ever dispatches — EVERY executable
+                # that will run, including remediation-ladder fallback
+                # artifacts recompiled after a runtime failure (the
+                # contract is "a wrong artifact never executes", not
+                # "the first artifact"). The first pass reuses
+                # --spmdcheck's schedule; a fallback rung's program
+                # differs, so its schedule is re-traced fresh.
+                self._hlocheck(lowered, compiled, cur_fn, args,
+                               cur_label, schedule=spmd_res)
+                self._hlo_audited = compiled
+                spmd_res = None
             if getattr(ip, "warmup", True):
                 # rank-local warm run EXCLUDED from stats (the
                 # reference drivers' warmup pattern, ref
@@ -858,7 +987,14 @@ class Driver:
                 guard.kernel_fallback()
         if resil:
             self._finish_resilience(ladder, injection)
-        xla_info = capture_compiled(compiled) if ip.report else None
+        xla_info = None
+        if ip.report:
+            # reuse the --hlocheck pass's capture when the surviving
+            # executable IS the audited one (a remediation rung that
+            # re-traced gets a fresh capture)
+            cached = getattr(self, "_hlo_xla_cache", None)
+            xla_info = cached[1] if cached and cached[0] is compiled \
+                else capture_compiled(compiled)
         best = min(times)
         t0 = time.perf_counter()
         dest = time.perf_counter() - t0

@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 9``).
+"""The versioned JSON run-report (``"schema": 10``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -62,6 +62,15 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                             "compile_s"},
                   "remediated", "failed", "retries",
                   "escalations", ...}],                    # (v8)
+     "hlocheck": [{"op", "ok", "kernel", "counts": {kind: n},
+                   "expected",
+                   "relation",  # ==|>=|mismatch|gspmd|
+                                # unreconciled|no-collectives
+                   "donated", "aliased",
+                   "hbm_peak_bytes", "hbm_budget", "copy_bytes",
+                   "total_bytes",
+                   "diagnostics": [{"kind", "message", "kernel",
+                                    "op", "detail"}]}],   # (v10)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
@@ -82,9 +91,13 @@ latency, executable-cache economics, per-request remediation
 outcomes, dplasma_tpu.serving + tools/servebench.py); 9 adds the
 ``panel.*`` keys to ``"pipeline"`` (the panel-factorization engine's
 raw knob + per-route resolution, kernels.panels — what perfdiff's
-same-family baselining keys on). All
+same-family baselining keys on); 10 adds ``"hlocheck"`` (--hlocheck
+compiled-artifact verification of the post-GSPMD HLO — collective
+reconciliation, precision/donation/HBM/anti-pattern audits,
+analysis.hlocheck — whose ``hbm_peak_bytes`` perfdiff gates
+lower-better). All
 additive — v1 readers of the other keys are unaffected; this reader
-accepts <= 9 (:func:`load_report` tolerates every v1-v9 vintage,
+accepts <= 10 (:func:`load_report` tolerates every v1-v10 vintage,
 filling the always-present keys).
 """
 from __future__ import annotations
@@ -97,7 +110,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 9
+REPORT_SCHEMA = 10
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -132,6 +145,7 @@ class RunReport:
         self.spmdcheck: List[dict] = []  # --spmdcheck verification (v6)
         self.refine: List[dict] = []    # IR-solver records (v7)
         self.serving: List[dict] = []   # serving-layer records (v8)
+        self.hlocheck: List[dict] = []  # --hlocheck audits (v10)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
         self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
@@ -193,6 +207,13 @@ class RunReport:
         self.serving.append(summary)
         return summary
 
+    def add_hlocheck(self, op: str, summary: dict) -> dict:
+        """Record one --hlocheck compiled-artifact audit (schema v10;
+        see analysis.hlocheck.HloResult.summary)."""
+        entry = {"op": op, **summary}
+        self.hlocheck.append(entry)
+        return entry
+
     def add_roofline(self, entry: dict) -> dict:
         """Record one per-op roofline ledger entry (schema v5; see
         observability.roofline.op_roofline)."""
@@ -228,6 +249,8 @@ class RunReport:
             doc["refine"] = self.refine
         if self.serving:
             doc["serving"] = self.serving
+        if self.hlocheck:
+            doc["hlocheck"] = self.hlocheck
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
         if self.roofline:
@@ -262,7 +285,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v8) loads: the schema history is purely
+    Every older vintage (v1-v9) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

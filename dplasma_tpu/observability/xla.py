@@ -6,8 +6,11 @@ compiled executable's own accounting — HLO flop/byte counts and the
 buffer-assignment memory breakdown. Both are best-effort across
 backends/versions (PJRT may return None, a list, or a dict), so every
 field here is guarded and reported as an explicit ``None`` rather than
-omitted: a null in the run-report means "backend declined to answer",
-never "forgot to ask".
+omitted: a null in the run-report means "backend declined to answer"
+(returned None), never "forgot to ask" — and a backend that RAISES
+instead records a structured ``{"error": <reason>}`` in the report's
+``"xla"`` section, so a broken analysis path is distinguishable from
+a merely silent one.
 """
 from __future__ import annotations
 
@@ -32,8 +35,8 @@ _MEM_ATTRS = (
 def _cost_dict(compiled) -> Optional[dict]:
     try:
         ca = compiled.cost_analysis()
-    except Exception:
-        return None
+    except Exception as exc:              # raising backend: keep why
+        return {"error": repr(exc)}
     if ca is None:
         return None
     if isinstance(ca, (list, tuple)):     # older jax: one dict per device
@@ -53,7 +56,11 @@ def capture_compiled(compiled) -> dict:
            "optimal_seconds": None, "cost": None, "memory": None,
            "peak_bytes": None}
     cost = _cost_dict(compiled)
-    if cost:
+    if cost and "error" in cost:
+        # the structured failure record: a raising cost_analysis is
+        # reported as {"error": reason}, never a silent null
+        out["cost"] = cost
+    elif cost:
         # keep only scalar entries (per-operand "bytes accessed0{}"
         # subkeys stay in the full dict)
         out["cost"] = {k: v for k, v in cost.items()
@@ -63,8 +70,9 @@ def capture_compiled(compiled) -> dict:
                 out[rk] = float(cost[xk])
     try:
         ma = compiled.memory_analysis()
-    except Exception:
+    except Exception as exc:              # raising backend: keep why
         ma = None
+        out["memory"] = {"error": repr(exc)}
     if ma is not None:
         mem = {}
         for attr in _MEM_ATTRS:

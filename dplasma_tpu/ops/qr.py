@@ -408,10 +408,12 @@ def gels(A: TileMatrix, B: TileMatrix) -> TileMatrix:
 
 # -- out-of-HBM tier ---------------------------------------------------
 
-@partial(jax.jit, static_argnums=(3,))
+@partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
 def _lowmem_qr_apply(col, V, T, s0: int):
     """Apply one streamed finished panel's compact-WY reflectors
-    (rows s0 and below) to the device-resident column block."""
+    (rows s0 and below) to the device-resident column block. ``col``
+    is donated: the caller rebinds it every apply, and the lowmem
+    tier exists precisely to not carry a second N x nb buffer."""
     tail = col[s0:]
     tail = hh.apply_q(V, T, tail, trans="C")
     return col.at[s0:].set(tail)

@@ -25,6 +25,12 @@ bound (MCA ``serving.cache_capacity``) evicts cold executables;
 hit/miss/eviction counts and cumulative compile seconds land in the
 metrics registry (``serving_cache_*``).
 
+Every admitted executable is audited by the compiled-artifact checker
+(:mod:`dplasma_tpu.analysis.hlocheck`: dropped donations, precision
+demotions, HBM budget, host-callback anti-patterns — MCA
+``hlocheck.serving``); the summary rides the :class:`Entry` and
+``serving_hlocheck_*`` metrics, never fatal.
+
 Fault-injection interplay: corruption taps fire at TRACE time
 (:mod:`dplasma_tpu.resilience.inject`), so an executable compiled
 while a fault plan is armed is *poisoned for its lifetime* — the
@@ -36,6 +42,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import sys
 import threading
 import time
 from typing import Callable, NamedTuple, Optional, Tuple
@@ -171,6 +178,9 @@ class Entry:
     compile_s: float
     tainted: bool      # compiled while a fault plan was armed & firing
     hits: int = 0
+    #: compiled-artifact audit of the admitted executable
+    #: (analysis.hlocheck summary; None when the audit is off/failed)
+    hlocheck: Optional[dict] = None
 
 
 class ExecutableCache:
@@ -224,13 +234,16 @@ class ExecutableCache:
             self.metrics.counter("serving_cache_misses_total").inc()
             faults0 = len(inject.faults())
             t0 = time.perf_counter()
-            compiled = jax.jit(build()).lower(*args).compile()
+            lowered = jax.jit(build()).lower(*args)
+            compiled = lowered.compile()
             dt = time.perf_counter() - t0
             tainted = len(inject.faults()) > faults0
             self.metrics.counter(
                 "serving_cache_compile_seconds").inc(dt)
             entry = Entry(fn=compiled, key=key, compile_s=dt,
-                          tainted=tainted)
+                          tainted=tainted,
+                          hlocheck=self._audit(lowered, compiled,
+                                               key))
             self._d[key] = entry
             while len(self._d) > self.capacity:
                 self._d.popitem(last=False)
@@ -239,6 +252,38 @@ class ExecutableCache:
             self.metrics.gauge("serving_cache_entries").set(
                 len(self._d))
             return entry
+
+    def _audit(self, lowered, compiled, key: CacheKey
+               ) -> Optional[dict]:
+        """Compiled-artifact audit (analysis.hlocheck) of a freshly
+        admitted executable: dropped donations, precision demotions,
+        the HBM budget, host-callback anti-patterns. Serving is a
+        long-lived process — an executable that carries its batch
+        twice or blocks on the host serves every future request worse,
+        so the audit runs at the one moment the artifact is new. Never
+        fatal: diagnostics land on the entry, in
+        ``serving_hlocheck_*`` metrics, and on stderr (MCA
+        ``hlocheck.serving`` = off disables)."""
+        from dplasma_tpu.analysis import hlocheck as hc
+        if _cfg.mca_get("hlocheck.serving", "on") == "off":
+            return None
+        prec = {"float32": "s", "float64": "d", "complex64": "c",
+                "complex128": "z"}.get(key.dtype, "s")
+        try:
+            res = hc.check_executable(lowered, compiled,
+                                      f"serving:{key.op}", prec=prec)
+        except Exception as exc:
+            # the audit must never take down a compile that succeeded
+            sys.stderr.write(f"#! serving hlocheck audit failed for "
+                             f"{key.op}: {exc!r}\n")
+            return None
+        self.metrics.counter("serving_hlocheck_audits_total").inc()
+        if not res.ok:
+            self.metrics.counter(
+                "serving_hlocheck_diagnostics_total").inc(
+                len(res.diagnostics))
+            sys.stderr.write(res.format(f"serving:{key.op}") + "\n")
+        return res.summary()
 
     def invalidate(self, key: CacheKey) -> bool:
         """Drop one entry (a poisoned executable after a detected

@@ -188,6 +188,87 @@ def test_j008_hardcoded_axis_name():
         """), "dplasma_tpu/parallel/mesh.py") == []
 
 
+def test_j009_missing_donation():
+    """A jitted hot-path function that rewrites a traced parameter in
+    place must donate it; donation, static operands, and the
+    allowlist all clear the finding."""
+    assert _codes("""\
+        import jax
+        @jax.jit
+        def f(w, x):
+            return jax.lax.dynamic_update_slice(w, x, (0, 0))
+    """) == ["J009"]
+    assert _codes("""\
+        import jax
+        @jax.jit
+        def f(w, x):
+            return w.at[0].set(x)
+    """) == ["J009"]
+    # donating the rewritten operand clears it (donate_argnums)
+    assert _codes("""\
+        import jax
+        from functools import partial
+        @partial(jax.jit, donate_argnums=(0,))
+        def f(w, x):
+            return jax.lax.dynamic_update_slice(w, x, (0, 0))
+    """) == []
+    # ... or donate_argnames
+    assert _codes("""\
+        import jax
+        from functools import partial
+        @partial(jax.jit, donate_argnames=('w',))
+        def f(w, x):
+            return w.at[0].set(x)
+    """) == []
+    # rewriting a LOCAL (not a parameter) is not a donation site
+    assert _codes("""\
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            w = jnp.zeros((4, 4))
+            return w.at[0].set(x)
+    """) == []
+    # outside kernels/ops/serving the rule does not police
+    assert jaxlint.lint_source(textwrap.dedent("""\
+        import jax
+        @jax.jit
+        def f(w, x):
+            return w.at[0].set(x)
+    """), "dplasma_tpu/utils/helpers.py") == []
+    # the allowlist clears a choke point whose caller reuses the
+    # operand after the call
+    src = textwrap.dedent("""\
+        import jax
+        @jax.jit
+        def keeps_operand(w, x):
+            return jax.lax.dynamic_update_slice(w, x, (0, 0))
+    """)
+    rel = "dplasma_tpu/ops/x.py"
+    assert [c for _, c, _ in jaxlint.lint_source(src, rel)] == ["J009"]
+    jaxlint.DONATE_ALLOWLIST.add((rel, "keeps_operand"))
+    try:
+        assert jaxlint.lint_source(src, rel) == []
+    finally:
+        jaxlint.DONATE_ALLOWLIST.discard((rel, "keeps_operand"))
+
+
+def test_j009_donated_package_sites_still_clean():
+    """The real donation sites (dd limb-cache writes, the lowmem QR
+    apply) pass J009 because they donate — the rule would fire on
+    them if the donation were dropped."""
+    for rel in ("dplasma_tpu/kernels/dd.py", "dplasma_tpu/ops/qr.py"):
+        src = (REPO / rel).read_text()
+        bad = [v for v in jaxlint.lint_source(src, rel)
+               if v[1] == "J009"]
+        assert bad == []
+        stripped = src.replace(", donate_argnums=(0,)", "")
+        assert stripped != src, f"{rel}: expected a donation site"
+        bad = [v for v in jaxlint.lint_source(stripped, rel)
+               if v[1] == "J009"]
+        assert bad, f"{rel}: J009 must fire when donation is removed"
+
+
 def test_suppression_comment():
     assert _codes("""\
         import jax

@@ -6,7 +6,9 @@
   analysis.jaxlint trace-safety rules + the perfdiff smoke + the
   analysis.palcheck pallas-contract gate + a dagcheck smoke pass over
   tiny DAGs of all four ops + the analysis.spmdcheck collective-
-  schedule smoke over the cyclic kernels) must exit 0 on the repo.
+  schedule smoke over the cyclic kernels + the analysis.hlocheck
+  compiled-artifact smoke over the cyclic kernels' post-GSPMD HLO
+  and one serving executable) must exit 0 on the repo.
 """
 import pathlib
 import sys
@@ -72,13 +74,13 @@ def test_lint_cli_exit_codes(tmp_path):
 def test_lint_all_aggregate_is_clean(capsys):
     """tools/lint_all.py gates every rule with one exit code: excepts,
     jaxlint, the perfdiff smoke, the pallas contract gate, and the
-    dagcheck/spmdcheck/serving smoke passes must all be clean on the
-    repo."""
+    dagcheck/spmdcheck/serving/hlocheck smoke passes must all be
+    clean on the repo."""
     import lint_all
     rc = lint_all.main([])
     out = capsys.readouterr()
     assert rc == 0, out.err
     for gate in ("lint_excepts", "jaxlint", "perfdiff-smoke",
                  "palcheck", "dagcheck-smoke", "spmdcheck-smoke",
-                 "serving-smoke"):
+                 "serving-smoke", "hlocheck-smoke"):
         assert f"# {gate}: OK" in out.out

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 9
+    assert doc["schema"] == REPORT_SCHEMA == 10
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -139,6 +139,18 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
             "pipeline": {"sweep.lookahead": 1, "qr.agg_depth": 4,
                          "panel.kernel": "auto", "panel.qr": "tree",
                          "panel.lu": "rec"}},
+        10: {"schema": 10, "name": "v10", "ops": [], "metrics": [],
+             "hlocheck": [{"op": "testing_dpotrf", "ok": True,
+                           "kernel": "testing_dpotrf",
+                           "counts": {"all-reduce": 8,
+                                      "all-gather": 4},
+                           "expected": {"all-reduce": 8,
+                                        "all-gather": 4},
+                           "relation": "==", "donated": 0,
+                           "aliased": 0, "hbm_peak_bytes": 2704,
+                           "hbm_budget": 0, "copy_bytes": 3584,
+                           "total_bytes": 68940,
+                           "diagnostics": []}]},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -217,7 +229,12 @@ def test_capture_compiled_never_raises():
         def memory_analysis(self):
             return None
     info = capture_compiled(Broken())
-    assert info["flops"] is None and info["cost"] is None
+    # a RAISING analysis records the structured reason (a declining
+    # backend that returns None stays an explicit null — see
+    # tests/test_hlocheck.py for the full round-trip)
+    assert info["flops"] is None
+    assert info["cost"] == {"error": repr(RuntimeError(
+        "no analysis on this backend"))}
     assert info["memory"] is None and info["peak_bytes"] is None
 
 
@@ -389,7 +406,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 9
+    assert doc["schema"] == 10
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

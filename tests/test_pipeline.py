@@ -271,7 +271,7 @@ def test_report_pipeline_section_schema_v6(tmp_path, capsys):
     assert rc == 0
     assert "#+ pipeline: sweep.lookahead=" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 9
+    assert doc["schema"] == 10
     assert set(doc["pipeline"]) == {"sweep.lookahead", "qr.agg_depth",
                                     "panel.kernel", "panel.qr",
                                     "panel.lu"}

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Seven passes, in increasing cost order:
+Eight passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
@@ -28,7 +28,14 @@ Seven passes, in increasing cost order:
    simulator;
 7. a ``dplasma_tpu.serving`` smoke pass — tiny batched posv/gesv
    round-trips within the backward-error gate, cache-key determinism,
-   and padded-vs-exact solution equivalence on CPU.
+   and padded-vs-exact solution equivalence on CPU;
+8. a ``dplasma_tpu.analysis.hlocheck`` smoke pass — the COMPILED
+   post-GSPMD HLO of the cyclic potrf/getrf/geqrf/gemm kernels on
+   the 2x2 CPU mesh must audit clean with the per-kind collective
+   counts EXACTLY matching the jaxpr-level schedule (a
+   GSPMD-inserted hidden collective fails here before it ever ships
+   to hardware), and one serving batched executable must audit clean
+   (donation/precision/anti-patterns).
 
 Usage: ``python tools/lint_all.py`` — prints ``file:line: message``
 per violation / one line per failed smoke case, exits nonzero on any.
@@ -338,6 +345,89 @@ def run_serving_smoke() -> int:
     return bad
 
 
+def run_hlocheck_smoke() -> int:
+    """The compiled-artifact gate: the cyclic kernels' post-GSPMD HLO
+    on the 2x2 CPU mesh must carry EXACTLY the collective schedule
+    the jaxpr traced (GSPMD neither inserted nor dropped), pass the
+    precision/donation/HBM/anti-pattern audits, and one serving
+    batched executable must audit clean. Compiles are tiny and ride
+    the persistent compilation cache."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from dplasma_tpu.analysis import hlocheck as hc
+    from dplasma_tpu.analysis import spmdcheck as sp
+    from dplasma_tpu.descriptors import Dist
+    from dplasma_tpu.parallel import cyclic
+    from dplasma_tpu.parallel import mesh as pmesh
+
+    if not jax.config.jax_compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(_ROOT / ".jax_cache"))
+    nb, nt = 4, 4
+    bad = 0
+    P, Q = 2, 2
+    if P * Q > len(jax.devices()):
+        print(f"# hlocheck-smoke: {P}x{Q} skipped "
+              f"({len(jax.devices())} device(s) available)")
+        return 0
+    m = pmesh.make_mesh(P, Q)
+    d = Dist(P=P, Q=Q)
+    desc = cyclic.CyclicDesc(nt * nb, nt * nb, nb, nb, d)
+    data = jnp.zeros((P, Q, desc.MTL * nb, desc.NTL * nb),
+                     jnp.float32)
+    KT = min(desc.MT, desc.NT)
+    la = 1
+    cases = [
+        ("potrf", partial(cyclic._potrf_cyclic_jit, desc=desc,
+                          mesh=m, lookahead=la), (data,), KT, la),
+        ("getrf", partial(cyclic._getrf_cyclic_jit, desc=desc,
+                          mesh=m, lookahead=la), (data,), KT, la),
+        ("geqrf", partial(cyclic._geqrf_cyclic_jit, desc=desc,
+                          mesh=m, lookahead=la), (data,), KT, la),
+        ("gemm", partial(cyclic._gemm_cyclic_jit, adesc=desc,
+                         bdesc=desc, mesh=m), (data, data),
+         desc.NT, 0),
+    ]
+    for op, fn, args, kt, la_ in cases:
+        lowered = jax.jit(fn).lower(*args)
+        compiled = lowered.compile()
+        schedule = sp.extract_schedule(fn, *args, kernel=op)
+        res = hc.check_executable(lowered, compiled,
+                                  f"{op}_{P}x{Q}",
+                                  schedule=schedule, exact=True,
+                                  op=op, KT=kt, lookahead=la_,
+                                  prec="s")
+        if not res.ok or res.relation != "==":
+            sys.stderr.write(res.format(f"{op} {P}x{Q}") + "\n")
+            bad += max(len(res.diagnostics), 1)
+    # one serving batched executable: the long-lived cache must only
+    # admit artifacts that audit clean
+    import numpy as np
+
+    from dplasma_tpu.serving import batched
+
+    rng = np.random.default_rng(3872)
+    n, nrhs = 6, 2
+    g = rng.standard_normal((2, n, n)).astype(np.float32)
+    spd = g @ g.transpose(0, 2, 1) + n * np.eye(n, dtype=np.float32)
+    b = rng.standard_normal((2, n, nrhs)).astype(np.float32)
+
+    def _posv(a, bb):
+        x, _ = batched.solve_batched("posv", a, bb, 4)
+        return x
+    lowered = jax.jit(_posv).lower(jnp.asarray(spd), jnp.asarray(b))
+    compiled = lowered.compile()
+    res = hc.check_executable(lowered, compiled, "serving:posv",
+                              prec="s")
+    if not res.ok:
+        sys.stderr.write(res.format("serving:posv") + "\n")
+        bad += len(res.diagnostics)
+    return bad
+
+
 def main(argv=None) -> int:
     pkg = _ROOT / "dplasma_tpu"
     bad = 0
@@ -347,7 +437,8 @@ def main(argv=None) -> int:
                      ("palcheck", run_palcheck),
                      ("dagcheck-smoke", run_dagcheck_smoke),
                      ("spmdcheck-smoke", run_spmdcheck_smoke),
-                     ("serving-smoke", run_serving_smoke)):
+                     ("serving-smoke", run_serving_smoke),
+                     ("hlocheck-smoke", run_hlocheck_smoke)):
         n = fn()
         print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")
         bad += n

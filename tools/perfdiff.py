@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v9), the bench
+(``--report`` from any driver, any schema vintage v1-v10), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -20,7 +20,12 @@ Comparable metrics extracted from each document:
   better) from a run-report's ``ops`` section;
 * bench ladder entries (``<metric>`` GFlop/s values, higher is
   better unless the entry declares ``"better": "lower"`` — e.g. the
-  IR solvers' iteration counts) from ``entries``/``ladder``.
+  IR solvers' iteration counts) from ``entries``/``ladder``;
+* compiled-artifact peak memory
+  (``<label>.hlocheck.hbm_peak_bytes``, lower is better) from a
+  run-report's ``hlocheck`` section (schema v10) — HBM regressions
+  gate like time regressions (``--metric-threshold
+  hbm_peak_bytes=FRAC`` for a custom bound).
 
 Exit codes: 0 = no regression, 1 = regression past threshold,
 2 = unusable input (unreadable doc, or a candidate with no
@@ -136,6 +141,17 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
         if isinstance(g, (int, float)) and g > 0:
             out[f"{lbl}.gflops"] = {"value": float(g),
                                     "better": "higher"}
+    for e in doc.get("hlocheck") or []:
+        # compiled-artifact peak memory (schema v10): lower is
+        # better — a grown peak is an HBM regression exactly like a
+        # grown median is a time regression
+        if not isinstance(e, dict):
+            continue
+        lbl = e.get("op") or e.get("kernel")
+        v = e.get("hbm_peak_bytes")
+        if lbl and isinstance(v, (int, float)) and v > 0:
+            out[f"{lbl}.hlocheck.hbm_peak_bytes"] = {
+                "value": float(v), "better": "lower"}
     for e in (doc.get("entries") or []) + (doc.get("ladder") or []):
         if isinstance(e, dict) and isinstance(e.get("metric"), str) \
                 and isinstance(e.get("value"), (int, float)):
