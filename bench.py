@@ -338,17 +338,14 @@ def main(argv=None) -> int:
     report.extra["budget_s"] = budget_s
     # active pipeline shape of the factorization sweeps (schema v4):
     # the ladder's getrf/geqrf/potrf entries run with THIS config.
-    # The per-route panel-engine resolution rides along so
-    # bench_history.jsonl entries stay comparable across panel
-    # strategies (perfdiff same-family baselining; a chain-vs-tree
-    # pair is visible in the ledger, not silent).
-    from dplasma_tpu.kernels import panels as _panels
-    from dplasma_tpu.ops._sweep import sweep_params
-    la, agg = sweep_params()
-    pipeline = {"sweep.lookahead": la, "qr.agg_depth": agg,
-                "panel.kernel": _panels.panel_kernel_config(),
-                "panel.qr": _panels.panel_kernel("qr"),
-                "panel.lu": _panels.panel_kernel("lu")}
+    # Since v11 this is the FULL resolved knob vector (sweep.lookahead,
+    # qr/lu.agg_depth, every panel.* knob, grid; the per-entry tile
+    # size rides each ladder entry's "nb" field) so historical ledger
+    # entries are usable autotuner evidence and perfdiff's same-knob-
+    # vector baselining compares like against like (a chain-vs-tree or
+    # lookahead flip is visible in the ledger, not silent).
+    from dplasma_tpu.tuning import resolved_knobs
+    pipeline = resolved_knobs(grid=(1, 1))
     report.pipeline = pipeline
 
     def remaining():
@@ -423,6 +420,11 @@ def main(argv=None) -> int:
                     entry = {"metric": f"{name}_gflops_n{kw['N']}",
                              "value": round(g, 2), "unit": "GFlop/s",
                              "vs_baseline": round((g / bound) / 0.70, 4)}
+                    if "nb" in kw:
+                        # the per-entry tile size completes the knob
+                        # vector (doc-level "pipeline" carries the
+                        # MCA knobs; nb varies per ladder entry)
+                        entry["nb"] = kw["nb"]
                     ladder.append(entry)
                     report.metrics.gauge(
                         "bench_gflops", metric=entry["metric"]).set(g)

@@ -31,8 +31,6 @@ PRECISIONS = {"s": "float32", "d": "float64", "c": "complex64",
 
 SCHEDULERS = ("LFQ", "LTQ", "AP", "LHQ", "GD", "PBQ", "IP", "RND")
 
-_UNSET = object()   # sentinel for the scoped --lookahead MCA override
-
 # Implicit DAG-analytics cap (--report / -v>=3): the analytic tile-DAG
 # builders materialize O(tiles^1.5) tasks in Python, so past this many
 # tiles the run-report carries an explicit null instead (an explicit
@@ -80,6 +78,11 @@ class IParam:
     alpha: float = -1.0
     # pipelined-sweep lookahead (--lookahead; -1 = MCA sweep.lookahead)
     lookahead: int = -1
+    # tuning-DB consultation (--autotune; dplasma_tpu.tuning)
+    autotune: bool = False
+    # did the CLI pin the tile shape (-t/-T)? --autotune may only
+    # apply a DB tile size when it did not (CLI > DB precedence)
+    nb_explicit: bool = False
     # butterfly (-y)
     butterfly_level: int = 0
     # accepted-for-compat knobs (scheduling/threads are XLA's job on TPU)
@@ -141,6 +144,18 @@ Optional arguments:
                      serialized baseline; default: MCA sweep.lookahead,
                      1). QR far-update aggregation rides MCA
                      qr.agg_depth.
+ --autotune        : resolve knobs (tile size, sweep.lookahead,
+                     qr/lu.agg_depth, panel.*) from the persistent
+                     tuning database (MCA tune.db / env
+                     DPLASMA_TUNE_DB; populated by tools/autotune.py)
+                     for this run's (op, N, dtype, grid) key —
+                     nearest-key interpolation for unmeasured shapes.
+                     Precedence: explicit CLI flags (-t/-T,
+                     --lookahead, --mca-style env) beat the DB; the
+                     DB beats the registered defaults. The
+                     consultation (source: db/interpolated/default)
+                     lands in the run-report (v11 "tuning" section)
+                     and the scoped overrides restore at close
  --seed --mtx      : generator seed / matrix kind
  -y --butlvl       : butterfly level
  --nruns           : number of timed runs
@@ -217,6 +232,15 @@ def _int(v: str) -> int:
     return int(v, 0)
 
 
+def default_tile(n: int) -> int:
+    """The defaults-cascade tile size for an ``n``-sized problem —
+    ONE formula, shared with the autotuner's mandatory default-first
+    candidate (:func:`dplasma_tpu.tuning.search.default_nb`), so the
+    tuner's out-of-the-box baseline is exactly what an un-pinned
+    driver runs."""
+    return min(max(n, 1), 192 if n >= 1024 else 64)
+
+
 # option name -> (iparam field, converter or None-for-flag)
 _LONG = {
     "grid-rows": ("P", _int), "grid-cols": ("Q", _int),
@@ -235,6 +259,7 @@ _LONG = {
     "domino": ("qr_domino", _int), "tsrr": ("qr_tsrr", _int),
     "criteria": ("criteria", _int), "alpha": ("alpha", float),
     "lookahead": ("lookahead", _int),
+    "autotune": ("autotune", None),
     "seed": ("seed", _int), "mtx": ("mtx", _int),
     "butlvl": ("butterfly_level", _int),
     "nruns": ("nruns", _int),
@@ -341,11 +366,15 @@ def _parse_arguments(args: list[str], ip: IParam) -> IParam:
         i += 1
     if positional and ip.N == 0:
         ip.N = _int(positional[0])
-    # defaults cascade (iparam_default_* in tests/common.c:586-638)
+    # defaults cascade (iparam_default_* in tests/common.c:586-638).
+    # Whether the CLI pinned the tile shape is remembered BEFORE the
+    # cascade fills it: --autotune may only apply a DB tile size over
+    # the cascade's default, never over an explicit -t/-T.
+    ip.nb_explicit = ip.MB != 0 or ip.NB != 0
     if ip.M == 0:
         ip.M = ip.N
     if ip.MB == 0:
-        ip.MB = min(max(ip.N, 1), 192 if ip.N >= 1024 else 64)
+        ip.MB = default_tile(ip.N)
     if ip.NB == 0:
         ip.NB = ip.MB
     if ip.HNB == 0:
@@ -444,20 +473,19 @@ class Driver:
         self.ip = ip
         self.name = name
         self.mesh = None
-        # resolve the pipeline shape WITHOUT touching global state yet
-        # (the MCA override is applied at the very end of __init__,
-        # after everything that can raise — a failed construction must
-        # not leak the process-global knob)
         wants_la = getattr(ip, "lookahead", -1) >= 0
-        la, agg = sweep_params(
-            lookahead=ip.lookahead if wants_la else None)
-        from dplasma_tpu.kernels import panels as _panels
-        self.pipeline = {"sweep.lookahead": la, "qr.agg_depth": agg,
-                         "panel.kernel": _panels.panel_kernel_config(),
-                         "panel.qr": _panels.panel_kernel("qr"),
-                         "panel.lu": _panels.panel_kernel("lu")}
-        self._mca_prev_la = _UNSET
-        self._la_override_active = False
+        # --autotune: consult the persistent tuning DB for this run's
+        # (op, N, dtype, grid) key BEFORE any global state mutates —
+        # a pure read that may rewrite the UN-pinned tile shape
+        # (precedence: CLI flag > DPLASMA_MCA_* env > DB > default)
+        self.tuning = None
+        tune_applied: dict = {}
+        if getattr(ip, "autotune", False):
+            self.tuning, tune_applied = self._autotune_consult(wants_la)
+        # scoped MCA override frames (utils.config override stack);
+        # popped in LIFO order at close() so back-to-back Drivers in
+        # one process never leak a knob
+        self._mca_frames: list = []
         # resilience bookkeeping: which fn produced the last progress()
         # output (primary name or a ladder fallback label), and how many
         # -x verifications failed (run_driver turns that into exit 1)
@@ -471,7 +499,6 @@ class Driver:
         self.prof.save_info("driver", name)
         self.prof.save_info("prec", getattr(ip, "prec", "d"))
         self.report = RunReport(name, ip)
-        self.report.pipeline = dict(self.pipeline)   # schema v4
         try:
             # cache now: the lookup can fail after a backend error
             self._cpu = jax.devices("cpu")[0]
@@ -488,23 +515,117 @@ class Driver:
         self._cm = pmesh.use_grid(self.mesh) if self.mesh else None
         if self._cm:
             self._cm.__enter__()
-        if wants_la:
-            # --lookahead: scoped MCA override (restored at close() so
-            # back-to-back Drivers in one process never leak the knob);
-            # applied last — nothing below this line raises
-            self._mca_prev_la = _cfg._MCA_OVERRIDES.get(
-                "sweep.lookahead", _UNSET)
-            _cfg.mca_set("sweep.lookahead", ip.lookahead)
-            self._la_override_active = True
+        # the scoped overrides are applied LAST (everything above is
+        # raise-prone construction that must not leak process-global
+        # knobs) and NEST: --lookahead's frame first, the tuner's
+        # frame innermost — close() pops them in LIFO order
+        try:
+            if wants_la:
+                self._mca_frames.append(_cfg.push_overrides(
+                    {"sweep.lookahead": ip.lookahead},
+                    label="--lookahead"))
+            if tune_applied:
+                self._mca_frames.append(_cfg.push_overrides(
+                    tune_applied, label="--autotune"))
+            # resolve the pipeline shape (the FULL knob vector, schema
+            # v11) from the now-active configuration — the same source
+            # every sweep/panel callback reads
+            la, agg = sweep_params()
+            from dplasma_tpu.kernels import panels as _panels
+            self.pipeline = {
+                "sweep.lookahead": la, "qr.agg_depth": agg,
+                "lu.agg_depth": _cfg.mca_get_int("lu.agg_depth", 4),
+                "panel.kernel": _panels.panel_kernel_config(),
+                "panel.qr": _panels.panel_kernel("qr"),
+                "panel.lu": _panels.panel_kernel("lu"),
+                "panel.tree_leaf": _cfg.mca_get_int(
+                    "panel.tree_leaf", 2),
+                "panel.rec_base": _cfg.mca_get_int(
+                    "panel.rec_base", 8)}
+            if self.tuning is not None:
+                self.pipeline["tuning.source"] = self.tuning["source"]
+                self.report.add_tuning(self.tuning)
+                reg = self.report.metrics
+                reg.counter("tuning_consults_total",
+                            source=self.tuning["source"],
+                            op=self.tuning["op"]).inc()
+                reg.counter("tuning_overrides_total",
+                            op=self.tuning["op"]).inc(
+                    len(tune_applied)
+                    + (1 if self.tuning.get("nb") else 0))
+                if ip.rank == 0 and ip.loud >= 2:
+                    print("#+ tuning: source=%s key=%s nb=%s "
+                          "applied=%s"
+                          % (self.tuning["source"], self.tuning["key"],
+                             self.tuning.get("nb"),
+                             self.tuning.get("applied") or {}))
+            self.report.pipeline = dict(self.pipeline)   # schema v4
+        except BaseException:
+            for frame in reversed(self._mca_frames):
+                _cfg.pop_overrides(frame)
+            self._mca_frames = []
+            raise
+
+    def _autotune_consult(self, wants_la: bool):
+        """``--autotune``: resolve this run's knobs from the
+        persistent tuning database (:mod:`dplasma_tpu.tuning`) —
+        exact key, or the nearest measured neighbor. Returns the v11
+        ``"tuning"`` summary plus the MCA overrides to apply (the DB
+        knob vector filtered by precedence: keys an explicit override
+        or env var already pins are dropped, ``sweep.lookahead`` is
+        dropped under an explicit ``--lookahead``). The DB tile size
+        applies only when the CLI did not pin ``-t/-T``."""
+        from dplasma_tpu.observability.comm import OP_CLASS
+        from dplasma_tpu.tuning import db as _tdb
+        ip = self.ip
+        algo = _algo_of(self.name)
+        op = OP_CLASS.get(algo, algo)
+        entry, source, key, path = _tdb.consult(
+            op, ip.N, PRECISIONS[ip.prec], (ip.P, ip.Q))
+        summary = {"op": algo, "key": key, "source": source,
+                   "db": path, "knobs": None, "applied": {},
+                   "nb": None, "measured_s": None, "entry_key": None}
+        applied: dict = {}
+        if entry is not None and isinstance(entry.get("knobs"), dict):
+            knobs = entry["knobs"]
+            summary["knobs"] = dict(knobs)
+            summary["measured_s"] = entry.get("measured_s")
+            try:
+                summary["entry_key"] = _tdb.make_key(
+                    entry["op"], entry["n"], entry["dtype"],
+                    entry["grid"])
+            except (KeyError, TypeError):
+                summary["entry_key"] = None
+            applied = _tdb.appliable(
+                knobs, skip=("sweep.lookahead",) if wants_la else ())
+            summary["applied"] = dict(applied)
+            nb = knobs.get("nb")
+            if isinstance(nb, int) and nb > 0:
+                # an interpolated neighbor may have been measured at a
+                # much larger n: a tile wider than this problem would
+                # pad the whole run (the generators pad to the tile
+                # boundary) — clamp, exactly like the serving path
+                nb = min(nb, max(min(ip.M or ip.N, ip.N), 1))
+            if isinstance(nb, int) and nb > 0 \
+                    and not getattr(ip, "nb_explicit", False):
+                # apply the DB tile size over the defaults cascade;
+                # HNB/HMB followed NB/MB's default — keep them in step
+                if ip.HNB == ip.NB:
+                    ip.HNB = nb
+                if ip.HMB == ip.MB:
+                    ip.HMB = nb
+                ip.MB = ip.NB = nb
+                summary["nb"] = nb
+        return summary, applied
 
     def close(self):
         from dplasma_tpu.utils import config as _cfg
-        if getattr(self, "_la_override_active", False):
-            if self._mca_prev_la is _UNSET:
-                _cfg.mca_unset("sweep.lookahead")
-            else:
-                _cfg.mca_set("sweep.lookahead", self._mca_prev_la)
-            self._la_override_active = False
+        # scoped MCA overrides restore in LIFO order: the tuner's
+        # frame pops before the --lookahead frame it nests inside
+        # (utils.config.pop_overrides enforces the order)
+        for frame in reversed(getattr(self, "_mca_frames", [])):
+            _cfg.pop_overrides(frame)
+        self._mca_frames = []
         ip = self.ip
         if getattr(ip, "profile", None):
             try:

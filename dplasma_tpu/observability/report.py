@@ -43,7 +43,12 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                                     "tile"}]}],            # (v3)
      "pipeline": {"sweep.lookahead": n, "qr.agg_depth": d,
                   "panel.kernel": raw, "panel.qr": k,
-                  "panel.lu": k} | absent,    # (v4; panel.* keys v9)
+                  "panel.lu": k,
+                  "lu.agg_depth": d, "panel.tree_leaf": h,
+                  "panel.rec_base": w,
+                  "tuning.source": s?} | absent,
+                                   # (v4; panel.* keys v9; the full
+                                   # knob vector + tuning.source v11)
      "roofline": [{"op", "op_class", "expected_s", "measured_s",
                    "achieved_frac", "bound", "components_s",
                    "peaks", "peaks_source"}],              # (v5)
@@ -71,6 +76,14 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                    "total_bytes",
                    "diagnostics": [{"kind", "message", "kernel",
                                     "op", "detail"}]}],   # (v10)
+     "tuning": [{"op", "key", "source",  # db|interpolated|default
+                 "db",                   # DB path | null
+                 "knobs",       # the consulted DB knob vector | null
+                 "applied",     # MCA overrides actually applied
+                 "nb",          # tile size applied | null
+                 "measured_s",  # the DB winner's provenance | null
+                 "entry_key"}],  # the DB entry consulted (may be a
+                                 # neighbor under interpolation) (v11)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
@@ -95,9 +108,13 @@ same-family baselining keys on); 10 adds ``"hlocheck"`` (--hlocheck
 compiled-artifact verification of the post-GSPMD HLO — collective
 reconciliation, precision/donation/HBM/anti-pattern audits,
 analysis.hlocheck — whose ``hbm_peak_bytes`` perfdiff gates
-lower-better). All
+lower-better); 11 adds ``"tuning"`` (the --autotune consultation
+record — which tuning-DB entry resolved this run's knobs, with what
+source/provenance, dplasma_tpu.tuning) plus the ``"tuning.source"``
+and full-knob-vector keys (``lu.agg_depth``/``panel.tree_leaf``/
+``panel.rec_base``) in ``"pipeline"``. All
 additive — v1 readers of the other keys are unaffected; this reader
-accepts <= 10 (:func:`load_report` tolerates every v1-v10 vintage,
+accepts <= 11 (:func:`load_report` tolerates every v1-v11 vintage,
 filling the always-present keys).
 """
 from __future__ import annotations
@@ -110,7 +127,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 10
+REPORT_SCHEMA = 11
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -146,6 +163,7 @@ class RunReport:
         self.refine: List[dict] = []    # IR-solver records (v7)
         self.serving: List[dict] = []   # serving-layer records (v8)
         self.hlocheck: List[dict] = []  # --hlocheck audits (v10)
+        self.tuning: List[dict] = []    # --autotune consultations (v11)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
         self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
@@ -214,6 +232,12 @@ class RunReport:
         self.hlocheck.append(entry)
         return entry
 
+    def add_tuning(self, summary: dict) -> dict:
+        """Record one --autotune tuning-DB consultation (schema v11;
+        see drivers.common.Driver and dplasma_tpu.tuning.consult)."""
+        self.tuning.append(summary)
+        return summary
+
     def add_roofline(self, entry: dict) -> dict:
         """Record one per-op roofline ledger entry (schema v5; see
         observability.roofline.op_roofline)."""
@@ -251,6 +275,8 @@ class RunReport:
             doc["serving"] = self.serving
         if self.hlocheck:
             doc["hlocheck"] = self.hlocheck
+        if self.tuning:
+            doc["tuning"] = self.tuning
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
         if self.roofline:
@@ -285,7 +311,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v9) loads: the schema history is purely
+    Every older vintage (v1-v10) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

@@ -70,6 +70,14 @@ _cfg.mca_register(
 #: style: THRESHOLD * eps * n)
 _GATE = 60.0
 
+#: serializes the tuning-DB override scope across dispatch threads:
+#: the MCA override stack is process-global and strictly LIFO, and
+#: _dispatch runs on caller AND timer threads — two concurrent
+#: scoped pushes would interleave their pops into RuntimeErrors and
+#: leaked overrides. Compiles already serialize under the cache's
+#: own lock, so this costs nothing extra on the miss path.
+_TUNE_LOCK = threading.Lock()
+
 
 def percentile(sorted_vals, p: float):
     """Nearest-rank percentile of an ascending list (None when empty)
@@ -165,6 +173,11 @@ class SolverService:
             else cache_mod.ExecutableCache(metrics=self.metrics)
         self.check = bool(check)
         self.resilience: List[dict] = []   # ladder summaries
+        # per-cache-key tuning-DB consultation memo (the serving face
+        # of dplasma_tpu.tuning: resolved ONCE per key so the same key
+        # always compiles the same knobs; MCA tune.serving=off or no
+        # DB -> every value is None)
+        self._tuning: Dict[cache_mod.CacheKey, Optional[dict]] = {}
         self._pending: Dict[tuple, List[_Request]] = {}
         # (op, n, nrhs, dtype, kwargs) -> CacheKey memo: the key
         # context (grid, pipeline shape, ir precision, bucket policy)
@@ -276,10 +289,13 @@ class SolverService:
             bs[i, :r.n, :r.nrhs] = r.b
         return As, bs
 
-    def _builder(self, key: cache_mod.CacheKey, kwargs: dict):
+    def _builder(self, key: cache_mod.CacheKey, kwargs: dict,
+                 nb: Optional[int] = None):
         """The ONE executable body both the batched and the solo paths
-        compile: solve + in-executable backward errors."""
-        nb, op, kw = self.nb, key.op, dict(kwargs)
+        compile: solve + in-executable backward errors. ``nb``
+        overrides the service tile size (the tuning-DB consultation's
+        per-key winner)."""
+        nb, op, kw = (nb or self.nb), key.op, dict(kwargs)
 
         def build():
             def fn(a, b):
@@ -290,16 +306,67 @@ class SolverService:
             return fn
         return build
 
+    def _tuning_for(self, key: cache_mod.CacheKey) -> Optional[dict]:
+        """Resolve the tuning-DB consultation for one cache key
+        (memoized — a key must always compile the same knobs): the
+        per-op-class winner at this shape bucket, filtered by the
+        precedence contract (:func:`dplasma_tpu.tuning.appliable`).
+        None when no DB is configured or MCA ``tune.serving`` is
+        off."""
+        from dplasma_tpu.observability.comm import OP_CLASS
+        from dplasma_tpu.tuning import db as tdb
+        # the whole check-consult-store runs under the lock so
+        # concurrent dispatch threads (caller + timer) racing the same
+        # new key consult exactly once — the memo IS the "a key always
+        # compiles the same knobs" invariant, and the consult counter
+        # must agree with it
+        with self._lock:
+            if key in self._tuning:
+                return self._tuning[key]
+            tune = None
+            if _cfg.mca_get("tune.serving", "on") != "off" \
+                    and tdb.db_path():
+                op = OP_CLASS.get(key.op, key.op)
+                entry, source, tkey, _path = tdb.consult(
+                    op, key.n, key.dtype, key.grid)
+                if entry is not None \
+                        and isinstance(entry.get("knobs"), dict):
+                    knobs = entry["knobs"]
+                    nb = knobs.get("nb")
+                    tune = {"key": tkey, "source": source,
+                            "applied": tdb.appliable(knobs),
+                            "nb": (min(int(nb), key.n)
+                                   if isinstance(nb, int) and nb > 0
+                                   else None)}
+                self.metrics.counter(
+                    "serving_tuning_consults_total",
+                    source=(tune or {}).get("source", "default")).inc()
+            self._tuning[key] = tune
+            return tune
+
     def _run(self, key: cache_mod.CacheKey, reqs: List[_Request]):
         """Compile-or-hit + dispatch one bucket-shaped batch; returns
-        (X, bwds, info). Tainted entries (compiled while a fault plan
-        fired — poisoned for life) are dropped so any retry
+        (X, bwds, info). The tuning-DB consultation's knobs scope the
+        compile (a cache hit never re-traces, so the overrides only
+        matter on a miss — and the memoized consultation keeps them
+        identical per key). Tainted entries (compiled while a fault
+        plan fired — poisoned for life) are dropped so any retry
         re-compiles clean."""
         import jax.numpy as jnp
         As, bs = self._stack(key, reqs)
         Aj, bj = jnp.asarray(As), jnp.asarray(bs)   # ONE transfer
-        entry = self.cache.get(key, self._builder(key, reqs[0].kwargs),
-                               Aj, bj)
+        tune = self._tuning_for(key)
+        builder = self._builder(key, reqs[0].kwargs,
+                                nb=tune["nb"] if tune else None)
+        if tune and tune["applied"]:
+            # the override scope is process-global and LIFO: hold
+            # _TUNE_LOCK for the whole push..pop so concurrent
+            # dispatch threads never interleave their frames
+            with _TUNE_LOCK, _cfg.override_scope(tune["applied"],
+                                                 label="serving-tune"):
+                entry = self.cache.get(key, builder, Aj, bj)
+        else:
+            entry = self.cache.get(key, builder, Aj, bj)
         out = entry.fn(Aj, bj)
         if entry.tainted:
             self.cache.invalidate(key)
@@ -541,7 +608,16 @@ class SolverService:
             batches = self._batches
             requests = self._requests
             res = list(self.resilience)
+            tunes = dict(self._tuning)
+        tuning = None
+        if any(v is not None for v in tunes.values()):
+            sources: Dict[str, int] = {}
+            for v in tunes.values():
+                src = v["source"] if v else "default"
+                sources[src] = sources.get(src, 0) + 1
+            tuning = {"consulted": len(tunes), "sources": sources}
         return {"requests": requests, "batches": batches,
+                "tuning": tuning,
                 "mean_batch": (requests / batches) if batches else None,
                 "latency_s": {"p50": percentile(lats, 50),
                               "p99": percentile(lats, 99),

@@ -1,0 +1,37 @@
+"""Autotuning: roofline-pruned knob search + the persistent tuning
+database every driver consults.
+
+The closed loop over the repo's flight instruments: the knob space
+(``nb``, grid shape, ``sweep.lookahead``, ``qr.agg_depth``/
+``lu.agg_depth``, the panel engine's ``panel.*``) is searched per
+tuning key ``(op, n, dtype, grid)`` with the roofline model pruning
+analytically-dominated configs (:mod:`dplasma_tpu.tuning.search`),
+winners persist in a versioned JSON database with full provenance
+(:mod:`dplasma_tpu.tuning.db` — MCA ``tune.db`` / env
+``DPLASMA_TUNE_DB``), and every driver (``--autotune``) and the
+serving layer resolve their knobs from it at dispatch.
+
+Consultation precedence: CLI flag > ``DPLASMA_MCA_*`` env > DB >
+registered default. ``tools/autotune.py`` is the CLI face (sweep /
+show / prune-report / export / check).
+"""
+from dplasma_tpu.tuning.db import (KNOB_NAMES, MCA_KNOBS,
+                                   TUNE_DB_SCHEMA, TuningDB,
+                                   appliable, consult, db_path,
+                                   load_or_empty, make_key, parse_key,
+                                   resolved_knobs)
+from dplasma_tpu.tuning.search import (MEASURABLE_OPS,
+                                       candidate_configs,
+                                       expected_config_seconds,
+                                       measure_config,
+                                       prune_candidates, retune_gate,
+                                       select_winner, sweep)
+
+__all__ = [
+    "KNOB_NAMES", "MCA_KNOBS", "TUNE_DB_SCHEMA", "TuningDB",
+    "appliable", "consult", "db_path", "load_or_empty", "make_key",
+    "parse_key", "resolved_knobs",
+    "MEASURABLE_OPS", "candidate_configs", "expected_config_seconds",
+    "measure_config", "prune_candidates", "retune_gate",
+    "select_winner", "sweep",
+]

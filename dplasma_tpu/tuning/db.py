@@ -1,0 +1,363 @@
+"""The persistent tuning database — per-key knob winners with
+provenance.
+
+The knob space the repo grew (tile size ``nb``, grid shape,
+``sweep.lookahead``, ``qr.agg_depth``/``lu.agg_depth``, the panel
+engine's ``panel.kernel``/``panel.tree_leaf``/``panel.rec_base``) was
+hand-tuned per machine in the reference's lineage (PLASMA/DPLASMA
+tile-size tables). Here every measured winner is keyed by
+
+    ``(op, n, dtype, grid)``  →  ``"potrf|n=8192|float32|g1x1"``
+
+and stored in one versioned JSON document (``"schema": 1``) that the
+drivers (``--autotune``), the serving layer, and ``tools/autotune.py``
+consult. Each entry carries the FULL resolved knob vector plus its
+provenance — the measured seconds, achieved roofline fraction, the
+peaks fingerprint it was measured against, and the entry vintage — so
+a consultation can be audited and a DB refresh perfdiff-gated
+(:mod:`dplasma_tpu.tuning.search`).
+
+Consultation precedence (documented in docs/architecture.md): an
+explicit CLI flag wins over an ambient ``DPLASMA_MCA_*`` env var,
+which wins over the DB, which wins over the registered default —
+:func:`appliable` filters a DB knob vector down to exactly the knobs
+nothing louder already pinned. Keys without an exact match fall back
+to NEAREST-KEY interpolation: the same (op, dtype, grid) at the
+closest ``n`` by log-distance (tile-size winners drift slowly in
+problem size; a neighbor's knobs beat the static defaults).
+
+DB location: env ``DPLASMA_TUNE_DB`` > MCA ``tune.db`` > none (the
+autotuner is inert without a database).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from dplasma_tpu.utils import config as _cfg
+
+#: version of the on-disk document; additive changes bump it.
+TUNE_DB_SCHEMA = 1
+
+_cfg.mca_register(
+    "tune.db", "",
+    "Path of the persistent tuning database (versioned JSON) the "
+    "drivers' --autotune and the serving layer consult; env "
+    "DPLASMA_TUNE_DB overrides. Empty = no database (autotuning "
+    "inert).")
+_cfg.mca_register(
+    "tune.margin", "0.25",
+    "Roofline pruning margin of the autotuner sweep: a candidate "
+    "config whose analytic lower bound exceeds the incumbent's "
+    "MEASURED time by more than this fraction is skipped unmeasured "
+    "(the bound is a lower bound — it cannot win).")
+_cfg.mca_register(
+    "tune.serving", "on",
+    "on = SolverService/ExecutableCache resolve knobs from the "
+    "tuning database at dispatch (scoped around each compile); off "
+    "= serving ignores the DB.")
+_cfg.mca_register(
+    "tune.nruns", "3",
+    "Timed runs per autotuner trial (median is the trial's measured "
+    "time).")
+
+#: MCA knobs a DB entry may carry and a consultation may apply
+#: (``nb`` and ``grid`` ride the knob vector too but are applied
+#: structurally — tile/grid shape, not MCA state).
+MCA_KNOBS = ("sweep.lookahead", "qr.agg_depth", "lu.agg_depth",
+             "panel.kernel", "panel.tree_leaf", "panel.rec_base")
+
+#: every key a full resolved knob vector carries (``panel.qr``/
+#: ``panel.lu`` are the per-route resolutions of ``panel.kernel`` —
+#: recorded provenance, never applied as MCA state)
+KNOB_NAMES = ("nb", "grid", "panel.qr", "panel.lu") + MCA_KNOBS
+
+
+def db_path() -> Optional[str]:
+    """Resolve the tuning-DB location (env ``DPLASMA_TUNE_DB`` > MCA
+    ``tune.db`` > None)."""
+    p = os.environ.get("DPLASMA_TUNE_DB")
+    if p:
+        return p
+    p = _cfg.mca_get("tune.db")
+    return p or None
+
+
+def make_key(op: str, n: int, dtype, grid: Tuple[int, int]) -> str:
+    """Canonical tuning key ``op|n=N|dtype|gPxQ`` for one
+    ``(op, n, dtype, grid)`` point of the key space."""
+    import numpy as _np
+    name = _np.dtype(dtype).name if not isinstance(dtype, str) \
+        else dtype
+    P, Q = int(grid[0]), int(grid[1])
+    return f"{op}|n={int(n)}|{name}|g{P}x{Q}"
+
+
+def parse_key(key: str) -> Optional[dict]:
+    """Invert :func:`make_key`; None for an unparseable key."""
+    parts = key.split("|")
+    if len(parts) != 4 or not parts[1].startswith("n=") \
+            or not parts[3].startswith("g") or "x" not in parts[3]:
+        return None
+    try:
+        P, Q = parts[3][1:].split("x")
+        return {"op": parts[0], "n": int(parts[1][2:]),
+                "dtype": parts[2], "grid": (int(P), int(Q))}
+    except ValueError:
+        return None
+
+
+def resolved_knobs(nb: Optional[int] = None,
+                   grid: Tuple[int, int] = (1, 1)) -> dict:
+    """The FULL resolved knob vector of the live configuration — what
+    a bench/tuner ledger entry records so historical measurements are
+    usable tuner evidence (and what perfdiff's same-knob-vector
+    baselining keys on). ``panel.kernel`` is the raw MCA value; the
+    per-route resolutions ride alongside (``panel.qr``/``panel.lu``)
+    exactly as the run-report ``"pipeline"`` section records them."""
+    from dplasma_tpu.kernels import panels as _panels
+    from dplasma_tpu.ops._sweep import sweep_params
+    la, agg = sweep_params()
+    kv = {
+        "sweep.lookahead": la,
+        "qr.agg_depth": agg,
+        "lu.agg_depth": _cfg.mca_get_int("lu.agg_depth", 4),
+        "panel.kernel": _panels.panel_kernel_config(),
+        "panel.qr": _panels.panel_kernel("qr"),
+        "panel.lu": _panels.panel_kernel("lu"),
+        "panel.tree_leaf": _cfg.mca_get_int("panel.tree_leaf", 2),
+        "panel.rec_base": _cfg.mca_get_int("panel.rec_base", 8),
+    }
+    if nb is not None:
+        kv["nb"] = int(nb)
+    kv["grid"] = f"{int(grid[0])}x{int(grid[1])}"
+    return kv
+
+
+def appliable(knobs: dict, skip=()) -> dict:
+    """Filter a DB knob vector down to the MCA overrides a
+    consultation may apply — the precedence contract: an explicit
+    override already on the stack (CLI flag, an enclosing scope) or
+    an ambient ``DPLASMA_MCA_*`` env var beats the DB, so those keys
+    are dropped; ``skip`` names additional keys the caller pins
+    (e.g. ``sweep.lookahead`` under an explicit ``--lookahead``)."""
+    out = {}
+    for name in MCA_KNOBS:
+        if name not in knobs or name in skip:
+            continue
+        if name in _cfg._MCA_OVERRIDES:
+            continue
+        env = "DPLASMA_MCA_" + name.upper().replace(".", "_")
+        if os.environ.get(env) is not None:
+            continue
+        out[name] = knobs[name]
+    return out
+
+
+class TuningDB:
+    """The versioned per-key winner store (module docstring).
+
+    ``entries`` maps canonical keys (:func:`make_key`) to entry dicts
+    ``{"op", "n", "dtype", "grid", "knobs": {...}, "measured_s",
+    "gflops", "achieved_frac", "peaks", "schema",
+    "created_unix_ns", "source", "trials", "nruns"}``.
+    """
+
+    def __init__(self, entries: Optional[Dict[str, dict]] = None,
+                 schema: int = TUNE_DB_SCHEMA,
+                 created_unix_ns: Optional[int] = None):
+        self.schema = schema
+        self.created_unix_ns = created_unix_ns or time.time_ns()
+        self.entries: Dict[str, dict] = dict(entries or {})
+
+    # ------------------------------------------------------ persistence
+    @classmethod
+    def load(cls, path: str) -> "TuningDB":
+        """Read a DB back. Vintage tolerance mirrors the run-report
+        contract: any ``schema <= TUNE_DB_SCHEMA`` loads (the history
+        is additive), a NEWER document raises — this reader cannot
+        know what its knobs mean."""
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: tuning DB is not a JSON object")
+        schema = doc.get("schema", 1)
+        if not isinstance(schema, int) or schema > TUNE_DB_SCHEMA:
+            raise ValueError(
+                f"{path}: tuning DB schema {schema!r} is newer than "
+                f"supported ({TUNE_DB_SCHEMA})")
+        entries = doc.get("entries")
+        if not isinstance(entries, dict):
+            entries = {}
+        return cls(entries=entries, schema=schema,
+                   created_unix_ns=doc.get("created_unix_ns"))
+
+    def snapshot(self) -> dict:
+        return {"schema": TUNE_DB_SCHEMA,
+                "created_unix_ns": self.created_unix_ns,
+                "entries": self.entries}
+
+    def save(self, path: str) -> str:
+        """Serialize (atomic rename); always writes the CURRENT
+        schema — saving is how a stale vintage upgrades."""
+        doc = self.snapshot()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        self.schema = TUNE_DB_SCHEMA
+        return path
+
+    # ---------------------------------------------------------- access
+    def get(self, op: str, n: int, dtype,
+            grid: Tuple[int, int]) -> Optional[dict]:
+        return self.entries.get(make_key(op, n, dtype, grid))
+
+    def put(self, op: str, n: int, dtype, grid: Tuple[int, int],
+            knobs: dict, measured_s: float,
+            gflops: Optional[float] = None,
+            achieved_frac: Optional[float] = None,
+            peaks: Optional[dict] = None, source: str = "measured",
+            trials: int = 1, nruns: int = 1) -> dict:
+        """Record one per-key winner with provenance; returns the
+        stored entry."""
+        import numpy as _np
+        key = make_key(op, n, dtype, grid)
+        entry = {
+            "op": op, "n": int(n),
+            "dtype": (dtype if isinstance(dtype, str)
+                      else _np.dtype(dtype).name),
+            "grid": [int(grid[0]), int(grid[1])],
+            "knobs": dict(knobs),
+            "measured_s": float(measured_s),
+            "gflops": (float(gflops) if gflops is not None else None),
+            "achieved_frac": (float(achieved_frac)
+                              if achieved_frac is not None else None),
+            "peaks": dict(peaks) if peaks else None,
+            "schema": TUNE_DB_SCHEMA,
+            "created_unix_ns": time.time_ns(),
+            "source": source, "trials": int(trials),
+            "nruns": int(nruns),
+        }
+        self.entries[key] = entry
+        return entry
+
+    def lookup(self, op: str, n: int, dtype, grid: Tuple[int, int]
+               ) -> Tuple[Optional[dict], str]:
+        """Resolve a key to ``(entry, source)`` with nearest-key
+        interpolation: exact hit → ``"db"``; else the same
+        (op, dtype, grid) at the nearest ``n`` by log-distance →
+        ``"interpolated"``; nothing relevant → ``(None,
+        "default")``."""
+        import math
+
+        import numpy as _np
+        exact = self.get(op, n, dtype, grid)
+        if exact is not None:
+            return exact, "db"
+        dname = _np.dtype(dtype).name if not isinstance(dtype, str) \
+            else dtype
+        want_grid = [int(grid[0]), int(grid[1])]
+        best, best_d = None, None
+        for entry in self.entries.values():
+            if not isinstance(entry, dict):
+                continue
+            if entry.get("op") != op or entry.get("dtype") != dname \
+                    or entry.get("grid") != want_grid:
+                continue
+            en = entry.get("n")
+            if not isinstance(en, int) or en <= 0 or n <= 0:
+                continue
+            d = abs(math.log(en / n))
+            # deterministic tie-break: the smaller neighbor (its nb
+            # certainly divides-ish the problem; a larger neighbor's
+            # nb may exceed it)
+            if best_d is None or d < best_d \
+                    or (d == best_d and en < best["n"]):
+                best, best_d = entry, d
+        if best is not None:
+            return best, "interpolated"
+        return None, "default"
+
+    # ------------------------------------------------------ validation
+    def check(self) -> list:
+        """Validate against the CURRENT schema for the committed-DB
+        gate (``tools/autotune.py --check``): a stale vintage, a
+        malformed entry, or an unknown knob name fails fast here
+        instead of mis-steering every driver that consults it.
+        Returns a list of problem strings (empty = clean)."""
+        problems = []
+        if self.schema != TUNE_DB_SCHEMA:
+            problems.append(
+                f"db schema {self.schema} != current "
+                f"{TUNE_DB_SCHEMA} (re-save with tools/autotune.py "
+                "to upgrade)")
+        for key, entry in sorted(self.entries.items()):
+            if parse_key(key) is None:
+                problems.append(f"{key}: unparseable key")
+                continue
+            if not isinstance(entry, dict):
+                problems.append(f"{key}: entry is not an object")
+                continue
+            for field in ("op", "n", "dtype", "grid", "knobs",
+                          "measured_s"):
+                if field not in entry:
+                    problems.append(f"{key}: missing field {field!r}")
+            knobs = entry.get("knobs")
+            if isinstance(knobs, dict):
+                for name in knobs:
+                    if name not in KNOB_NAMES:
+                        problems.append(
+                            f"{key}: unknown knob {name!r}")
+            elif knobs is not None:
+                problems.append(f"{key}: knobs is not an object")
+            ms = entry.get("measured_s")
+            if ms is not None and (not isinstance(ms, (int, float))
+                                   or ms <= 0):
+                problems.append(
+                    f"{key}: measured_s {ms!r} is not a positive "
+                    "number")
+            es = entry.get("schema")
+            if isinstance(es, int) and es > TUNE_DB_SCHEMA:
+                problems.append(
+                    f"{key}: entry schema {es} is newer than "
+                    f"supported ({TUNE_DB_SCHEMA})")
+        return problems
+
+
+def load_or_empty(path: Optional[str]) -> TuningDB:
+    """A DB from ``path`` when it exists, else an empty one (the
+    sweep's create-on-first-write path). Unreadable/invalid raises —
+    a present-but-broken DB must fail loudly, not tune silently from
+    nothing."""
+    if path and os.path.exists(path):
+        return TuningDB.load(path)
+    return TuningDB()
+
+
+def consult(op: str, n: int, dtype, grid: Tuple[int, int],
+            path: Optional[str] = None
+            ) -> Tuple[Optional[dict], str, str, Optional[str]]:
+    """One-stop consultation for drivers/serving: resolve the DB
+    location, look the key up (nearest-key interpolation included),
+    and return ``(entry, source, key, db_path)`` with source in
+    ``{"db", "interpolated", "default"}``. Any read failure degrades
+    to ``"default"`` with a stderr note — consultation must never
+    break a run."""
+    import sys
+    key = make_key(op, n, dtype, grid)
+    p = path or db_path()
+    if not p:
+        return None, "default", key, None
+    try:
+        db = TuningDB.load(p)
+    except FileNotFoundError:
+        return None, "default", key, p
+    except (OSError, ValueError) as exc:
+        sys.stderr.write(f"#! tuning DB unreadable ({p}): {exc}\n")
+        return None, "default", key, p
+    entry, source = db.lookup(op, n, dtype, grid)
+    return entry, source, key, p

@@ -1,0 +1,480 @@
+"""The autotuner search engine: enumerate → prune → measure → store.
+
+Per tuning key ``(op, n, dtype, grid)`` the engine enumerates
+candidate knob configurations (:func:`candidate_configs`), prunes the
+analytically hopeless ones against the incumbent's *measured* time
+with the roofline model (:func:`expected_config_seconds` — the bound
+is a lower bound, so a candidate whose bound already exceeds the best
+measured time by the ``tune.margin`` fraction cannot win and is
+skipped unmeasured), measures the survivors through the same op
+dispatch the drivers run (scoped MCA overrides via
+:func:`dplasma_tpu.utils.config.override_scope`, so each trial's knob
+vector is exactly what the compiled program saw), and selects a
+deterministic winner (:func:`select_winner`: fastest median,
+canonical-knob-vector tie-break).
+
+Every measured trial lands in the ``bench_history.jsonl`` ledger with
+its FULL resolved knob vector and an explicit ``"tuning": true`` mark
+— exploration trials are deliberately bad configs, and a production
+``bench.py --gate`` must never baseline against one
+(:func:`tools.perfdiff.latest_comparable_entry` skips them).
+
+DB refreshes are perfdiff-gated (:func:`retune_gate`): a re-tune whose
+new winner regresses past threshold against the stored winner's
+measured time KEEPS the stored entry (the hardware didn't get slower —
+the sweep got unlucky or narrower) unless forced.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dplasma_tpu.tuning import db as tdb
+from dplasma_tpu.utils import config as _cfg
+
+#: op classes the measurement harness knows how to run
+MEASURABLE_OPS = ("potrf", "getrf", "geqrf", "gemm")
+
+
+def _perfdiff():
+    try:
+        from tools import perfdiff
+    except ImportError:    # repo-root not on sys.path (direct import)
+        import pathlib
+        sys.path.insert(
+            0, str(pathlib.Path(__file__).resolve().parents[2]))
+        from tools import perfdiff
+    return perfdiff
+
+
+def canonical(config: dict) -> str:
+    """Deterministic serialization of one candidate config — the
+    winner tie-break and the dedup key."""
+    return json.dumps(config, sort_keys=True)
+
+
+def default_nb(n: int) -> int:
+    """The drivers' default tile size for an ``n`` problem — always a
+    candidate, so the winner can never lose to the out-of-the-box
+    config. Delegates to the drivers' own cascade formula
+    (:func:`dplasma_tpu.drivers.common.default_tile`): one source of
+    truth, the baseline cannot drift from what drivers run."""
+    from dplasma_tpu.drivers.common import default_tile
+    return default_tile(n)
+
+
+def default_nbs(n: int) -> List[int]:
+    """A small tile-size ladder around the problem size."""
+    out = [nb for nb in (16, 32, 64, 128, 192, 256, 384, 512, 1024)
+           if nb <= n and n <= nb * 64]
+    dflt = default_nb(n)
+    if dflt not in out:
+        out.append(dflt)
+    out.sort()
+    return out[-4:] if len(out) > 4 else out
+
+
+def candidate_configs(op: str, n: int,
+                      nbs: Optional[List[int]] = None,
+                      lookaheads: Optional[List[int]] = None,
+                      agg_depths: Optional[List[int]] = None,
+                      panel_kernels: Optional[List[str]] = None
+                      ) -> List[dict]:
+    """Enumerate candidate configs for one key. The FIRST candidate
+    is always the current default resolution (default nb, live MCA
+    knobs) so the incumbent baseline is measured before anything
+    speculative, and the stored winner is provably no worse than the
+    defaults."""
+    from dplasma_tpu.ops._sweep import sweep_params
+    la0, _ = sweep_params()
+    # the op's OWN aggregation knob (qr.agg_depth drives geqrf,
+    # lu.agg_depth everything LU-shaped) — the default-first
+    # candidate must record the same resolution Driver.pipeline and
+    # resolved_knobs() report, or "no worse than out-of-the-box"
+    # silently baselines the wrong knob
+    agg_name = "qr.agg_depth" if op == "geqrf" else "lu.agg_depth"
+    agg0 = _cfg.mca_get_int(agg_name, 4)
+    if op == "gemm":
+        # the gemm path (ops.blas3 — ONE XLA dot, GSPMD-SUMMA'd on a
+        # mesh) is nb-invariant: XLA owns its tiling. Sweeping nb
+        # would measure identical programs and store a noise-selected
+        # tile size that --autotune then applies to real runs.
+        nbs = [default_nb(n)]
+    else:
+        nbs = list(nbs) if nbs else default_nbs(n)
+    las = list(lookaheads) if lookaheads is not None else [la0]
+    aggs = list(agg_depths) if agg_depths is not None else [None]
+    kers = list(panel_kernels) if panel_kernels is not None else [None]
+
+    def cfg(nb, la, agg, ker):
+        c = {"nb": int(nb), "sweep.lookahead": int(la)}
+        if agg is not None:
+            c[agg_name] = int(agg)
+        if ker is not None:
+            c["panel.kernel"] = str(ker)
+        return c
+
+    first = cfg(default_nb(n), la0,
+                agg0 if agg_depths is not None else None,
+                kers[0] if panel_kernels is not None else None)
+    out, seen = [first], {canonical(first)}
+    for nb in nbs:
+        for la in las:
+            for agg in aggs:
+                for ker in kers:
+                    c = cfg(nb, la, agg, ker)
+                    key = canonical(c)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(c)
+    return out
+
+
+# ---------------------------------------------------------------------
+# Roofline pruning
+# ---------------------------------------------------------------------
+
+def expected_config_seconds(op: str, n: int, dtype, config: dict,
+                            peaks: Optional[dict] = None) -> float:
+    """Analytic lower bound on one config's run time: the per-phase
+    roofline demands of :func:`dplasma_tpu.observability.roofline.
+    phase_model` at THIS config's pipeline shape, each phase bounded
+    by its binding resource, summed (phases of one sweep are
+    serialized by dataflow, so the sum of per-phase lower bounds is
+    still a lower bound). Ops without a phase model (gemm) fall back
+    to the whole-op bound. Evaluated under the config's scoped MCA
+    overrides so the panel-route resolution matches what a trial
+    would actually run."""
+    from dplasma_tpu.observability import roofline as _rl
+    itemsize = np.dtype(dtype).itemsize
+    nb = int(config.get("nb") or default_nb(n))
+    overrides = {k: config[k] for k in tdb.MCA_KNOBS if k in config}
+    with _cfg.override_scope(overrides, label="tune-prune"):
+        la, agg = (int(config.get("sweep.lookahead", 1)),
+                   _cfg.mca_get_int("qr.agg_depth", 4))
+        model = _rl.phase_model(
+            op if op in ("potrf", "getrf", "geqrf") else None,
+            n, n, nb, itemsize, lookahead=la, agg_depth=agg,
+            peaks=peaks)
+    if model is None:
+        fl = 2.0 * float(n) ** 3 if op == "gemm" \
+            else float(n) ** 3
+        exp, _, _ = _rl.expected_seconds(
+            flops=fl, hbm_bytes=3.0 * n * n * itemsize,
+            dispatches=1, peaks=peaks)
+        return exp
+    total = 0.0
+    for fl, by, disp in model.values():
+        exp, _, _ = _rl.expected_seconds(
+            flops=fl, hbm_bytes=by, dispatches=disp, peaks=peaks)
+        total += exp
+    return total
+
+
+def prune_candidates(op: str, n: int, dtype, candidates: List[dict],
+                     incumbent_s: Optional[float],
+                     peaks: Optional[dict] = None,
+                     margin: Optional[float] = None
+                     ) -> Tuple[List[dict], List[dict]]:
+    """Split ``candidates`` into (survivors, pruned) against the
+    incumbent's measured seconds. With no incumbent yet nothing is
+    pruned (there is nothing to lose to). Each pruned record carries
+    the config, its analytic bound, and the incumbent it lost to —
+    the sweep's prune-report."""
+    if margin is None:
+        margin = _cfg.mca_get_float("tune.margin", 0.25)
+    survivors, pruned = [], []
+    for c in candidates:
+        if incumbent_s is None:
+            survivors.append(c)
+            continue
+        exp = expected_config_seconds(op, n, dtype, c, peaks)
+        if exp > incumbent_s * (1.0 + margin):
+            pruned.append({"config": dict(c), "expected_s": exp,
+                           "incumbent_s": incumbent_s,
+                           "margin": margin})
+        else:
+            survivors.append(c)
+    return survivors, pruned
+
+
+# ---------------------------------------------------------------------
+# Measurement (through the real op dispatch)
+# ---------------------------------------------------------------------
+
+def _trial_problem(op: str, n: int, nb: int, dtype):
+    """Build one trial's callable + args + flop count — the same op
+    entry points the drivers time."""
+    from dplasma_tpu.descriptors import TileMatrix
+    from dplasma_tpu.ops import generators
+    from dplasma_tpu.ops import lu as lu_mod
+    from dplasma_tpu.ops import potrf as potrf_mod
+    from dplasma_tpu.ops import qr as qr_mod
+    from dplasma_tpu.utils import flops as lawn41
+    if op == "potrf":
+        A0 = generators.plghe(float(n), n, nb, seed=3872, dtype=dtype)
+        fn = lambda a: potrf_mod.potrf(  # noqa: E731
+            TileMatrix(a, A0.desc), "L").data
+        return fn, (A0.data,), lawn41.potrf(n)
+    if op == "getrf":
+        A0 = generators.plrnt(n, n, nb, nb, seed=3872, dtype=dtype)
+
+        def fn(a):
+            LU, piv = lu_mod.getrf_1d(TileMatrix(a, A0.desc))
+            return LU.data, piv
+        return fn, (A0.data,), lawn41.getrf(n, n)
+    if op == "geqrf":
+        A0 = generators.plrnt(n, n, nb, nb, seed=3872, dtype=dtype)
+
+        def fn(a):
+            Af, Tf = qr_mod.geqrf(TileMatrix(a, A0.desc))
+            return Af.data, Tf.data
+        return fn, (A0.data,), lawn41.geqrf(n, n)
+    if op == "gemm":
+        # the TILED gemm (ops.blas3) — nb must actually shape the
+        # measured program, or the sweep would time identical
+        # executables and store a noise-selected tile size
+        from dplasma_tpu.ops import blas3
+        A0 = generators.plrnt(n, n, nb, nb, seed=3872, dtype=dtype)
+        B0 = generators.plrnt(n, n, nb, nb, seed=3873, dtype=dtype)
+        C0 = generators.plrnt(n, n, nb, nb, seed=3874, dtype=dtype)
+
+        def fn(a, b, c):
+            return blas3.gemm(0.51, TileMatrix(a, A0.desc),
+                              TileMatrix(b, B0.desc), -0.42,
+                              TileMatrix(c, C0.desc)).data
+        return fn, (A0.data, B0.data, C0.data), lawn41.gemm(n, n, n)
+    raise ValueError(f"unmeasurable op {op!r} "
+                     f"(know {MEASURABLE_OPS})")
+
+
+def measure_config(op: str, n: int, dtype, grid: Tuple[int, int],
+                   config: dict, nruns: Optional[int] = None
+                   ) -> Tuple[float, float, dict]:
+    """Measure one candidate: compile+warm once, then ``tune.nruns``
+    timed runs; returns ``(median_s, gflops, resolved_knobs)``. The
+    config's MCA knobs are scoped overrides for the whole
+    build+measure (the compiled program IS the config); the returned
+    knob vector is resolved inside the scope."""
+    import contextlib
+    import statistics
+
+    import jax
+    if nruns is None:
+        nruns = max(_cfg.mca_get_int("tune.nruns", 3), 1)
+    nb = int(config.get("nb") or default_nb(n))
+    overrides = {k: config[k] for k in tdb.MCA_KNOBS if k in config}
+    mesh_cm = contextlib.nullcontext()
+    if tuple(grid) != (1, 1):
+        from dplasma_tpu.parallel import mesh as pmesh
+        P, Q = int(grid[0]), int(grid[1])
+        if P * Q > len(jax.devices()):
+            raise ValueError(f"grid {P}x{Q} needs {P * Q} devices, "
+                             f"have {len(jax.devices())}")
+        mesh_cm = pmesh.use_grid(pmesh.make_mesh(P, Q))
+    with _cfg.override_scope(overrides, label="tune-trial"), mesh_cm:
+        knobs = tdb.resolved_knobs(nb=nb, grid=grid)
+        fn, args, flops = _trial_problem(op, n, nb, dtype)
+        jfn = jax.jit(fn)
+        jax.block_until_ready(jfn(*args))     # compile + warm
+        times = []
+        for _ in range(nruns):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jfn(*args))
+            times.append(time.perf_counter() - t0)
+    med = statistics.median(times)
+    return med, flops / 1e9 / max(med, 1e-12), knobs
+
+
+def trial_ledger_doc(op: str, n: int, dtype, key: str, knobs: dict,
+                     median_s: float, gflops: float,
+                     config: dict) -> dict:
+    """The ``bench_history.jsonl`` document of one tuner trial: a
+    regular one-line bench doc (so the ledger stays one format)
+    carrying the full resolved knob vector AND the explicit
+    ``"tuning": true`` mark — exploration trials are deliberately-bad
+    configs and must never baseline a production ``--gate``."""
+    dname = np.dtype(dtype).name
+    metric = f"tune_{op}_{dname}_n{n}"
+    return {"metric": metric, "value": round(gflops, 3),
+            "unit": "GFlop/s", "tuning": True,
+            "pipeline": dict(knobs),
+            "ladder": [{"metric": metric, "value": round(gflops, 3),
+                        "unit": "GFlop/s", "tuning": True,
+                        "nb": knobs.get("nb")}],
+            "tune": {"key": key, "median_s": median_s,
+                     "config": dict(config)}}
+
+
+# ---------------------------------------------------------------------
+# Winner selection + the perfdiff re-tune gate
+# ---------------------------------------------------------------------
+
+def select_winner(trials: List[dict]) -> Optional[dict]:
+    """Deterministic winner: fastest median, ties broken by the
+    canonical knob-vector serialization (so equal measurements on a
+    quiet machine always pick the same config)."""
+    if not trials:
+        return None
+    return min(trials, key=lambda t: (t["median_s"],
+                                      canonical(t["config"])))
+
+
+def retune_gate(key: str, prior: Optional[dict], winner: dict,
+                threshold: float = 0.10, force: bool = False
+                ) -> Tuple[bool, Optional[dict]]:
+    """perfdiff-gate a DB refresh: compare the stored winner's
+    measured seconds (lower-better) against the new winner's. A
+    regression past ``threshold`` KEEPS the prior entry (returns
+    ``(False, result)``) unless forced — a narrower or unlucky
+    re-sweep must not silently clobber a previously-measured
+    winner."""
+    if prior is None or force:
+        return True, None
+    pm = prior.get("measured_s")
+    if not isinstance(pm, (int, float)) or pm <= 0:
+        return True, None
+    perfdiff = _perfdiff()
+    mk = f"{key}.measured_s"
+    old_doc = {"ladder": [{"metric": mk, "value": float(pm),
+                           "unit": "s", "better": "lower"}]}
+    new_doc = {"ladder": [{"metric": mk,
+                           "value": float(winner["median_s"]),
+                           "unit": "s", "better": "lower"}]}
+    res = perfdiff.compare(old_doc, new_doc, threshold=threshold)
+    return res["ok"], res
+
+
+# ---------------------------------------------------------------------
+# The sweep orchestrator
+# ---------------------------------------------------------------------
+
+def sweep(ops: List[str], sizes: List[int], dtype="float32",
+          grid: Tuple[int, int] = (1, 1),
+          db_file: Optional[str] = None,
+          nbs: Optional[List[int]] = None,
+          lookaheads: Optional[List[int]] = None,
+          agg_depths: Optional[List[int]] = None,
+          panel_kernels: Optional[List[str]] = None,
+          nruns: Optional[int] = None,
+          margin: Optional[float] = None, prune: bool = True,
+          history: Optional[str] = None,
+          peaks: Optional[dict] = None,
+          gate_threshold: float = 0.10, force: bool = False,
+          measure_fn: Optional[Callable] = None,
+          log: Optional[Callable[[str], None]] = None) -> dict:
+    """Sweep the key space ``ops x sizes`` on one (dtype, grid):
+    enumerate, prune against the incumbent's measured time, measure
+    survivors (each trial appended to the ``history`` ledger with its
+    knob vector + tuning mark), select the deterministic winner,
+    perfdiff-gate the refresh, and persist to ``db_file`` after every
+    key (a killed sweep keeps its finished keys). Returns the sweep
+    report ``{"db", "keys": [...]}`` — also written next to the DB as
+    ``<db>.sweep.json`` for ``tools/autotune.py prune-report``."""
+    log = log or (lambda s: print(s, file=sys.stderr))
+    measure_fn = measure_fn or measure_config
+    path = db_file or tdb.db_path()
+    db = tdb.load_or_empty(path)
+    perfdiff = _perfdiff()
+    report: Dict = {"db": path, "dtype": np.dtype(dtype).name,
+                    "grid": [int(grid[0]), int(grid[1])],
+                    "created_unix_ns": time.time_ns(), "keys": []}
+    for op in ops:
+        for n in sizes:
+            key = tdb.make_key(op, n, dtype, grid)
+            prior = db.get(op, n, dtype, grid)
+            incumbent = prior.get("measured_s") if prior else None
+            cands = candidate_configs(
+                op, n, nbs=nbs, lookaheads=lookaheads,
+                agg_depths=agg_depths, panel_kernels=panel_kernels)
+            krep = {"key": key, "op": op, "n": n, "trials": [],
+                    "pruned": [], "candidates": len(cands)}
+            report["keys"].append(krep)
+            trials = krep["trials"]
+            for c in cands:
+                if prune:
+                    keep, cut = prune_candidates(
+                        op, n, dtype, [c], incumbent, peaks=peaks,
+                        margin=margin)
+                    if cut:
+                        krep["pruned"].extend(cut)
+                        log(f"# tune[{key}]: pruned {canonical(c)} "
+                            f"(bound {cut[0]['expected_s']:.3g}s > "
+                            f"incumbent {incumbent:.3g}s "
+                            f"+{100 * cut[0]['margin']:.0f}%)")
+                        continue
+                try:
+                    med, gf, knobs = measure_fn(op, n, dtype, grid,
+                                                c, nruns)
+                except Exception as exc:  # noqa: BLE001 — one bad
+                    # config (OOM, unsupported shape) must not kill
+                    # the sweep; the failure is recorded, not hidden
+                    krep.setdefault("errors", []).append(
+                        {"config": dict(c), "error": repr(exc)})
+                    log(f"# tune[{key}]: {canonical(c)} failed: "
+                        f"{exc!r}")
+                    continue
+                trial = {"config": dict(c), "median_s": med,
+                         "gflops": gf, "knobs": knobs}
+                trials.append(trial)
+                log(f"# tune[{key}]: {canonical(c)} -> "
+                    f"{med:.3g}s ({gf:.2f} GF/s)")
+                if history:
+                    try:
+                        perfdiff.append_ledger(
+                            history, trial_ledger_doc(
+                                op, n, dtype, key, knobs, med, gf, c))
+                    except OSError as exc:
+                        log(f"# tune[{key}]: cannot append ledger: "
+                            f"{exc}")
+                if incumbent is None or med < incumbent:
+                    incumbent = med
+            winner = select_winner(trials)
+            if winner is None:
+                krep["decision"] = "no-trials"
+                continue
+            krep["winner"] = winner
+            ok, gres = retune_gate(key, prior, winner,
+                                   threshold=gate_threshold,
+                                   force=force)
+            if not ok:
+                krep["decision"] = "kept-prior"
+                krep["gate"] = {
+                    "prior_s": prior["measured_s"],
+                    "new_s": winner["median_s"],
+                    "threshold": gate_threshold}
+                log(f"# tune[{key}]: refresh regresses "
+                    f"{prior['measured_s']:.3g}s -> "
+                    f"{winner['median_s']:.3g}s past "
+                    f"{100 * gate_threshold:.0f}%; keeping the "
+                    "stored winner (--force overrides)")
+                continue
+            krep["decision"] = "stored"
+            # the winner's roofline provenance: analytic bound over
+            # measured median ((0, 1] on honest peaks — small means
+            # the key still has headroom worth a wider sweep)
+            exp = expected_config_seconds(op, n, dtype,
+                                          winner["config"], peaks)
+            db.put(op, n, dtype, grid, winner["knobs"],
+                   winner["median_s"], gflops=winner["gflops"],
+                   achieved_frac=(exp / winner["median_s"]
+                                  if winner["median_s"] > 0
+                                  else None),
+                   peaks=peaks, trials=len(trials),
+                   nruns=nruns
+                   or max(_cfg.mca_get_int("tune.nruns", 3), 1))
+            if path:
+                db.save(path)
+    if path:
+        db.save(path)
+        try:
+            with open(path + ".sweep.json", "w") as f:
+                json.dump(report, f, indent=1)
+                f.write("\n")
+        except OSError as exc:
+            log(f"# tune: cannot write sweep report: {exc}")
+    return report

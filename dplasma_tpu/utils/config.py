@@ -18,6 +18,7 @@ exactly as the reference's values shaped its DAGs.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Optional
 
@@ -144,6 +145,103 @@ def mca_get_int(name: str, default: int) -> int:
         return int(v)
     except ValueError:
         return default
+
+
+def mca_get_float(name: str, default: float) -> float:
+    v = mca_get(name)
+    if v is None:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+# -- scoped override stack ---------------------------------------------
+#
+# Several layers apply *temporary* MCA overrides around a region of
+# work — a driver's --lookahead, the autotuner's per-trial knob
+# vectors, the tuning-DB consultation a driver/serving dispatch makes.
+# These scopes NEST (a tuner trial runs inside a driver that already
+# holds --lookahead), so ad-hoc save/restore pairs per call site are a
+# leak waiting to happen: restoring out of order resurrects a stale
+# value. The stack below makes LIFO restoration structural — each
+# frame records the prior state of exactly the keys it touched, and
+# popping out of order is an error, not a silent corruption.
+
+_UNSET = object()          # "key had no override before this frame"
+_OVERRIDE_STACK: list = []  # [_OverrideFrame, ...] — top is last
+
+
+class _OverrideFrame:
+    """One pushed override scope: the applied values plus the exact
+    prior state of every touched key (value, or _UNSET)."""
+
+    __slots__ = ("applied", "saved", "label")
+
+    def __init__(self, applied: dict, saved: dict, label: str):
+        self.applied = applied
+        self.saved = saved
+        self.label = label
+
+
+def push_overrides(kv: dict, label: str = "") -> _OverrideFrame:
+    """Apply ``kv`` as MCA overrides and push a restore frame.
+
+    Returns the frame token; hand it back to :func:`pop_overrides` in
+    LIFO order. Keys are applied through :func:`mca_set` (stringified);
+    a ``None`` value means "unset the override for this key in this
+    scope" (the env/default tiers resume underneath)."""
+    saved = {}
+    applied = {}
+    for name, value in kv.items():
+        saved[name] = _MCA_OVERRIDES.get(name, _UNSET)
+        if value is None:
+            mca_unset(name)
+            applied[name] = None
+        else:
+            mca_set(name, value)
+            applied[name] = str(value)
+    frame = _OverrideFrame(applied, saved, label)
+    _OVERRIDE_STACK.append(frame)
+    return frame
+
+
+def pop_overrides(frame: _OverrideFrame) -> None:
+    """Restore the prior override state of ``frame``'s keys.
+
+    LIFO is enforced: ``frame`` must be the top of the stack (popping
+    an inner scope's parent first would restore stale values over the
+    inner scope's save). A non-top pop raises RuntimeError and leaves
+    the stack untouched."""
+    if not _OVERRIDE_STACK or _OVERRIDE_STACK[-1] is not frame:
+        raise RuntimeError(
+            "MCA override scopes must pop in LIFO order: "
+            f"frame {frame.label or id(frame)} is not the innermost "
+            "active scope")
+    _OVERRIDE_STACK.pop()
+    for name, prev in frame.saved.items():
+        if prev is _UNSET:
+            _MCA_OVERRIDES.pop(name, None)
+        else:
+            _MCA_OVERRIDES[name] = prev
+
+
+@contextlib.contextmanager
+def override_scope(kv: dict, label: str = ""):
+    """``with override_scope({...}):`` — scoped MCA overrides with
+    structural LIFO restore (the context-manager face of
+    :func:`push_overrides`/:func:`pop_overrides`)."""
+    frame = push_overrides(kv, label=label)
+    try:
+        yield frame
+    finally:
+        pop_overrides(frame)
+
+
+def override_depth() -> int:
+    """Number of active override scopes (diagnostics/tests)."""
+    return len(_OVERRIDE_STACK)
 
 
 def mca_help() -> str:

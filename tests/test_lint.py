@@ -8,7 +8,8 @@
   tiny DAGs of all four ops + the analysis.spmdcheck collective-
   schedule smoke over the cyclic kernels + the analysis.hlocheck
   compiled-artifact smoke over the cyclic kernels' post-GSPMD HLO
-  and one serving executable) must exit 0 on the repo.
+  and one serving executable + the dplasma_tpu.tuning sweep → DB →
+  driver --autotune consultation smoke) must exit 0 on the repo.
 """
 import pathlib
 import sys
@@ -74,7 +75,7 @@ def test_lint_cli_exit_codes(tmp_path):
 def test_lint_all_aggregate_is_clean(capsys):
     """tools/lint_all.py gates every rule with one exit code: excepts,
     jaxlint, the perfdiff smoke, the pallas contract gate, and the
-    dagcheck/spmdcheck/serving/hlocheck smoke passes must all be
+    dagcheck/spmdcheck/serving/hlocheck/tune smoke passes must all be
     clean on the repo."""
     import lint_all
     rc = lint_all.main([])
@@ -82,5 +83,5 @@ def test_lint_all_aggregate_is_clean(capsys):
     assert rc == 0, out.err
     for gate in ("lint_excepts", "jaxlint", "perfdiff-smoke",
                  "palcheck", "dagcheck-smoke", "spmdcheck-smoke",
-                 "serving-smoke", "hlocheck-smoke"):
+                 "serving-smoke", "hlocheck-smoke", "tune-smoke"):
         assert f"# {gate}: OK" in out.out

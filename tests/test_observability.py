@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 10
+    assert doc["schema"] == REPORT_SCHEMA == 11
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -151,6 +151,19 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
                            "hbm_budget": 0, "copy_bytes": 3584,
                            "total_bytes": 68940,
                            "diagnostics": []}]},
+        11: {"schema": 11, "name": "v11", "ops": [], "metrics": [],
+             "pipeline": {"sweep.lookahead": 1, "qr.agg_depth": 4,
+                          "lu.agg_depth": 4, "panel.kernel": "auto",
+                          "panel.qr": "tree", "panel.lu": "rec",
+                          "panel.tree_leaf": 2, "panel.rec_base": 8,
+                          "tuning.source": "db"},
+             "tuning": [{"op": "potrf",
+                         "key": "potrf|n=8192|float32|g1x1",
+                         "source": "db", "db": "tune_db.json",
+                         "knobs": {"nb": 512, "sweep.lookahead": 2},
+                         "applied": {"sweep.lookahead": 2},
+                         "nb": 512, "measured_s": 0.84,
+                         "entry_key": "potrf|n=8192|float32|g1x1"}]},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -406,7 +419,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 10
+    assert doc["schema"] == 11
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

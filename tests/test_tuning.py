@@ -1,0 +1,647 @@
+"""Autotuning (dplasma_tpu.tuning + tools/autotune.py): the
+persistent tuning database, the roofline-pruned knob search, the
+scoped MCA override stack, and the drivers'/serving layer's
+``--autotune`` consultation.
+
+Heavy real sweeps carry the ``slow`` marker; tier-1 keeps the DB/
+search-logic unit tests (injected measure functions — deterministic,
+no compiles), one real single-config measurement, and the driver
+consultation e2e (tiny N=32 compiles riding the persistent cache).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dplasma_tpu.tuning import db as tdb
+from dplasma_tpu.tuning import search
+from dplasma_tpu.utils import config
+
+
+# ---------------------------------------------------------------------
+# Scoped MCA override stack (utils.config)
+# ---------------------------------------------------------------------
+
+def test_override_stack_nested_lifo_restore():
+    """Nested scopes restore exact prior state — including a key the
+    outer scope SET and the inner scope overrode, and a key that had
+    no override at all."""
+    assert "sweep.lookahead" not in config._MCA_OVERRIDES
+    f1 = config.push_overrides({"sweep.lookahead": 3,
+                                "qr.agg_depth": 2}, label="outer")
+    assert config.mca_get_int("sweep.lookahead", -1) == 3
+    f2 = config.push_overrides({"sweep.lookahead": 0,
+                                "panel.rec_base": 4}, label="inner")
+    assert config.mca_get_int("sweep.lookahead", -1) == 0
+    assert config.mca_get_int("panel.rec_base", -1) == 4
+    config.pop_overrides(f2)
+    # the inner pop resurrects the OUTER override, not the default
+    assert config.mca_get_int("sweep.lookahead", -1) == 3
+    assert "panel.rec_base" not in config._MCA_OVERRIDES
+    config.pop_overrides(f1)
+    assert "sweep.lookahead" not in config._MCA_OVERRIDES
+    assert "qr.agg_depth" not in config._MCA_OVERRIDES
+    assert config.override_depth() == 0
+
+
+def test_override_stack_out_of_order_pop_raises():
+    """Popping an outer frame while an inner one is live is the bug
+    the stack exists to prevent — it must raise and change nothing."""
+    f1 = config.push_overrides({"sweep.lookahead": 2})
+    f2 = config.push_overrides({"sweep.lookahead": 5})
+    try:
+        with pytest.raises(RuntimeError, match="LIFO"):
+            config.pop_overrides(f1)
+        # the failed pop left both frames intact
+        assert config.mca_get_int("sweep.lookahead", -1) == 5
+        assert config.override_depth() == 2
+    finally:
+        config.pop_overrides(f2)
+        config.pop_overrides(f1)
+    assert "sweep.lookahead" not in config._MCA_OVERRIDES
+
+
+def test_override_scope_context_restores_on_raise():
+    with pytest.raises(ValueError):
+        with config.override_scope({"qr.agg_depth": 9}):
+            assert config.mca_get_int("qr.agg_depth", -1) == 9
+            raise ValueError("boom")
+    assert "qr.agg_depth" not in config._MCA_OVERRIDES
+    assert config.override_depth() == 0
+
+
+def test_override_scope_none_unsets_within_scope():
+    """A None value UNSETS an existing override for the scope (the
+    env/default tiers resume), then the prior override comes back."""
+    with config.override_scope({"sweep.lookahead": 7}):
+        with config.override_scope({"sweep.lookahead": None}):
+            assert "sweep.lookahead" not in config._MCA_OVERRIDES
+            assert config.mca_get("sweep.lookahead") == "1"  # default
+        assert config.mca_get_int("sweep.lookahead", -1) == 7
+    assert "sweep.lookahead" not in config._MCA_OVERRIDES
+
+
+# ---------------------------------------------------------------------
+# Tuning DB: round-trip, vintages, interpolation, validation
+# ---------------------------------------------------------------------
+
+def _mk_db(tmp_path, entries):
+    db = tdb.TuningDB()
+    for op, n, knobs, secs in entries:
+        db.put(op, n, "float32", (1, 1), knobs, secs, gflops=1.0)
+    path = str(tmp_path / "tune_db.json")
+    db.save(path)
+    return db, path
+
+
+def test_db_roundtrip(tmp_path):
+    db, path = _mk_db(tmp_path, [
+        ("potrf", 64, {"nb": 16, "sweep.lookahead": 1}, 1e-3),
+        ("getrf", 128, {"nb": 32, "lu.agg_depth": 2}, 2e-3)])
+    back = tdb.TuningDB.load(path)
+    assert back.schema == tdb.TUNE_DB_SCHEMA
+    assert set(back.entries) == set(db.entries)
+    e = back.get("potrf", 64, "float32", (1, 1))
+    assert e["knobs"] == {"nb": 16, "sweep.lookahead": 1}
+    assert e["measured_s"] == pytest.approx(1e-3)
+    assert e["source"] == "measured" and e["schema"] == 1
+    assert back.check() == []
+
+
+def test_db_vintage_tolerance(tmp_path):
+    """Older vintages load (additive history) but fail the committed-
+    DB check as stale; a NEWER document is rejected outright; saving
+    upgrades the vintage."""
+    path = str(tmp_path / "old.json")
+    entry = {"op": "potrf", "n": 64, "dtype": "float32",
+             "grid": [1, 1], "knobs": {"nb": 16}, "measured_s": 1e-3}
+    with open(path, "w") as f:
+        json.dump({"schema": 0, "entries":
+                   {tdb.make_key("potrf", 64, "float32", (1, 1)):
+                    entry}}, f)
+    db = tdb.TuningDB.load(path)
+    assert db.get("potrf", 64, "float32", (1, 1))["knobs"]["nb"] == 16
+    assert any("schema 0" in p for p in db.check())
+    db.save(path)
+    assert tdb.TuningDB.load(path).check() == []
+    newer = str(tmp_path / "newer.json")
+    with open(newer, "w") as f:
+        json.dump({"schema": tdb.TUNE_DB_SCHEMA + 1, "entries": {}}, f)
+    with pytest.raises(ValueError, match="newer"):
+        tdb.TuningDB.load(newer)
+
+
+def test_db_check_flags_malformed_entries(tmp_path):
+    db, path = _mk_db(tmp_path, [
+        ("potrf", 64, {"nb": 16}, 1e-3)])
+    key = tdb.make_key("potrf", 64, "float32", (1, 1))
+    db.entries[key]["knobs"]["bogus.knob"] = 1
+    db.entries[key]["measured_s"] = -1.0
+    del db.entries[key]["dtype"]
+    db.entries["not-a-key"] = {}
+    probs = db.check()
+    assert any("bogus.knob" in p for p in probs)
+    assert any("measured_s" in p for p in probs)
+    assert any("dtype" in p for p in probs)
+    assert any("unparseable" in p for p in probs)
+
+
+def test_autotune_cli_check_and_show(tmp_path, capsys):
+    """tools/autotune.py: check exits 0/1 (incl. the --check alias),
+    show/export/prune-report read the artifacts back."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import autotune
+    _db, path = _mk_db(tmp_path, [
+        ("potrf", 64, {"nb": 16, "sweep.lookahead": 1}, 1e-3)])
+    assert autotune.main(["check", "--db", path]) == 0
+    assert autotune.main(["--check", "--db", path]) == 0
+    assert autotune.main(["show", "--db", path]) == 0
+    out = capsys.readouterr().out
+    assert "potrf|n=64|float32|g1x1" in out and "nb=16" in out
+    exp = str(tmp_path / "export.json")
+    assert autotune.main(["export", "--db", path, "--out", exp]) == 0
+    assert json.load(open(exp))["schema"] == tdb.TUNE_DB_SCHEMA
+    # a sweep report next to the DB feeds prune-report
+    with open(path + ".sweep.json", "w") as f:
+        json.dump({"keys": [{"key": "potrf|n=64|float32|g1x1",
+                             "pruned": [{"config": {"nb": 4},
+                                         "expected_s": 1.0,
+                                         "incumbent_s": 0.1,
+                                         "margin": 0.25}]}]}, f)
+    assert autotune.main(["prune-report", "--db", path]) == 0
+    assert "pruned" in capsys.readouterr().out
+    # stale vintage fails the check gate
+    with open(path, "w") as f:
+        json.dump({"schema": 0, "entries": {}}, f)
+    assert autotune.main(["check", "--db", path]) == 1
+
+
+def test_nearest_key_interpolation(tmp_path):
+    db, _ = _mk_db(tmp_path, [
+        ("potrf", 64, {"nb": 16}, 1e-3),
+        ("potrf", 256, {"nb": 64}, 4e-3),
+        ("getrf", 96, {"nb": 32}, 2e-3)])
+    e, src = db.lookup("potrf", 64, "float32", (1, 1))
+    assert src == "db" and e["knobs"]["nb"] == 16
+    # log-nearest: 96 is closer to 64 than to 256
+    e, src = db.lookup("potrf", 96, "float32", (1, 1))
+    assert src == "interpolated" and e["n"] == 64
+    e, src = db.lookup("potrf", 200, "float32", (1, 1))
+    assert src == "interpolated" and e["n"] == 256
+    # wrong dtype / grid / op: no neighbor
+    assert db.lookup("potrf", 96, "float64", (1, 1)) == (None,
+                                                         "default")
+    assert db.lookup("potrf", 96, "float32", (2, 2)) == (None,
+                                                         "default")
+    assert db.lookup("geqrf", 96, "float32", (1, 1)) == (None,
+                                                         "default")
+
+
+def test_consult_resolves_env_tier(tmp_path, monkeypatch, capsys):
+    _db, path = _mk_db(tmp_path, [("potrf", 64, {"nb": 16}, 1e-3)])
+    monkeypatch.setenv("DPLASMA_TUNE_DB", path)
+    entry, src, key, p = tdb.consult("potrf", 64, "float32", (1, 1))
+    assert src == "db" and p == path and entry["knobs"]["nb"] == 16
+    # no DB anywhere: inert default
+    monkeypatch.delenv("DPLASMA_TUNE_DB")
+    entry, src, _key, p = tdb.consult("potrf", 64, "float32", (1, 1))
+    assert (entry, src, p) == (None, "default", None)
+    # an unreadable DB degrades to default with a note, never raises
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write("{not json")
+    monkeypatch.setenv("DPLASMA_TUNE_DB", bad)
+    entry, src, _key, _p = tdb.consult("potrf", 64, "float32", (1, 1))
+    assert (entry, src) == (None, "default")
+
+
+def test_appliable_precedence(monkeypatch):
+    """CLI/programmatic override > env > DB: pinned keys are dropped
+    from what a consultation may apply."""
+    knobs = {"nb": 32, "grid": "1x1", "sweep.lookahead": 2,
+             "qr.agg_depth": 8, "panel.kernel": "tree",
+             "panel.qr": "tree"}
+    monkeypatch.setenv("DPLASMA_MCA_QR_AGG_DEPTH", "4")
+    with config.override_scope({"panel.kernel": "chain"}):
+        out = tdb.appliable(knobs, skip=("sweep.lookahead",))
+        # nb/grid are structural, panel.qr is provenance-only, the
+        # env pins qr.agg_depth, the override pins panel.kernel, and
+        # the caller pinned sweep.lookahead
+        assert out == {}
+        assert tdb.appliable(knobs) == {"sweep.lookahead": 2}
+
+
+# ---------------------------------------------------------------------
+# Search: candidates, pruning, winner, re-tune gate
+# ---------------------------------------------------------------------
+
+def test_candidate_configs_default_first():
+    cands = search.candidate_configs("potrf", 256, nbs=[32, 64],
+                                     lookaheads=[0, 1])
+    assert cands[0] == {"nb": search.default_nb(256),
+                        "sweep.lookahead": 1}
+    assert len(cands) == len({search.canonical(c) for c in cands})
+    assert {c["nb"] for c in cands} >= {32, 64}
+
+
+def test_candidate_default_uses_ops_own_agg_knob():
+    """The default-first candidate records the OP'S aggregation knob
+    (lu.agg_depth for LU ops, not the QR resolution), so the
+    'no worse than out-of-the-box' baseline is the real default and
+    the dedup recognizes a user-listed default value."""
+    cands = search.candidate_configs("getrf", 256, nbs=[64],
+                                     agg_depths=[4, 2])
+    assert cands[0]["lu.agg_depth"] == config.mca_get_int(
+        "lu.agg_depth", -1) == 4
+    assert "qr.agg_depth" not in cands[0]
+    # nb=64 x agg=4 equals the default-first candidate -> deduped
+    assert sum(1 for c in cands
+               if c["nb"] == 64 and c["lu.agg_depth"] == 4) == 1
+    qr = search.candidate_configs("geqrf", 256, nbs=[64],
+                                  agg_depths=[2])
+    assert qr[0]["qr.agg_depth"] == config.mca_get_int(
+        "qr.agg_depth", -1)
+
+
+def test_expected_seconds_dominates_tiny_tiles():
+    """The analytic bound must rank a pathologically small tile size
+    above a sane one (its dispatch ladder is latency-bound) — the
+    property the pruning rule exploits."""
+    e4 = search.expected_config_seconds(
+        "potrf", 256, "float32", {"nb": 4, "sweep.lookahead": 1})
+    e64 = search.expected_config_seconds(
+        "potrf", 256, "float32", {"nb": 64, "sweep.lookahead": 1})
+    assert e4 > 2.0 * e64
+
+
+def test_roofline_prune_skips_dominated_config(tmp_path):
+    """The dominated config is pruned UNMEASURED (and logged in the
+    prune report); the counterfactual sweep with pruning off measures
+    it."""
+    e64 = search.expected_config_seconds(
+        "potrf", 256, "float32", {"nb": 64, "sweep.lookahead": 1})
+    measured = []
+
+    def fake_measure(op, n, dtype, grid, cfg, nruns):
+        measured.append(dict(cfg))
+        # every trial "measures" exactly the sane config's bound, so
+        # the dominated config's bound exceeds it past any margin
+        return e64, 1.0, tdb.resolved_knobs(nb=cfg["nb"], grid=grid)
+
+    dbp = str(tmp_path / "db.json")
+    rep = search.sweep(
+        ["potrf"], [256], dtype="float32", grid=(1, 1), db_file=dbp,
+        nbs=[4, 64], lookaheads=[1], margin=0.25,
+        measure_fn=fake_measure, log=lambda s: None)
+    krep = rep["keys"][0]
+    assert any(p["config"]["nb"] == 4 for p in krep["pruned"])
+    assert all(c["nb"] != 4 for c in measured)
+    assert krep["decision"] == "stored"
+    # counterfactual: pruning off -> the dominated config IS measured
+    measured.clear()
+    search.sweep(["potrf"], [256], dtype="float32", grid=(1, 1),
+                 db_file=str(tmp_path / "db2.json"),
+                 nbs=[4, 64], lookaheads=[1], prune=False,
+                 measure_fn=fake_measure, log=lambda s: None)
+    assert any(c["nb"] == 4 for c in measured)
+
+
+def test_winner_selection_deterministic():
+    trials = [
+        {"config": {"nb": 64}, "median_s": 1e-3, "knobs": {}},
+        {"config": {"nb": 16}, "median_s": 1e-3, "knobs": {}},
+        {"config": {"nb": 32}, "median_s": 2e-3, "knobs": {}},
+    ]
+    import random
+    for _ in range(5):
+        shuffled = list(trials)
+        random.shuffle(shuffled)
+        w = search.select_winner(shuffled)
+        # equal medians: the canonical knob-vector order breaks the
+        # tie the same way every time
+        assert w["config"] == {"nb": 16}
+    assert search.select_winner([]) is None
+
+
+def test_retune_gate_blocks_silent_regression(tmp_path):
+    """A DB refresh whose winner regresses past threshold keeps the
+    stored winner (perfdiff-gated) unless forced."""
+    prior = {"measured_s": 1e-3}
+    worse = {"config": {"nb": 8}, "median_s": 2e-3, "gflops": 0.5,
+             "knobs": {"nb": 8}}
+    ok, res = search.retune_gate("k", prior, worse, threshold=0.10)
+    assert not ok and res is not None
+    assert search.retune_gate("k", prior, worse, force=True) == (True,
+                                                                 None)
+    better = dict(worse, median_s=0.9e-3)
+    ok, _res = search.retune_gate("k", prior, better, threshold=0.10)
+    assert ok
+    # end-to-end through sweep(): the stored entry survives the bad
+    # re-sweep, and --force replaces it
+    dbp = str(tmp_path / "db.json")
+    db = tdb.TuningDB()
+    db.put("potrf", 64, "float32", (1, 1), {"nb": 16}, 1e-3)
+    db.save(dbp)
+
+    def slow_measure(op, n, dtype, grid, cfg, nruns):
+        return 5e-3, 0.1, tdb.resolved_knobs(nb=cfg["nb"], grid=grid)
+
+    rep = search.sweep(["potrf"], [64], dtype="float32", grid=(1, 1),
+                       db_file=dbp, nbs=[16], lookaheads=[1],
+                       prune=False, measure_fn=slow_measure,
+                       log=lambda s: None)
+    assert rep["keys"][0]["decision"] == "kept-prior"
+    e = tdb.TuningDB.load(dbp).get("potrf", 64, "float32", (1, 1))
+    assert e["measured_s"] == pytest.approx(1e-3)
+    rep = search.sweep(["potrf"], [64], dtype="float32", grid=(1, 1),
+                       db_file=dbp, nbs=[16], lookaheads=[1],
+                       prune=False, measure_fn=slow_measure,
+                       force=True, log=lambda s: None)
+    assert rep["keys"][0]["decision"] == "stored"
+    e = tdb.TuningDB.load(dbp).get("potrf", 64, "float32", (1, 1))
+    assert e["measured_s"] == pytest.approx(5e-3)
+
+
+def test_trial_ledger_doc_knob_vector_and_tuning_mark(tmp_path):
+    """Every trial's ledger entry carries the FULL resolved knob
+    vector and the explicit tuning mark; a production (non-tuning)
+    gate never baselines against it."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools import perfdiff
+    knobs = tdb.resolved_knobs(nb=16, grid=(1, 1))
+    for name in tdb.KNOB_NAMES:
+        assert name in knobs
+    doc = search.trial_ledger_doc("potrf", 64, "float32", "k", knobs,
+                                  1e-3, 5.0, {"nb": 16})
+    assert doc["tuning"] is True
+    assert doc["pipeline"]["nb"] == 16
+    assert doc["ladder"][0]["nb"] == 16
+    ledger = str(tmp_path / "h.jsonl")
+    good = {"ladder": [{"metric": "tune_potrf_float32_n64",
+                        "value": 9.0}]}
+    perfdiff.append_ledger(ledger, good)
+    perfdiff.append_ledger(ledger, doc)
+    # a non-tuning candidate sharing the metric family skips the
+    # exploration trial and baselines on the production entry
+    cand = {"ladder": [{"metric": "tune_potrf_float32_n64",
+                        "value": 8.0}]}
+    assert perfdiff.latest_comparable_entry(ledger, cand) == good
+    # a tuning candidate may baseline against its own kind
+    assert perfdiff.latest_comparable_entry(
+        ledger, dict(cand, tuning=True))["tuning"] is True
+
+
+# ---------------------------------------------------------------------
+# Real measurement + driver/serving consultation e2e (CPU mesh)
+# ---------------------------------------------------------------------
+
+def test_measure_config_real_runs_op():
+    med, gf, knobs = search.measure_config(
+        "potrf", 32, "float32", (1, 1),
+        {"nb": 16, "sweep.lookahead": 0}, nruns=2)
+    assert med > 0 and gf > 0
+    assert knobs["nb"] == 16 and knobs["grid"] == "1x1"
+    assert knobs["sweep.lookahead"] == 0
+    # the trial's scoped overrides are fully restored
+    assert "sweep.lookahead" not in config._MCA_OVERRIDES
+    assert config.override_depth() == 0
+
+
+def test_gemm_candidates_collapse_the_nb_axis():
+    """The gemm path is ONE XLA dot (nb-invariant — XLA owns its
+    tiling): sweeping nb would time identical programs and store a
+    noise-selected tile size. The candidate space collapses nb to
+    the default, and the trial itself runs the real ops.blas3 gemm."""
+    import jax
+    cands = search.candidate_configs("gemm", 256, nbs=[32, 64, 128],
+                                     lookaheads=[1])
+    assert {c["nb"] for c in cands} == {search.default_nb(256)}
+    f, args, fl = search._trial_problem("gemm", 32, 16, np.float32)
+    assert fl == pytest.approx(2.0 * 32 ** 3)
+    out = np.asarray(jax.jit(f)(*args))
+    want = 0.51 * np.asarray(args[0]) @ np.asarray(args[1]) \
+        - 0.42 * np.asarray(args[2])
+    assert np.allclose(out, want, atol=1e-3)
+
+
+def _seed_db(tmp_path, monkeypatch, op="potrf", n=32, knobs=None,
+             measured_s=1e-3):
+    db = tdb.TuningDB()
+    db.put(op, n, "float32", (1, 1),
+           knobs or {"nb": 16, "sweep.lookahead": 0,
+                     "qr.agg_depth": 2}, measured_s, gflops=1.0)
+    path = str(tmp_path / "tune_db.json")
+    db.save(path)
+    monkeypatch.setenv("DPLASMA_TUNE_DB", path)
+    return path
+
+
+def test_driver_autotune_consults_db(tmp_path, monkeypatch):
+    """--autotune e2e: the DB winner steers the run (tile size + MCA
+    knobs), the v11 report names the provenance, and the scoped
+    overrides restore at close."""
+    from dplasma_tpu.drivers import main as drv_main
+    _seed_db(tmp_path, monkeypatch)
+    before = dict(config._MCA_OVERRIDES)
+    rj = str(tmp_path / "r.json")
+    rc = drv_main(["-N", "32", "--autotune", f"--report={rj}"],
+                  prog="testing_spotrf")
+    assert rc == 0
+    assert config._MCA_OVERRIDES == before
+    doc = json.load(open(rj))
+    assert doc["schema"] == 11
+    t = doc["tuning"][0]
+    assert t["source"] == "db"
+    assert t["key"] == tdb.make_key("potrf", 32, "float32", (1, 1))
+    assert t["nb"] == 16 and t["applied"]["sweep.lookahead"] == 0
+    assert doc["pipeline"]["tuning.source"] == "db"
+    assert doc["pipeline"]["sweep.lookahead"] == 0
+    assert doc["iparam"]["NB"] == 16
+    assert any(m["name"] == "tuning_consults_total"
+               and m["labels"].get("source") == "db"
+               for m in doc["metrics"])
+
+
+def test_driver_autotune_interpolates_unmeasured_shape(tmp_path,
+                                                       monkeypatch):
+    from dplasma_tpu.drivers import main as drv_main
+    _seed_db(tmp_path, monkeypatch, n=64)
+    rj = str(tmp_path / "r.json")
+    rc = drv_main(["-N", "48", "--autotune", f"--report={rj}"],
+                  prog="testing_spotrf")
+    assert rc == 0
+    t = json.load(open(rj))["tuning"][0]
+    assert t["source"] == "interpolated"
+    assert t["key"] == tdb.make_key("potrf", 48, "float32", (1, 1))
+    assert t["entry_key"] == tdb.make_key("potrf", 64, "float32",
+                                          (1, 1))
+    assert t["nb"] == 16
+
+
+def test_driver_autotune_clamps_oversized_neighbor_nb(tmp_path,
+                                                      monkeypatch):
+    """An interpolated neighbor measured at a much larger n must not
+    apply a tile wider than this problem (the generators pad to the
+    tile boundary — a 192-wide tile at N=64 times a 3x-padded run)."""
+    from dplasma_tpu.drivers import main as drv_main
+    _seed_db(tmp_path, monkeypatch, n=8192, knobs={"nb": 192})
+    rj = str(tmp_path / "r.json")
+    rc = drv_main(["-N", "64", "--autotune", f"--report={rj}"],
+                  prog="testing_spotrf")
+    assert rc == 0
+    doc = json.load(open(rj))
+    assert doc["tuning"][0]["source"] == "interpolated"
+    assert doc["tuning"][0]["nb"] == 64
+    assert doc["iparam"]["NB"] == 64
+
+
+def test_driver_autotune_cli_beats_db(tmp_path, monkeypatch):
+    """Precedence: explicit -t and --lookahead beat the DB winner;
+    the DB's remaining knobs still apply."""
+    from dplasma_tpu.drivers import main as drv_main
+    _seed_db(tmp_path, monkeypatch)
+    rj = str(tmp_path / "r.json")
+    rc = drv_main(["-N", "32", "-t", "8", "--lookahead", "1",
+                   "--autotune", f"--report={rj}"],
+                  prog="testing_spotrf")
+    assert rc == 0
+    doc = json.load(open(rj))
+    t = doc["tuning"][0]
+    assert t["source"] == "db"
+    assert t["nb"] is None                      # -t pinned the tile
+    assert "sweep.lookahead" not in t["applied"]
+    assert doc["iparam"]["NB"] == 8
+    assert doc["pipeline"]["sweep.lookahead"] == 1
+    assert doc["pipeline"]["qr.agg_depth"] == 2  # DB knob applied
+
+
+def test_driver_autotune_without_db_is_inert(tmp_path, monkeypatch):
+    from dplasma_tpu.drivers import main as drv_main
+    monkeypatch.delenv("DPLASMA_TUNE_DB", raising=False)
+    rj = str(tmp_path / "r.json")
+    rc = drv_main(["-N", "32", "--autotune", f"--report={rj}"],
+                  prog="testing_spotrf")
+    assert rc == 0
+    doc = json.load(open(rj))
+    t = doc["tuning"][0]
+    assert t["source"] == "default" and t["knobs"] is None
+    assert doc["pipeline"]["tuning.source"] == "default"
+
+
+def test_serving_consults_tuning_db(tmp_path, monkeypatch):
+    """The serving hook: SolverService resolves per-key knobs from
+    the DB at dispatch (op class, shape bucket) and records the
+    consultation in its summary."""
+    from dplasma_tpu.serving.service import SolverService
+    # posv maps to the potrf op class; n=6 buckets to 8
+    _seed_db(tmp_path, monkeypatch, n=8, knobs={"nb": 4})
+    rng = np.random.default_rng(3872)
+    n = 6
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    a = g @ g.T + n * np.eye(n, dtype=np.float32)
+    b = rng.standard_normal((n, 2)).astype(np.float32)
+    svc = SolverService(nb=8, max_wait_ms=0)
+    try:
+        x = svc.submit("posv", a, b).result(timeout=60)
+    finally:
+        svc.close()
+    assert np.allclose(a @ x, b, atol=1e-3)
+    s = svc.summary()
+    assert s["tuning"]["sources"].get("db", 0) >= 1
+    assert "sweep.lookahead" not in config._MCA_OVERRIDES
+
+
+def test_serving_tuning_concurrent_dispatch_no_leak(tmp_path,
+                                                    monkeypatch):
+    """Concurrent dispatches (caller + timer threads) under an active
+    tuning DB must never interleave their override frames: the scope
+    is serialized, every request resolves, and the global override
+    store ends exactly where it started."""
+    from dplasma_tpu.serving.service import SolverService
+    _seed_db(tmp_path, monkeypatch, n=8,
+             knobs={"nb": 4, "sweep.lookahead": 1})
+    _seed = tdb.TuningDB.load(os.environ["DPLASMA_TUNE_DB"])
+    _seed.put("potrf", 12, "float32", (1, 1),
+              {"nb": 4, "sweep.lookahead": 1}, 1e-3)
+    _seed.save(os.environ["DPLASMA_TUNE_DB"])
+    rng = np.random.default_rng(3872)
+    before = dict(config._MCA_OVERRIDES)
+    svc = SolverService(nb=8, max_batch=2, max_wait_ms=1)
+    futs = []
+    try:
+        for n in (6, 6, 10, 10, 6, 10):   # two distinct cache keys
+            g = rng.standard_normal((n, n)).astype(np.float32)
+            a = g @ g.T + n * np.eye(n, dtype=np.float32)
+            b = rng.standard_normal((n, 1)).astype(np.float32)
+            futs.append((a, b, svc.submit("posv", a, b)))
+        for a, b, f in futs:
+            x = f.result(timeout=120)
+            assert np.allclose(a @ x, b, atol=1e-3)
+    finally:
+        svc.close()
+    assert config._MCA_OVERRIDES == before
+    assert config.override_depth() == 0
+
+
+def test_serving_tuning_off_switch(tmp_path, monkeypatch):
+    from dplasma_tpu.serving.service import SolverService
+    _seed_db(tmp_path, monkeypatch, n=8, knobs={"nb": 4})
+    rng = np.random.default_rng(3872)
+    n = 6
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    a = g @ g.T + n * np.eye(n, dtype=np.float32)
+    b = rng.standard_normal((n, 2)).astype(np.float32)
+    with config.override_scope({"tune.serving": "off"}):
+        svc = SolverService(nb=8, max_wait_ms=0)
+        try:
+            svc.submit("posv", a, b).result(timeout=60)
+        finally:
+            svc.close()
+        assert svc.summary()["tuning"] is None
+
+
+@pytest.mark.slow
+def test_sweep_e2e_acceptance(tmp_path, monkeypatch):
+    """The acceptance loop on the CPU mesh: a real sweep over >= 2
+    ops x >= 3 configs persists winners, the prune report logs at
+    least one analytically-dominated config, and a subsequent
+    --autotune driver run consults the DB with a median no worse
+    than the default-config run (modulo timing noise slack)."""
+    from dplasma_tpu.drivers import main as drv_main
+    dbp = str(tmp_path / "tune_db.json")
+    hist = str(tmp_path / "hist.jsonl")
+    n = 64
+    rep = search.sweep(["potrf", "getrf"], [n], dtype="float32",
+                       grid=(1, 1), db_file=dbp, nbs=[4, 16, 32],
+                       lookaheads=[1], nruns=3, history=hist,
+                       log=lambda s: None)
+    db = tdb.TuningDB.load(dbp)
+    assert len(db.entries) == 2 and db.check() == []
+    # the nb=4 dispatch ladder is latency-dominated at n=64: at least
+    # one config must have been pruned unmeasured across the sweep
+    assert sum(len(k["pruned"]) for k in rep["keys"]) >= 1
+    # every measured trial landed in the ledger, tuning-marked, with
+    # its knob vector
+    entries = [json.loads(ln) for ln in open(hist)]
+    assert entries and all(e["tuning"] and "nb" in e["pipeline"]
+                           for e in entries)
+    monkeypatch.setenv("DPLASMA_TUNE_DB", dbp)
+
+    def _median(args, prog):
+        rj = str(tmp_path / "bench_r.json")
+        rc = drv_main(args + [f"--report={rj}", "--nruns", "5"],
+                      prog=prog)
+        assert rc == 0
+        doc = json.load(open(rj))
+        return doc, doc["ops"][0]["timings"]["median_s"]
+
+    doc, tuned = _median(["-N", str(n), "--autotune"],
+                         "testing_spotrf")
+    assert doc["tuning"][0]["source"] == "db"
+    _doc, default = _median(["-N", str(n)], "testing_spotrf")
+    assert tuned <= default * 1.5   # noise slack; the winner beat or
+    #                                 matched the default when measured

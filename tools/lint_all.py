@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Eight passes, in increasing cost order:
+Nine passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
@@ -35,7 +35,13 @@ Eight passes, in increasing cost order:
    counts EXACTLY matching the jaxpr-level schedule (a
    GSPMD-inserted hidden collective fails here before it ever ships
    to hardware), and one serving batched executable must audit clean
-   (donation/precision/anti-patterns).
+   (donation/precision/anti-patterns);
+9. a ``dplasma_tpu.tuning`` smoke pass — a tiny 2-config dpotrf
+   sweep on the 1x1 grid must persist a winner to a fresh tuning DB,
+   the DB must read back clean (``TuningDB.check``), and a
+   subsequent driver ``--autotune`` run must provably consult it
+   (v11 ``"tuning"`` report section: source ``db``, the winner's
+   tile size applied, scoped overrides restored at close).
 
 Usage: ``python tools/lint_all.py`` — prints ``file:line: message``
 per violation / one line per failed smoke case, exits nonzero on any.
@@ -428,6 +434,87 @@ def run_hlocheck_smoke() -> int:
     return bad
 
 
+def run_tune_smoke() -> int:
+    """The autotuner's closed loop, CPU-fast: a tiny 2-config dpotrf
+    sweep persists a winner into a fresh DB, the DB reads back clean
+    against the current schema, and a driver ``--autotune`` run
+    consults it — the v11 report section names source ``db``, the
+    winner's tile size lands in the parameter block, and the scoped
+    MCA overrides are fully restored after close."""
+    import json as _json
+    import tempfile
+
+    import jax
+
+    from dplasma_tpu.tuning import TuningDB, make_key, search
+    from dplasma_tpu.utils import config as _cfg
+
+    if not jax.config.jax_compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(_ROOT / ".jax_cache"))
+    bad = 0
+    with tempfile.TemporaryDirectory() as td:
+        dbp = f"{td}/tune_db.json"
+        search.sweep(["potrf"], [32], dtype="float32", grid=(1, 1),
+                     db_file=dbp, nbs=[8, 16], lookaheads=[1],
+                     prune=False, nruns=2, log=lambda s: None)
+        try:
+            db = TuningDB.load(dbp)
+        except (OSError, ValueError) as exc:
+            sys.stderr.write(f"tune-smoke: DB unreadable: {exc}\n")
+            return 1
+        key = make_key("potrf", 32, "float32", (1, 1))
+        entry = db.entries.get(key)
+        if entry is None:
+            sys.stderr.write(f"tune-smoke: no winner stored for "
+                             f"{key}\n")
+            return 1
+        problems = db.check()
+        if problems:
+            sys.stderr.write("tune-smoke: DB check: "
+                             + "; ".join(problems) + "\n")
+            bad += len(problems)
+        # the winner must steer a driver run (env tier of tune.db)
+        from dplasma_tpu.drivers import main as drv_main
+        rj = f"{td}/r.json"
+        before = dict(_cfg._MCA_OVERRIDES)
+        prev_db = os.environ.get("DPLASMA_TUNE_DB")
+        os.environ["DPLASMA_TUNE_DB"] = dbp
+        try:
+            rc = drv_main(["-N", "32", "--autotune",
+                           f"--report={rj}"],
+                          prog="testing_spotrf")
+        finally:
+            # restore, don't pop: the gate may run in-process (pytest)
+            # where a user's own DB pin must survive it
+            if prev_db is None:
+                os.environ.pop("DPLASMA_TUNE_DB", None)
+            else:
+                os.environ["DPLASMA_TUNE_DB"] = prev_db
+        if rc != 0:
+            sys.stderr.write(f"tune-smoke: --autotune driver run "
+                             f"exited {rc}\n")
+            return bad + 1
+        if _cfg._MCA_OVERRIDES != before:
+            sys.stderr.write("tune-smoke: driver leaked MCA "
+                             "overrides after close\n")
+            bad += 1
+        with open(rj) as f:
+            doc = _json.load(f)
+        tune = (doc.get("tuning") or [{}])[0]
+        if tune.get("source") != "db" or tune.get("key") != key:
+            sys.stderr.write(f"tune-smoke: report tuning section "
+                             f"did not consult the DB: {tune}\n")
+            bad += 1
+        nb = (entry.get("knobs") or {}).get("nb")
+        if nb and (doc.get("iparam") or {}).get("NB") != nb:
+            sys.stderr.write("tune-smoke: winner tile size "
+                             f"nb={nb} not applied "
+                             f"(NB={(doc.get('iparam') or {}).get('NB')})\n")
+            bad += 1
+    return bad
+
+
 def main(argv=None) -> int:
     pkg = _ROOT / "dplasma_tpu"
     bad = 0
@@ -438,7 +525,8 @@ def main(argv=None) -> int:
                      ("dagcheck-smoke", run_dagcheck_smoke),
                      ("spmdcheck-smoke", run_spmdcheck_smoke),
                      ("serving-smoke", run_serving_smoke),
-                     ("hlocheck-smoke", run_hlocheck_smoke)):
+                     ("hlocheck-smoke", run_hlocheck_smoke),
+                     ("tune-smoke", run_tune_smoke)):
         n = fn()
         print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")
         bad += n

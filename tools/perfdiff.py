@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v10), the bench
+(``--report`` from any driver, any schema vintage v1-v11), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -62,20 +62,28 @@ def latest_ledger_entry(path: str) -> Optional[dict]:
 def latest_comparable_entry(path: str, doc: dict) -> Optional[dict]:
     """Newest ledger entry sharing at least one comparable metric with
     ``doc``. Several bench families (bench.py's ladder, servebench's
-    serving.* metrics) may share one ledger; a gate that baselines
-    against the raw newest entry would compare across families and
-    pass informationally forever. Among shared-metric entries, one
-    whose ``"pipeline"`` section (lookahead/aggregation shape AND the
-    panel-engine strategy) matches the candidate's is preferred: a
+    serving.* metrics, the autotuner's trial entries) may share one
+    ledger; a gate that baselines against the raw newest entry would
+    compare across families and pass informationally forever. Among
+    shared-metric entries, one whose ``"pipeline"`` section (since
+    v11 the FULL resolved knob vector — lookahead/aggregation shape,
+    every panel.* knob, grid) matches the candidate's is preferred: a
     chain-panel rerun interleaved after a tree-panel run must not
-    silently become the tree run's baseline — strategy flips compare
-    same-vs-same when the ledger has a same-strategy entry, and only
-    fall back to the newest same-family entry when it does not. With
-    no shared-metric entry (or a candidate with no metrics at all)
-    this falls back to the newest raw entry, preserving the callers'
-    vacuous-gate handling."""
+    silently become the tree run's baseline — knob-vector flips
+    compare same-vs-same when the ledger has a same-vector entry, and
+    only fall back to the newest same-family entry when it does not.
+    Autotuner exploration trials mark themselves ``"tuning": true``
+    (deliberately-bad configs measured to be rejected): a candidate
+    that is NOT itself a tuning trial never baselines against one.
+    With no shared-metric entry (or a candidate with no metrics at
+    all) this falls back to the newest raw non-tuning entry,
+    preserving the callers' vacuous-gate handling."""
     want = set(extract_metrics(doc))
     pipe = doc.get("pipeline")
+    # the trial MARKER is the literal `true` — a v11 run-report's
+    # "tuning" section (a list of consultation records) does not make
+    # the document an exploration trial
+    tuning_doc = doc.get("tuning") is True
     best = best_pipe = last = None
     with open(path) as f:
         for line in f:
@@ -86,6 +94,10 @@ def latest_comparable_entry(path: str, doc: dict) -> Optional[dict]:
             except ValueError:
                 continue
             if not isinstance(entry, dict):
+                continue
+            if entry.get("tuning") is True and not tuning_doc:
+                # a production gate must never baseline against a
+                # deliberately-bad exploration trial
                 continue
             last = entry
             if want & set(extract_metrics(entry)):
