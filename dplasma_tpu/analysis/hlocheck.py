@@ -83,12 +83,20 @@ _HLO_COLLECTIVES = {
 }
 
 #: jaxpr collective kind (spmdcheck) -> the HLO opcode it lowers to
-#: (psum/pmax/pmin all become all-reduce with different reducers)
+#: (psum/pmax/pmin all become all-reduce with different reducers).
+#: The explicit ICI-ring kernels (kernels.pallas_ring, counted by
+#: spmdcheck as ring_bcast/ring_shift) lower to Mosaic custom-calls
+#: carrying the ``dplasma_ring_`` marker — reconciled as "ring-dma"
+#: (the async-remote-copy leg of the collective reconciliation).
 _JAXPR_TO_HLO = {
     "psum": "all-reduce", "pmax": "all-reduce", "pmin": "all-reduce",
     "all_gather": "all-gather", "reduce_scatter": "reduce-scatter",
     "ppermute": "collective-permute", "all_to_all": "all-to-all",
+    "ring_bcast": "ring-dma", "ring_shift": "ring-dma",
 }
+
+#: marker identifying a ring kernel's custom-call in compiled HLO text
+_RING_MARKER = "dplasma_ring_"
 
 #: repo-relative module suffixes whose converts are the AUTHORIZED
 #: precision ladder: the dd/limb emulation (f64 <-> f32 limb splits
@@ -203,6 +211,10 @@ class HloModule:
             kind = _HLO_COLLECTIVES.get(o.opcode)
             if kind:
                 c[kind] += 1
+            elif o.opcode == "custom-call" and _RING_MARKER in o.line:
+                # a Mosaic-lowered explicit ICI-ring kernel: wire
+                # traffic exactly like the named collectives
+                c["ring-dma"] += 1
         return dict(c)
 
 
@@ -455,15 +467,19 @@ def check_collectives(mod: HloModule, res: HloResult,
                                 "model": n})
 
 
-def model_counts(op: Optional[str], KT: int,
-                 lookahead: int = 0) -> Optional[Dict[str, int]]:
+def model_counts(op: Optional[str], KT: int, lookahead: int = 0,
+                 ring: bool = False,
+                 grid: Tuple[int, int] = (1, 1)
+                 ) -> Optional[Dict[str, int]]:
     """Per-HLO-kind collective counts the analytic comm model prices
     for one cyclic kernel (spmdcheck's per-(kind, axis) table,
-    collapsed through the same lowering map)."""
+    collapsed through the same lowering map). ``ring``/``grid``
+    select the explicit ICI-ring schedule's table — its ring classes
+    land on the "ring-dma" kind the custom-call counter produces."""
     from dplasma_tpu.analysis import spmdcheck as sp
     if not op or KT <= 0:
         return None
-    exp = sp.expected_counts(op, KT, lookahead)
+    exp = sp.expected_counts(op, KT, lookahead, ring=ring, grid=grid)
     if exp is None:
         return None
     c: Counter = Counter()
@@ -666,6 +682,8 @@ def check_executable(lowered, compiled, kernel: str = "", *,
                      schedule=None, exact: bool = True,
                      op: Optional[str] = None, KT: int = 0,
                      lookahead: int = 0, prec: str = "s",
+                     ring: bool = False,
+                     grid: Tuple[int, int] = (1, 1),
                      xla_info: Optional[dict] = None,
                      hbm_budget: Optional[int] = None,
                      copy_frac: Optional[float] = None) -> HloResult:
@@ -685,7 +703,8 @@ def check_executable(lowered, compiled, kernel: str = "", *,
     expected = schedule_counts(schedule) if schedule is not None \
         else None
     check_collectives(mod, res, expected, exact=exact,
-                      model=model_counts(op, KT, lookahead))
+                      model=model_counts(op, KT, lookahead,
+                                         ring=ring, grid=grid))
     check_precision(mod, res, PREC_BITS.get(prec, 32))
     requests = donation_requests(lowered) if lowered is not None \
         else []

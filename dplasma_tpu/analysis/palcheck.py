@@ -500,12 +500,32 @@ def _cap_pallas_dd(out: List[PallasContract]) -> None:
                                               True)
 
 
+def _cap_pallas_ring(out: List[PallasContract]) -> None:
+    """kernels/pallas_ring.py: the ICI ring transfer kernels — the
+    chunked panel-broadcast ring and the neighbor shift (whole-array
+    ANY-space blocks, DMA-semaphore scratch, no grid). Capture only
+    records the contract; the remote-DMA kernel bodies never run."""
+    import jax.numpy as jnp
+    from dplasma_tpu.kernels import pallas_ring
+    x = jnp.zeros((16, 128), jnp.float32)
+    axes = (("p", 1), ("q", 4))
+    with capture("dplasma_tpu/kernels/pallas_ring.py:ring_bcast",
+                 out):
+        pallas_ring.ring_bcast(x, root=1, axis="q", axes=axes,
+                               chunks=2, interpret=True)
+    with capture("dplasma_tpu/kernels/pallas_ring.py:ring_shift",
+                 out):
+        pallas_ring.ring_shift(x, axis="q", axes=axes,
+                               interpret=True)
+
+
 #: relpath -> capture entry point exercising every pallas_call in it
 SITES = {
     "dplasma_tpu/kernels/pallas_kernels.py": _cap_pallas_kernels,
     "dplasma_tpu/kernels/pallas_lu.py": _cap_pallas_lu,
     "dplasma_tpu/kernels/pallas_qr.py": _cap_pallas_qr,
     "dplasma_tpu/kernels/pallas_dd.py": _cap_pallas_dd,
+    "dplasma_tpu/kernels/pallas_ring.py": _cap_pallas_ring,
 }
 
 

@@ -58,6 +58,28 @@ _COLLECTIVE_PRIMS = {
 #: pbroadcast is shard_map's replication bookkeeping, not wire traffic
 _IGNORED_PRIMS = {"pbroadcast"}
 
+#: pallas_call name prefix marking an explicit ICI-ring kernel
+#: (kernels.pallas_ring): ``dplasma_ring_{bcast|shift}_{axis}``. These
+#: are wire traffic exactly like the named collectives — the walk
+#: counts them as kind ``ring_bcast``/``ring_shift`` over their axis.
+_RING_PREFIX = "dplasma_ring_"
+
+
+def _ring_collective(eqn) -> Optional[Tuple[str, str]]:
+    """(kind, axis) of a pallas_call eqn that is a named ring kernel,
+    None otherwise. The kernel name rides the eqn's name_and_src_info
+    param (jax >= 0.4.31) or the debug name."""
+    name = str(eqn.params.get("name_and_src_info", "") or
+               eqn.params.get("name", ""))
+    name = name.split(" ", 1)[0]
+    if not name.startswith(_RING_PREFIX):
+        return None
+    rest = name[len(_RING_PREFIX):]
+    what, _, axis = rest.partition("_")
+    if what not in ("bcast", "shift") or not axis:
+        return None
+    return f"ring_{what}", axis
+
 
 class SpmdCheckError(ValueError):
     """A traced SPMD program failed collective-schedule verification."""
@@ -204,6 +226,23 @@ def _walk(jaxpr, res: SpmdResult, mesh_axes: Optional[Dict[str, int]],
             if kind == "ppermute":
                 _check_perm(col, mesh_axes, res)
             out.append(col)
+            continue
+        if name == "pallas_call":
+            rc = _ring_collective(eqn)
+            if rc is not None:
+                kind, axis = rc
+                col = Collective(kind, (axis,), mult)
+                if mesh_axes is None:
+                    res.add("unbound-axis",
+                            f"ring kernel {col.key} outside any "
+                            f"shard_map region (no mesh binds its "
+                            f"axis)")
+                elif axis not in mesh_axes:
+                    res.add("unbound-axis",
+                            f"ring kernel {col.key}: axis name "
+                            f"[{axis!r}] not bound by the mesh axes "
+                            f"{sorted(mesh_axes)}")
+                out.append(col)
             continue
         if name == "shard_map":
             mesh = eqn.params.get("mesh")
@@ -359,52 +398,83 @@ _STEP_COUNTS = {
 }
 
 
-def expected_counts(op: str, KT: int,
-                    lookahead: int = 0) -> Optional[Dict[str, int]]:
+def expected_counts(op: str, KT: int, lookahead: int = 0,
+                    ring: bool = False,
+                    grid: Tuple[int, int] = (1, 1)
+                    ) -> Optional[Dict[str, int]]:
     """Expected per-class collective counts of one cyclic kernel over
     ``KT`` panel steps. The lookahead pipeline *relocates* the panel
     broadcast (step k pre-broadcasts column k+1) but never changes
     the totals — the schedule is count-invariant in the pipeline
-    shape, which is exactly why this check can be exact."""
+    shape, which is exactly why this check can be exact.
+
+    ``ring=True`` expects the explicit ICI-ring schedule
+    (kernels.pallas_ring under MCA ``ring.enable``): the panel
+    broadcast class moves from ``psum@q`` to ``ring_bcast@q`` (one
+    ring kernel per step) and the LU winner-row exchange from
+    ``psum@p`` to ``ring_shift@p`` at P-1 hops per step — which is
+    why the ring schedule needs the ``grid`` shape (a size-1 axis
+    keeps its psum class: the kernels fall back per axis)."""
     from dplasma_tpu.parallel import mesh as pmesh
     tbl = _STEP_COUNTS.get(op)
     if tbl is None:
         return None
     axis = {"row": pmesh.ROW_AXIS, "col": pmesh.COL_AXIS}
-    return {f"{kind}@{axis[role]}": n * KT
-            for (kind, role), n in tbl.items()}
+    P, Q = int(grid[0]), int(grid[1])
+    out: Dict[str, int] = {}
+    for (kind, role), n in tbl.items():
+        key = f"{kind}@{axis[role]}"
+        cnt = n * KT
+        if ring and kind == "psum" and role == "col" and Q > 1 \
+                and op in ("potrf", "getrf", "geqrf"):
+            key, cnt = f"ring_bcast@{axis[role]}", KT
+        elif ring and op == "getrf" and kind == "psum" \
+                and role == "row" and P > 1:
+            key, cnt = f"ring_shift@{axis[role]}", KT * (P - 1)
+        out[key] = out.get(key, 0) + cnt
+    return out
 
 
-def model_classes(op: str) -> Optional[set]:
+def model_classes(op: str, ring: bool = False,
+                  grid: Tuple[int, int] = (2, 2)) -> Optional[set]:
     """The (kind, axis) collective classes the analytic comm model
     (:func:`dplasma_tpu.parallel.cyclic.spmd_comm_model`) prices for
     one op — parsed from its per-collective key names, so the checker
-    and the observability model can never drift apart silently."""
+    and the observability model can never drift apart silently. Ring
+    classes (``panel_ring_bcast_q``/``pivot_row_ring_shift_p``) parse
+    to ``ring_bcast``/``ring_shift`` kinds; the ``grid`` shape must
+    match the count table's (per-axis psum fallback)."""
     from dplasma_tpu.descriptors import Dist
     from dplasma_tpu.parallel.cyclic import CyclicDesc, spmd_comm_model
-    desc = CyclicDesc(8, 8, 4, 4, Dist(P=2, Q=2))
+    P, Q = max(int(grid[0]), 1), max(int(grid[1]), 1)
+    desc = CyclicDesc(8, 8, 4, 4, Dist(P=P, Q=Q))
     try:
-        model = spmd_comm_model(desc, op, 4)
+        model = spmd_comm_model(desc, op, 4, ring=ring)
     except KeyError:
         return None
     classes = set()
     for key in model["bytes_by_collective"]:
         base, _, axis = key.rpartition("_")
         kind = base.rsplit("_", 1)[-1]
-        kind = {"allgather": "all_gather"}.get(kind, kind)
+        kind = {"allgather": "all_gather", "bcast": "ring_bcast",
+                "shift": "ring_shift"}.get(kind, kind)
         classes.add(f"{kind}@{axis}")
     return classes
 
 
 def reconcile_counts(res: SpmdResult, op: Optional[str], KT: int,
-                     lookahead: int = 0, exact: bool = True) -> None:
+                     lookahead: int = 0, exact: bool = True,
+                     ring: bool = False,
+                     grid: Tuple[int, int] = (1, 1)) -> None:
     """Reconcile the traced collective counts against the analytic
     model: exact (``==``) for the cyclic kernels themselves,
     dominating (``>=``, conversions around them may add collectives)
     for driver programs. A class the model prices that the trace
     lacks — the dropped-psum defect — is a hard diagnostic naming the
-    kernel and the collective class."""
-    exp = expected_counts(op, KT, lookahead) if op else None
+    kernel and the collective class. ``ring``/``grid`` select the
+    explicit ICI-ring schedule's count table (kernels.pallas_ring)."""
+    exp = expected_counts(op, KT, lookahead, ring=ring, grid=grid) \
+        if op else None
     if exp is None:
         res.relation = ("no-collectives"
                         if not res.collectives else "unmodelled")
@@ -434,8 +504,11 @@ def reconcile_counts(res: SpmdResult, op: Optional[str], KT: int,
     else:
         res.relation = "==" if got == exp else ">="
     # tie to the priced model: the expected classes must be exactly
-    # what spmd_comm_model prices (guards the two models against drift)
-    mc = model_classes(op)
+    # what spmd_comm_model prices (guards the two models against
+    # drift). Strip the mesh-axis names back to the model's p/q
+    # roles via the same mapping expected_counts applied.
+    mc = model_classes(op, ring=ring,
+                       grid=grid if ring else (2, 2))
     if mc is not None and mc != set(exp):
         res.add("model-mismatch",
                 f"collective classes of the count table {sorted(exp)} "
@@ -445,14 +518,17 @@ def reconcile_counts(res: SpmdResult, op: Optional[str], KT: int,
 
 def check_kernel(fn, args, kernel: str, op: Optional[str] = None,
                  KT: int = 0, lookahead: int = 0,
-                 exact: bool = True) -> SpmdResult:
+                 exact: bool = True, ring: bool = False,
+                 grid: Tuple[int, int] = (1, 1)) -> SpmdResult:
     """Extract + verify one program's collective schedule. ``op`` (a
     comm-model op class: potrf/getrf/geqrf/gemm) and ``KT`` enable the
     count reconciliation; without them only the structural checks run.
+    ``ring``/``grid`` select the explicit ICI-ring count table.
     """
     res = extract_schedule(fn, *args, kernel=kernel)
     if op is not None and KT > 0:
-        reconcile_counts(res, op, KT, lookahead, exact=exact)
+        reconcile_counts(res, op, KT, lookahead, exact=exact,
+                         ring=ring, grid=grid)
     elif not res.collectives:
         res.relation = "no-collectives"
     else:

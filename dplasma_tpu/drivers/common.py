@@ -541,7 +541,9 @@ class Driver:
                 "panel.tree_leaf": _cfg.mca_get_int(
                     "panel.tree_leaf", 2),
                 "panel.rec_base": _cfg.mca_get_int(
-                    "panel.rec_base", 8)}
+                    "panel.rec_base", 8),
+                "ring.enable": (_cfg.mca_get("ring.enable")
+                                or "auto")}
             if self.tuning is not None:
                 self.pipeline["tuning.source"] = self.tuning["source"]
                 self.report.add_tuning(self.tuning)
@@ -772,8 +774,24 @@ class Driver:
         # structure is actually on the wire: a cyclic shard_map
         # program (schedule has collectives) of a modelled op class
         op, KT = None, 0
+        ring = False
         if schedule is not None and schedule.collectives:
             op, KT = _model_op_kt(_algo_of(self.name), ip)
+            if op is not None:
+                # the model leg must price the schedule the kernels
+                # resolved: THE SAME gate the cyclic wrappers consult
+                # (cyclic._cyclic_ring — per-axis runtime probe +
+                # geometry, need_row for the LU exchange), so the
+                # two can never disagree on a mesh where one axis
+                # gates differently than the other
+                from dplasma_tpu.descriptors import Dist
+                from dplasma_tpu.parallel import cyclic as _cyc
+                desc = _cyc.CyclicDesc(
+                    ip.M, ip.N, max(ip.MB, 1), max(ip.NB, 1),
+                    Dist(P=ip.P, Q=ip.Q, kp=ip.kp, kq=ip.kq))
+                ring = _cyc._cyclic_ring(
+                    desc, PRECISIONS[ip.prec], self.mesh,
+                    need_row=(op == "getrf"))
         xla_info = capture_compiled(compiled)
         # --report captures the same analyses after the timed loop:
         # remember this pass so an unchanged executable isn't
@@ -789,7 +807,8 @@ class Driver:
             lowered, compiled, name, schedule=schedule, exact=False,
             op=op, KT=KT,
             lookahead=self.pipeline["sweep.lookahead"],
-            prec=ip.prec, xla_info=xla_info)
+            prec=ip.prec, ring=ring, grid=(ip.P, ip.Q),
+            xla_info=xla_info)
         self.report.add_hlocheck(name, res.summary())
         lbl = dict(op=name, prec=ip.prec)
         reg = self.report.metrics
@@ -855,7 +874,7 @@ class Driver:
             OP_CLASS.get(_algo_of(self.name)), ip.M, ip.N, ip.NB,
             itemsize, lookahead=self.pipeline["sweep.lookahead"],
             agg_depth=self.pipeline["qr.agg_depth"], nrhs=ip.K,
-            peaks=peaks)
+            peaks=peaks, grid=(ip.P, ip.Q))
         spans = _rl.attribute_phases(led, model, peaks)
         ssum = led.total()
         return {"attributed_run_s": total, "sum_s": ssum,

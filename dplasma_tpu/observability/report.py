@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 10``).
+"""The versioned JSON run-report (``"schema": 12``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -84,6 +84,15 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                  "measured_s",  # the DB winner's provenance | null
                  "entry_key"}],  # the DB entry consulted (may be a
                                  # neighbor under interpolation) (v11)
+     "scaling": [{"op", "prec", "n", "nb",
+                  "ring",                # the resolved ring.enable
+                  "points": [{"chips", "grid": [P, Q], "median_s",
+                              "gflops",
+                              "parallel_efficiency"}]}],  # (v12,
+                                 # tools/multichip.py per-chip-count
+                                 # scaling curves; efficiency =
+                                 # T_1 / (chips * T_chips), higher
+                                 # is better)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
@@ -112,9 +121,14 @@ lower-better); 11 adds ``"tuning"`` (the --autotune consultation
 record — which tuning-DB entry resolved this run's knobs, with what
 source/provenance, dplasma_tpu.tuning) plus the ``"tuning.source"``
 and full-knob-vector keys (``lu.agg_depth``/``panel.tree_leaf``/
-``panel.rec_base``) in ``"pipeline"``. All
+``panel.rec_base``) in ``"pipeline"``; 12 adds ``"scaling"`` (the
+per-chip-count scaling curves of the cyclic factorizations —
+``tools/multichip.py`` runs each op over 1/2/4/8 chips and records
+median seconds, GFlop/s, and parallel efficiency per point, gated
+higher-better through perfdiff) plus the ``ring.enable`` key in
+``"pipeline"`` (the explicit-ICI-ring knob, kernels.pallas_ring). All
 additive — v1 readers of the other keys are unaffected; this reader
-accepts <= 11 (:func:`load_report` tolerates every v1-v11 vintage,
+accepts <= 12 (:func:`load_report` tolerates every v1-v12 vintage,
 filling the always-present keys).
 """
 from __future__ import annotations
@@ -127,7 +141,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 11
+REPORT_SCHEMA = 12
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -164,6 +178,7 @@ class RunReport:
         self.serving: List[dict] = []   # serving-layer records (v8)
         self.hlocheck: List[dict] = []  # --hlocheck audits (v10)
         self.tuning: List[dict] = []    # --autotune consultations (v11)
+        self.scaling: List[dict] = []   # per-chip-count curves (v12)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
         self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
@@ -238,6 +253,12 @@ class RunReport:
         self.tuning.append(summary)
         return summary
 
+    def add_scaling(self, summary: dict) -> dict:
+        """Record one op's per-chip-count scaling curve (schema v12;
+        see tools/multichip.py)."""
+        self.scaling.append(summary)
+        return summary
+
     def add_roofline(self, entry: dict) -> dict:
         """Record one per-op roofline ledger entry (schema v5; see
         observability.roofline.op_roofline)."""
@@ -277,6 +298,8 @@ class RunReport:
             doc["hlocheck"] = self.hlocheck
         if self.tuning:
             doc["tuning"] = self.tuning
+        if self.scaling:
+            doc["scaling"] = self.scaling
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
         if self.roofline:
@@ -311,7 +334,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v10) loads: the schema history is purely
+    Every older vintage (v1-v11) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

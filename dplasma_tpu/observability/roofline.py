@@ -235,11 +235,39 @@ def refine_phase_model(op_class: str, M: int, N: int, nrhs: int,
     }
 
 
+def ring_phase_demand(op_class: str, M: int, N: int, nb: int,
+                      itemsize: int,
+                      grid: Tuple[int, int]) -> Optional[dict]:
+    """The ``ring`` span's demand: the PANEL-BROADCAST wire bytes of
+    the cyclic kernel on this grid — exactly the transfers the
+    wrappers' comm microprogram (``_panel_bcast_probe_jit``) runs,
+    priced from the SAME analytic model spmdcheck/hlocheck reconcile
+    (:func:`dplasma_tpu.parallel.cyclic.spmd_comm_model`) — so the
+    measured ICI seconds of the ``ring`` span finally validate the
+    roofline ``ici`` component (before this, ``bound == "ici"`` was
+    unreachable in any phase table). The RING pricing is used
+    unconditionally: at ``(n-1)/n`` of the payload it never exceeds
+    the masked psum's ``2(n-1)/n``, so it lower-bounds the probe's
+    transfer whichever schedule the live gate resolved."""
+    P, Q = int(grid[0]), int(grid[1])
+    if Q <= 1 or op_class not in ("potrf", "getrf", "geqrf") \
+            or nb <= 0:
+        return None
+    from dplasma_tpu.descriptors import Dist
+    from dplasma_tpu.parallel.cyclic import CyclicDesc, spmd_comm_model
+    desc = CyclicDesc(M, N, nb, nb, Dist(P=P, Q=Q))
+    model = spmd_comm_model(desc, op_class, itemsize, ring=True)
+    ici = sum(v for k, v in model["bytes_by_collective"].items()
+              if "panel" in k and "bcast" in k)
+    return {"ici_bytes": float(ici)}
+
+
 def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
                 itemsize: int, lookahead: int = 1,
                 agg_depth: int = 1, nrhs: int = 1,
                 peaks: Optional[dict] = None,
-                panel_kernel: Optional[str] = None
+                panel_kernel: Optional[str] = None,
+                grid: Optional[Tuple[int, int]] = None
                 ) -> Optional[Dict[str, list]]:
     """Per-phase ``{name: [flops, hbm_bytes, dispatches]}`` demands.
 
@@ -254,8 +282,15 @@ def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
     :func:`refine_phase_model` (dict-valued demands carrying per-phase
     MXU-rate overrides), with the working precision resolved from the
     live MCA ``ir.*`` configuration — the same source the solver
-    reads. Unmodelled op classes return None.
+    reads. A multi-rank ``grid`` adds the ``ring`` entry
+    (:func:`ring_phase_demand`) — the ICI-bytes demand of the cyclic
+    wrappers' panel-broadcast span. Unmodelled op classes return
+    None.
     """
+    ring_extra = None
+    if grid is not None and op_class is not None:
+        ring_extra = ring_phase_demand(op_class, M, N, nb, itemsize,
+                                       grid)
     if op_class in REFINE_CLASSES:
         from dplasma_tpu.ops import refine as _refine
         prec_w, _, _ = _refine.ir_params()
@@ -300,6 +335,8 @@ def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
                     *_apply_cost("potrf", m, nb, nb, 1, itemsize))
             add("panel", *_panel_cost("potrf", m, nb, itemsize))
         add("assemble", 0.0, 2.0 * Mp * Mp * itemsize)
+        if ring_extra is not None:
+            acc["ring"] = ring_extra
         return acc
 
     # right-looking engine simulation (mirrors pipelined_sweep /
@@ -361,6 +398,8 @@ def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
             ahead.append(peel())
 
     add("assemble", 0.0, 2.0 * Mp * NT * nb * itemsize)
+    if ring_extra is not None:
+        acc["ring"] = ring_extra
     return acc
 
 
@@ -380,12 +419,15 @@ def attribute_phases(ledger, model: Optional[dict],
     (:func:`refine_phase_model`) may scale per measured dispatch
     (``per_count``), override the MXU rate (``mxu_gflops`` — how
     the IR factor phase gets priced at the WORKING-precision peak
-    while the residual stays at the dd rate), and declare itself
-    ``inclusive``: its demand covers the whole region INCLUDING
-    enclosed child spans (the IR ``factor`` span wraps the inner
-    factorization sweep, whose panel/lookahead/... spans carry the
-    actual work), so achieved_frac divides by the ledger's inclusive
-    ``total_s`` instead of the self ``measured_s``."""
+    while the residual stays at the dd rate), carry an ``ici_bytes``
+    demand (the ``ring`` span of the cyclic kernels — the component
+    that makes ``bound == "ici"`` reachable in the phase table; it
+    never was before this join passed ICI bytes through), and declare
+    itself ``inclusive``: its demand covers the whole region
+    INCLUDING enclosed child spans (the IR ``factor`` span wraps the
+    inner factorization sweep, whose panel/lookahead/... spans carry
+    the actual work), so achieved_frac divides by the ledger's
+    inclusive ``total_s`` instead of the self ``measured_s``."""
     out = []
     for row in ledger.summary():
         name, meas = row["phase"], row["measured_s"]
@@ -398,6 +440,7 @@ def attribute_phases(ledger, model: Optional[dict],
             exp, bound, _ = expected_seconds(
                 flops=demand.get("flops", 0.0) * scale,
                 hbm_bytes=demand.get("hbm_bytes", 0.0) * scale,
+                ici_bytes=demand.get("ici_bytes", 0.0) * scale,
                 dispatches=row["count"], peaks=pk)
             if demand.get("inclusive"):
                 meas = row.get("total_s", meas)

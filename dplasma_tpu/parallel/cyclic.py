@@ -353,8 +353,31 @@ def _seed_pad_diag(A, desc: CyclicDesc, gid, gcid):
     return jnp.where(eq, jnp.ones((), A.dtype), A)
 
 
-@partial(jax.jit, static_argnums=(1, 2, 3))
-def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh, lookahead: int = 0):
+def _bcast_q(val, q, qk: int, Q: int, ring: bool, P: int,
+             rchunks: int = 0):
+    """Panel broadcast along 'q' from owner column ``qk`` (a trace-time
+    int): the explicit ICI ring when ``ring`` (wire-optimal — each
+    link carries the panel once, started as early as program order
+    allows), else the masked-psum emulation (an all-reduce moving 2x
+    the bytes — the bit-identical ``ring.enable=off`` path). The owner
+    mask is one-hot, so both paths produce IDENTICAL values.
+    ``rchunks`` is the PINNED pipelining depth (the wrappers resolve
+    MCA ``ring.chunks`` and thread it as a jit static, so an MCA flip
+    re-traces instead of replaying a stale cached kernel; 0 = resolve
+    at trace time — direct/test callers only)."""
+    if ring and Q > 1:
+        from dplasma_tpu.kernels import pallas_ring as _pring
+        return _pring.ring_bcast(
+            val, root=qk, axis=pmesh.COL_AXIS,
+            axes=((pmesh.ROW_AXIS, P), (pmesh.COL_AXIS, Q)),
+            chunks=rchunks if rchunks > 0 else None)
+    return jax.lax.psum(
+        jnp.where(q == qk, val, jnp.zeros_like(val)), pmesh.COL_AXIS)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh, lookahead: int = 0,
+                      ring: bool = False, rchunks: int = 0):
     # ``mesh`` (hashable) is part of the jit key: two same-shaped meshes
     # with different device orders must not share a trace.
     # ``lookahead`` > 0 pipelines the sweep: step k broadcasts and
@@ -364,6 +387,11 @@ def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh, lookahead: int = 0):
     # MXU-bound update and the compiler/runtime can overlap them —
     # the lookahead the reference gets from PaRSEC running panel
     # tasks as soon as their block-column lands.
+    # ``ring`` routes the panel broadcast over the explicit ICI ring
+    # (kernels.pallas_ring) instead of the masked psum: with
+    # lookahead, the NEXT panel's ring transfer is issued before this
+    # step's wide MXU matmul and consumed only at step k+1's panel
+    # factorization — the start-early/wait-late overlap schedule.
     d = desc.dist
     P, Q = d.P, d.Q
     mb = desc.mb
@@ -393,9 +421,7 @@ def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh, lookahead: int = 0):
             # or take the lookahead-carried pre-updated column
             cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
             if pan_next is None:
-                pan = jax.lax.psum(
-                    jnp.where(q == qk, cs, jnp.zeros_like(cs)),
-                    pmesh.COL_AXIS)
+                pan = _bcast_q(cs, q, qk, Q, ring, P, rchunks)
             else:
                 pan = pan_next
             # 2) broadcast diagonal tile along 'p'
@@ -437,9 +463,10 @@ def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh, lookahead: int = 0):
                 lrk1 = layout.local_index(k + 1, P, d.kp)
                 cs1 = jax.lax.dynamic_slice_in_dim(A, lck1 * mb, mb,
                                                    axis=1)
-                stale = jax.lax.psum(
-                    jnp.where(q == qk1, cs1, jnp.zeros_like(cs1)),
-                    pmesh.COL_AXIS)
+                # with ring on, this transfer STARTS here — before the
+                # wide trailing matmul below — and is consumed only at
+                # step k+1's panel factorization (the overlap window)
+                stale = _bcast_q(cs1, q, qk1, Q, ring, P, rchunks)
                 Lk1 = allg[pk1 * mloc + lrk1 * mb:
                            pk1 * mloc + (lrk1 + 1) * mb]
                 pan_next = stale - kb.dot(Lbelow, ct(Lk1))
@@ -458,13 +485,18 @@ def _potrf_cyclic_jit(data, desc: CyclicDesc, mesh, lookahead: int = 0):
         in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
                                None),
         out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
-                                None))
+                                None),
+        # pallas_call has no replication rule: the ring path must opt
+        # out of shard_map's rep check (the off path keeps it — its
+        # traced program is bit-identical to the pre-ring kernels)
+        **({"check_rep": False} if ring else {}))
     return f(data)
 
 
-@partial(jax.jit, static_argnums=(1, 2, 3, 4))
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
 def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh,
-                      lookahead: int = 0, panel: str = "chain"):
+                      lookahead: int = 0, panel: str = "chain",
+                      ring: bool = False, rchunks: int = 0):
     """Distributed tournament-pivoting LU over cyclic local slabs —
     the reference's hand-distributed parallel panel
     (src/zgetrf_ptgpanel.jdf: per-rank panel elimination + pivot
@@ -505,9 +537,7 @@ def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh,
             # pre-updated next column from the previous step
             cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
             if pan_next is None:
-                pan = jax.lax.psum(
-                    jnp.where(q == qk, cs, jnp.zeros_like(cs)),
-                    pmesh.COL_AXIS)
+                pan = _bcast_q(cs, q, qk, Q, ring, P, rchunks)
             else:
                 pan = pan_next
             panm = jnp.where(active[:, None], pan, 0)
@@ -548,7 +578,17 @@ def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh,
             #    along 'p' — the pivot-row exchange)
             sel = jnp.where(mine[:, None],
                             A[jnp.where(mine, win_lrow, 0)], 0)
-            wrows = jax.lax.psum(sel, pmesh.ROW_AXIS)      # (mb, nloc)
+            if ring and P > 1:
+                # winner rows ride the explicit 'p' ring: P-1
+                # shift-and-add hops (kernels.pallas_ring). Winner
+                # rows have exactly one owner, so the contributions
+                # are disjoint and the sum is bit-identical to psum's.
+                from dplasma_tpu.kernels import pallas_ring as _pring
+                wrows = _pring.ring_allreduce(
+                    sel, axis=pmesh.ROW_AXIS,
+                    axes=((pmesh.ROW_AXIS, P), (pmesh.COL_AXIS, Q)))
+            else:
+                wrows = jax.lax.psum(sel, pmesh.ROW_AXIS)  # (mb, nloc)
             u12 = kb.trsm(top, wrows, side="L", lower=True, unit=True)
             trailing = (gcol > k)[None, :]
             u12 = jnp.where(trailing, u12, 0)
@@ -572,9 +612,9 @@ def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh,
                     jnp.where(mine[:, None], u12k1,
                               coln[jnp.where(mine, win_lrow, 0)]),
                     mode="drop")
-                pan_next = jax.lax.psum(
-                    jnp.where(q == qk1, coln, jnp.zeros_like(coln)),
-                    pmesh.COL_AXIS)
+                # ring: step k+1's panel transfer starts HERE, before
+                # the wide Schur matmul below (the overlap window)
+                pan_next = _bcast_q(coln, q, qk1, Q, ring, P, rchunks)
             else:
                 pan_next = None
             A = A - kb.dot(l21, u12)
@@ -609,7 +649,8 @@ def _getrf_cyclic_jit(data, desc: CyclicDesc, mesh,
                                  None),
                    PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
                                  None),
-                   PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None)))
+                   PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None)),
+        **({"check_rep": False} if ring else {}))
     return f(data)
 
 
@@ -628,8 +669,12 @@ def getrf_cyclic(A: CyclicMatrix):
     pk = _panels.panel_kernel("lu")
     if pk == "pallas":   # no fused pallas panel inside shard_map
         pk = "rec"
+    ring = _cyclic_ring(A.desc, A.dtype, m, need_row=True)
+    rch = _ring_chunks(ring)
+    _ring_span(A, m, ring, rch)
     out, wins, active = _getrf_cyclic_jit(A.data, A.desc, m,
-                                          _cyclic_lookahead(), pk)
+                                          _cyclic_lookahead(), pk,
+                                          ring, rch)
     desc = A.desc
     d = desc.dist
     mb = desc.mb
@@ -699,9 +744,10 @@ def _cqr2_panel(x, M: int, mb: int, eps: float, pdiag, ldiag, p, ct,
     return packedtop, V1, T, Ub, q2
 
 
-@partial(jax.jit, static_argnums=(1, 2, 3))
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
 def _geqrf_cyclic_jit(data, desc: CyclicDesc, mesh,
-                      lookahead: int = 0):
+                      lookahead: int = 0, ring: bool = False,
+                      rchunks: int = 0):
     """Distributed blocked Householder QR over cyclic local slabs —
     BASELINE config #3's hierarchical QR (ref src/zgeqrf_param.jdf +
     dplasma_hqr.c high-level trees) re-designed for the mesh: each
@@ -753,9 +799,7 @@ def _geqrf_cyclic_jit(data, desc: CyclicDesc, mesh,
             lck = layout.local_index(k, Q, d.kq)
             cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
             if pan_next is None:
-                pan = jax.lax.psum(
-                    jnp.where(q == qk, cs, jnp.zeros_like(cs)),
-                    pmesh.COL_AXIS)
+                pan = _bcast_q(cs, q, qk, Q, ring, P, rchunks)
             else:
                 pan = pan_next
             act = (gid >= k * mb)[:, None]
@@ -788,10 +832,10 @@ def _geqrf_cyclic_jit(data, desc: CyclicDesc, mesh,
                                                    axis=1)
                 updn = kb.dot(Vloc, kb.dot(T, Wk1, ta=True,
                                            conj_a=True))
-                pan_next = jax.lax.psum(
-                    jnp.where(q == qk1, cs1 - updn,
-                              jnp.zeros_like(cs1)),
-                    pmesh.COL_AXIS)
+                # ring: step k+1's panel transfer starts HERE, before
+                # the wide compact-WY apply below (the overlap window)
+                pan_next = _bcast_q(cs1 - updn, q, qk1, Q, ring, P,
+                                    rchunks)
             else:
                 pan_next = None
             upd = kb.dot(Vloc, kb.dot(T, W, ta=True, conj_a=True))
@@ -815,7 +859,8 @@ def _geqrf_cyclic_jit(data, desc: CyclicDesc, mesh,
         out_specs=(PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
                                  None),
                    PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
-                                 None, None)))
+                                 None, None)),
+        **({"check_rep": False} if ring else {}))
     return f(data)
 
 
@@ -1224,9 +1269,99 @@ def geqrf_cyclic(A: CyclicMatrix):
     ms = (m.shape[pmesh.ROW_AXIS], m.shape[pmesh.COL_AXIS])
     assert ms == (A.desc.dist.P, A.desc.dist.Q), (
         f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
+    ring = _cyclic_ring(A.desc, A.dtype, m)
+    rch = _ring_chunks(ring)
+    _ring_span(A, m, ring, rch)
     out, Ts = _geqrf_cyclic_jit(A.data, A.desc, m,
-                                _cyclic_lookahead())
+                                _cyclic_lookahead(), ring, rch)
     return CyclicMatrix(out, A.desc), Ts[0, 0]
+
+
+def _cyclic_ring(desc: CyclicDesc, dtype, mesh,
+                 need_row: bool = False) -> bool:
+    """Resolve MCA ``ring.enable`` for one cyclic factorization: the
+    panel-broadcast ring rides the 'q' axis, the LU winner-row
+    exchange (``need_row``) the 'p' axis. The kernels take ONE ring
+    flag and fall back per size-1 axis internally, so the resolution
+    is: every RINGABLE axis (size > 1) the kernel would use must pass
+    its gate — a Px1 LU grid rings the row exchange alone, and a
+    geometry failure on either live axis keeps the whole kernel on
+    the psum path (conservative: the single flag cannot express a
+    per-axis mix beyond the size-1 fallback). ``off`` keeps the
+    masked-psum kernels bit-identical; ``auto`` activates only where
+    the runtime probe and mesh-geometry gate pass (CPU always falls
+    back — see kernels.pallas_ring)."""
+    from dplasma_tpu.kernels import pallas_ring as _pring
+    d = desc.dist
+    gates = []
+    if d.Q > 1:
+        gates.append(_pring.ring_active(d.Q, dtype, mesh,
+                                        pmesh.COL_AXIS))
+    if need_row and d.P > 1:
+        gates.append(_pring.ring_active(d.P, dtype, mesh,
+                                        pmesh.ROW_AXIS))
+    return bool(gates) and all(gates)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _panel_bcast_probe_jit(data, desc: CyclicDesc, mesh,
+                           ring: bool = False, rchunks: int = 0):
+    """The factorizations' panel-broadcast schedule ALONE — KT
+    owner-column transfers along 'q' (ring or masked psum) with a
+    trivial reduction to keep the dataflow live. This is the comm
+    microprogram the ``ring`` phase span times: its measured seconds
+    are (nearly) pure ICI transfer, which the roofline joins against
+    the ``ici`` bound priced from :func:`spmd_comm_model`'s
+    panel-broadcast bytes (the satellite closing the never-validated
+    ``ici`` roofline component)."""
+    d = desc.dist
+    P, Q = d.P, d.Q
+    mb = desc.mb
+    KT = min(desc.MT, desc.NT)
+    mloc = desc.MTL * mb
+
+    def body(local):
+        A = local.reshape(mloc, desc.NTL * desc.nb)
+        q = jax.lax.axis_index(pmesh.COL_AXIS)
+        s = jnp.zeros((mloc, mb), A.dtype)
+        for k in range(KT):
+            qk = layout.owner(k, Q, d.kq, d.jq)
+            lck = layout.local_index(k, Q, d.kq)
+            cs = jax.lax.dynamic_slice_in_dim(A, lck * mb, mb, axis=1)
+            s = s + _bcast_q(cs, q, qk, Q, ring, P, rchunks)
+        return s[None, None]
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                               None),
+        out_specs=PartitionSpec(pmesh.ROW_AXIS, pmesh.COL_AXIS, None,
+                                None),
+        **({"check_rep": False} if ring else {}))
+    return f(data)
+
+
+def _ring_chunks(ring: bool) -> int:
+    """Resolve MCA ``ring.chunks`` ONCE at the wrapper (pinned into
+    the jit key as a static, so a knob flip re-traces instead of
+    replaying a stale cached kernel); 0 on the psum path."""
+    from dplasma_tpu.utils import config as _cfg
+    return _cfg.mca_get_int("ring.chunks", 4) if ring else 0
+
+
+def _ring_span(A: CyclicMatrix, mesh, ring: bool,
+               rchunks: int = 0) -> None:
+    """Emit the ``ring`` phase span (active ledger only — the default
+    path never runs the probe, keeping the timed loop untouched): one
+    fenced pass of the panel-broadcast microprogram, so the ledger's
+    measured ICI seconds can be validated against the roofline
+    ``ici`` bound."""
+    from dplasma_tpu.observability import phases as _phases
+    if _phases.active() is None:
+        return
+    with _phases.span("ring") as fence:
+        fence(_panel_bcast_probe_jit(A.data, A.desc, mesh, ring,
+                                     rchunks))
 
 
 def _cyclic_lookahead() -> int:
@@ -2048,11 +2183,15 @@ def potrf_cyclic(A: CyclicMatrix, uplo: str = "L") -> CyclicMatrix:
         f"mesh {ms} != dist grid {(A.desc.dist.P, A.desc.dist.Q)}")
     if uplo.upper() == "U":
         # the U storage is the compat variant; the lookahead pipeline
-        # lives on the L path (and the single-chip sweep)
+        # and the ICI ring live on the L path (and the single-chip
+        # sweep)
         out = _potrf_cyclic_upper_jit(A.data, A.desc, m)
     else:
+        ring = _cyclic_ring(A.desc, A.dtype, m)
+        rch = _ring_chunks(ring)
+        _ring_span(A, m, ring, rch)
         out = _potrf_cyclic_jit(A.data, A.desc, m,
-                                _cyclic_lookahead())
+                                _cyclic_lookahead(), ring, rch)
     return CyclicMatrix(out, A.desc)
 
 
@@ -2061,7 +2200,7 @@ def potrf_cyclic(A: CyclicMatrix, uplo: str = "L") -> CyclicMatrix:
 # ---------------------------------------------------------------------
 
 def spmd_comm_model(desc: CyclicDesc, op: str, itemsize: int,
-                    kt: int | None = None) -> dict:
+                    kt: int | None = None, ring: bool = False) -> dict:
     """Per-collective wire-byte model of the cyclic shard_map programs.
 
     Mirrors the collective structure the algorithms above actually
@@ -2072,6 +2211,16 @@ def spmd_comm_model(desc: CyclicDesc, op: str, itemsize: int,
     moves ``2(n-1)/n`` of the payload per rank, all-gather ``(n-1)/n``
     of the gathered output). Returned bytes are TOTAL wire bytes
     across all ranks and steps; a 1x1 grid prices to zero.
+
+    ``ring=True`` prices the explicit ICI-ring schedule the kernels
+    emit under MCA ``ring.enable`` (kernels.pallas_ring): the panel
+    broadcast becomes a store-and-forward ring (each link carries the
+    panel ONCE — half the masked psum's all-reduce bytes), and the LU
+    winner-row exchange becomes n-1 shift-and-add hops (``(n-1)``
+    payloads per rank — latency-optimized; more wire than the
+    reduce-scatter psum on large axes, fewer synchronization rounds
+    on the small ones the factorizations run). A size-1 axis keeps
+    its psum class (the kernels fall back per axis).
 
     Known ``op`` values: potrf, getrf, geqrf, gemm, herbt, ge2gb (the
     cyclic kernels in this module). Raises KeyError otherwise —
@@ -2092,22 +2241,46 @@ def spmd_comm_model(desc: CyclicDesc, op: str, itemsize: int,
         # per-rank output is n*payload; ring moves (n-1)*payload/rank
         return R * (n - 1) * payload_elems * itemsize
 
+    def rbcast(payload_elems: float, n: int) -> float:
+        # store-and-forward ring: each of the n-1 links in a ring row
+        # carries the payload exactly once
+        return R * (n - 1) / max(n, 1) * payload_elems * itemsize
+
+    def rshift_sum(payload_elems: float, n: int) -> float:
+        # n-1 shift-and-add hops, every rank sends the payload per hop
+        return R * (n - 1) * payload_elems * itemsize
+
+    ring_q = ring and Q > 1
+    ring_p = ring and P > 1
+
+    def bcast_q_entry(payload_elems: float) -> tuple:
+        if ring_q:
+            return "panel_ring_bcast_q", KT * rbcast(payload_elems, Q)
+        return "panel_bcast_psum_q", KT * psum(payload_elems, Q)
+
     if op == "potrf":
+        key, val = bcast_q_entry(mloc * mb)
         by = {
-            "panel_bcast_psum_q": KT * psum(mloc * mb, Q),
+            key: val,
             "diag_bcast_psum_p": KT * psum(mb * mb, P),
             "row_panel_allgather_p": KT * agather(mloc * mb, P),
         }
     elif op == "getrf":
+        key, val = bcast_q_entry(mloc * mb)
         by = {
-            "panel_bcast_psum_q": KT * psum(mloc * mb, Q),
+            key: val,
             "candidate_allgather_p": KT * (
                 agather(mb * mb, P) + agather(mb, P)),
-            "pivot_row_exchange_psum_p": KT * psum(mb * nloc, P),
         }
+        if ring_p:
+            by["pivot_row_ring_shift_p"] = \
+                KT * rshift_sum(mb * nloc, P)
+        else:
+            by["pivot_row_exchange_psum_p"] = KT * psum(mb * nloc, P)
     elif op == "geqrf":
+        key, val = bcast_q_entry(mloc * mb)
         by = {
-            "panel_bcast_psum_q": KT * psum(mloc * mb, Q),
+            key: val,
             # CholeskyQR2: two Gram psums + the top-block psum along 'p'
             "gram_psum_p": KT * 3 * psum(mb * mb, P),
             "trailing_vhc_psum_p": KT * psum(mb * nloc, P),

@@ -65,9 +65,12 @@ _cfg.mca_register(
 
 #: MCA knobs a DB entry may carry and a consultation may apply
 #: (``nb`` and ``grid`` ride the knob vector too but are applied
-#: structurally — tile/grid shape, not MCA state).
+#: structurally — tile/grid shape, not MCA state). ``ring.enable``
+#: makes ring-vs-psum panel transfers in the cyclic kernels a tuned,
+#: stored decision per (op, n, dtype, grid) key.
 MCA_KNOBS = ("sweep.lookahead", "qr.agg_depth", "lu.agg_depth",
-             "panel.kernel", "panel.tree_leaf", "panel.rec_base")
+             "panel.kernel", "panel.tree_leaf", "panel.rec_base",
+             "ring.enable")
 
 #: every key a full resolved knob vector carries (``panel.qr``/
 #: ``panel.lu`` are the per-route resolutions of ``panel.kernel`` —
@@ -129,6 +132,7 @@ def resolved_knobs(nb: Optional[int] = None,
         "panel.lu": _panels.panel_kernel("lu"),
         "panel.tree_leaf": _cfg.mca_get_int("panel.tree_leaf", 2),
         "panel.rec_base": _cfg.mca_get_int("panel.rec_base", 8),
+        "ring.enable": _cfg.mca_get("ring.enable") or "auto",
     }
     if nb is not None:
         kv["nb"] = int(nb)

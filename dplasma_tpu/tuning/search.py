@@ -82,8 +82,9 @@ def candidate_configs(op: str, n: int,
                       nbs: Optional[List[int]] = None,
                       lookaheads: Optional[List[int]] = None,
                       agg_depths: Optional[List[int]] = None,
-                      panel_kernels: Optional[List[str]] = None
-                      ) -> List[dict]:
+                      panel_kernels: Optional[List[str]] = None,
+                      ring_modes: Optional[List[str]] = None,
+                      grid: Tuple[int, int] = (1, 1)) -> List[dict]:
     """Enumerate candidate configs for one key. The FIRST candidate
     is always the current default resolution (default nb, live MCA
     knobs) so the incumbent baseline is measured before anything
@@ -98,39 +99,47 @@ def candidate_configs(op: str, n: int,
     # silently baselines the wrong knob
     agg_name = "qr.agg_depth" if op == "geqrf" else "lu.agg_depth"
     agg0 = _cfg.mca_get_int(agg_name, 4)
-    if op == "gemm":
-        # the gemm path (ops.blas3 — ONE XLA dot, GSPMD-SUMMA'd on a
-        # mesh) is nb-invariant: XLA owns its tiling. Sweeping nb
-        # would measure identical programs and store a noise-selected
-        # tile size that --autotune then applies to real runs.
+    if op == "gemm" and tuple(grid) == (1, 1):
+        # the single-chip gemm path (ops.blas3 — ONE XLA dot) is
+        # nb-invariant: XLA owns its tiling. Sweeping nb would
+        # measure identical programs and store a noise-selected tile
+        # size that --autotune then applies to real runs. The CYCLIC
+        # grids keep the nb axis: gemm_cyclic's SUMMA step count and
+        # local slabs are shaped by the tile size.
         nbs = [default_nb(n)]
     else:
         nbs = list(nbs) if nbs else default_nbs(n)
     las = list(lookaheads) if lookaheads is not None else [la0]
     aggs = list(agg_depths) if agg_depths is not None else [None]
     kers = list(panel_kernels) if panel_kernels is not None else [None]
+    rings = list(ring_modes) if ring_modes is not None else [None]
 
-    def cfg(nb, la, agg, ker):
+    def cfg(nb, la, agg, ker, rng):
         c = {"nb": int(nb), "sweep.lookahead": int(la)}
         if agg is not None:
             c[agg_name] = int(agg)
         if ker is not None:
             c["panel.kernel"] = str(ker)
+        if rng is not None:
+            c["ring.enable"] = str(rng)
         return c
 
     first = cfg(default_nb(n), la0,
                 agg0 if agg_depths is not None else None,
-                kers[0] if panel_kernels is not None else None)
+                kers[0] if panel_kernels is not None else None,
+                (_cfg.mca_get("ring.enable") or "auto")
+                if ring_modes is not None else None)
     out, seen = [first], {canonical(first)}
     for nb in nbs:
         for la in las:
             for agg in aggs:
                 for ker in kers:
-                    c = cfg(nb, la, agg, ker)
-                    key = canonical(c)
-                    if key not in seen:
-                        seen.add(key)
-                        out.append(c)
+                    for rng in rings:
+                        c = cfg(nb, la, agg, ker, rng)
+                        key = canonical(c)
+                        if key not in seen:
+                            seen.add(key)
+                            out.append(c)
     return out
 
 
@@ -206,9 +215,65 @@ def prune_candidates(op: str, n: int, dtype, candidates: List[dict],
 # Measurement (through the real op dispatch)
 # ---------------------------------------------------------------------
 
-def _trial_problem(op: str, n: int, nb: int, dtype):
+def _trial_problem_cyclic(op: str, n: int, nb: int, dtype,
+                          grid: Tuple[int, int]):
+    """Cyclic-grid trial problems (the 2x2+ key space): the realized
+    block-cyclic kernels (:mod:`dplasma_tpu.parallel.cyclic`) under
+    the already-active PxQ mesh — the programs whose ring-vs-psum
+    panel transfers the ``ring.enable`` knob actually reshapes."""
+    from dplasma_tpu.descriptors import Dist
+    from dplasma_tpu.ops import generators
+    from dplasma_tpu.parallel import cyclic
+    from dplasma_tpu.utils import flops as lawn41
+    d = Dist(P=int(grid[0]), Q=int(grid[1]))
+    if op == "potrf":
+        A0 = generators.plghe(float(n), n, nb, seed=3872, dtype=dtype)
+        C0 = cyclic.CyclicMatrix.from_tile(A0, d)
+
+        def fn(data):
+            return cyclic.potrf_cyclic(
+                cyclic.CyclicMatrix(data, C0.desc), "L").data
+        return fn, (C0.data,), lawn41.potrf(n)
+    if op == "getrf":
+        A0 = generators.plrnt(n, n, nb, nb, seed=3872, dtype=dtype)
+        C0 = cyclic.CyclicMatrix.from_tile(A0, d)
+
+        def fn(data):
+            F, perm = cyclic.getrf_cyclic(
+                cyclic.CyclicMatrix(data, C0.desc))
+            return F.data, perm
+        return fn, (C0.data,), lawn41.getrf(n, n)
+    if op == "geqrf":
+        A0 = generators.plrnt(n, n, nb, nb, seed=3872, dtype=dtype)
+        C0 = cyclic.CyclicMatrix.from_tile(A0, d)
+
+        def fn(data):
+            F, Ts = cyclic.geqrf_cyclic(
+                cyclic.CyclicMatrix(data, C0.desc))
+            return F.data, Ts
+        return fn, (C0.data,), lawn41.geqrf(n, n)
+    if op == "gemm":
+        A0 = generators.plrnt(n, n, nb, nb, seed=3872, dtype=dtype)
+        B0 = generators.plrnt(n, n, nb, nb, seed=3873, dtype=dtype)
+        Ca = cyclic.CyclicMatrix.from_tile(A0, d)
+        Cb = cyclic.CyclicMatrix.from_tile(B0, d)
+
+        def fn(a, b):
+            return cyclic.gemm_cyclic(
+                cyclic.CyclicMatrix(a, Ca.desc),
+                cyclic.CyclicMatrix(b, Cb.desc)).data
+        return fn, (Ca.data, Cb.data), lawn41.gemm(n, n, n)
+    raise ValueError(f"unmeasurable cyclic op {op!r} "
+                     f"(know {MEASURABLE_OPS})")
+
+
+def _trial_problem(op: str, n: int, nb: int, dtype,
+                   grid: Tuple[int, int] = (1, 1)):
     """Build one trial's callable + args + flop count — the same op
-    entry points the drivers time."""
+    entry points the drivers time. Nontrivial grids route to the
+    cyclic shard_map kernels (:func:`_trial_problem_cyclic`)."""
+    if tuple(grid) != (1, 1):
+        return _trial_problem_cyclic(op, n, nb, dtype, grid)
     from dplasma_tpu.descriptors import TileMatrix
     from dplasma_tpu.ops import generators
     from dplasma_tpu.ops import lu as lu_mod
@@ -278,7 +343,7 @@ def measure_config(op: str, n: int, dtype, grid: Tuple[int, int],
         mesh_cm = pmesh.use_grid(pmesh.make_mesh(P, Q))
     with _cfg.override_scope(overrides, label="tune-trial"), mesh_cm:
         knobs = tdb.resolved_knobs(nb=nb, grid=grid)
-        fn, args, flops = _trial_problem(op, n, nb, dtype)
+        fn, args, flops = _trial_problem(op, n, nb, dtype, grid)
         jfn = jax.jit(fn)
         jax.block_until_ready(jfn(*args))     # compile + warm
         times = []
@@ -360,6 +425,7 @@ def sweep(ops: List[str], sizes: List[int], dtype="float32",
           lookaheads: Optional[List[int]] = None,
           agg_depths: Optional[List[int]] = None,
           panel_kernels: Optional[List[str]] = None,
+          ring_modes: Optional[List[str]] = None,
           nruns: Optional[int] = None,
           margin: Optional[float] = None, prune: bool = True,
           history: Optional[str] = None,
@@ -390,7 +456,8 @@ def sweep(ops: List[str], sizes: List[int], dtype="float32",
             incumbent = prior.get("measured_s") if prior else None
             cands = candidate_configs(
                 op, n, nbs=nbs, lookaheads=lookaheads,
-                agg_depths=agg_depths, panel_kernels=panel_kernels)
+                agg_depths=agg_depths, panel_kernels=panel_kernels,
+                ring_modes=ring_modes, grid=grid)
             krep = {"key": key, "op": op, "n": n, "trials": [],
                     "pruned": [], "candidates": len(cands)}
             report["keys"].append(krep)

@@ -425,7 +425,7 @@ def test_driver_hlocheck_end_to_end(prog, tmp_path, capsys, devices8):
     assert rc == 0
     assert f"hlocheck[{prog}]" in out and "OK" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 11
+    assert doc["schema"] == 12
     (entry,) = doc["hlocheck"]
     assert entry["ok"] and entry["op"] == prog
     assert entry["relation"] in ("gspmd", "==", ">=",
@@ -608,3 +608,67 @@ def test_xla_capture_records_structured_errors():
 
     out2 = capture_compiled(_Silent())
     assert out2["cost"] is None and out2["memory"] is None
+
+
+# --------------------------------------- explicit ICI ring kernels
+
+def _ring_hlo(n_ring=4, n_permute=0):
+    lines = ["HloModule jit_ring, num_partitions=4\n",
+             "ENTRY %main (p0: f32[8,128]) -> f32[8,128] {\n",
+             "  %p0 = f32[8,128]{1,0} parameter(0)\n"]
+    for i in range(n_ring):
+        lines.append(
+            f"  %cc.{i} = f32[8,128]{{1,0}} custom-call(%p0), "
+            f'custom_call_target="tpu_custom_call", '
+            f'metadata={{op_name="dplasma_ring_bcast_q.{i}"}}\n')
+    for i in range(n_permute):
+        lines.append(
+            f"  %cp.{i} = f32[8,128]{{1,0}} "
+            f"collective-permute(%p0), "
+            f"source_target_pairs={{{{0,1}},{{1,2}},{{2,3}},{{3,0}}}}"
+            f"\n")
+    lines.append("  ROOT %r = f32[8,128]{1,0} copy(%p0)\n}\n")
+    return "".join(lines)
+
+
+def test_ring_custom_calls_counted_as_ring_dma():
+    """Mosaic-lowered ring kernels (custom-calls carrying the
+    dplasma_ring_ marker) count as the "ring-dma" collective kind —
+    wire traffic the reconciliation must see, not anonymous
+    custom-calls."""
+    mod = hc.parse_module(_ring_hlo(n_ring=3, n_permute=2))
+    assert mod.collective_counts == {"ring-dma": 3,
+                                     "collective-permute": 2}
+
+
+def test_ring_schedule_reconciles_against_compiled_counts():
+    """A jaxpr schedule carrying ring_bcast/ring_shift collectives
+    reconciles exactly against a compiled module's ring-dma count;
+    a dropped ring kernel is a missing-collective diagnostic."""
+    mod = hc.parse_module(_ring_hlo(n_ring=4))
+    sched = sp.SpmdResult(kernel="ring")
+    sched.collectives.append(sp.Collective("ring_bcast", ("q",), 4))
+    res = hc.HloResult(kernel="ring")
+    hc.check_collectives(mod, res, hc.schedule_counts(sched),
+                         exact=True)
+    assert res.ok and res.relation == "=="
+    # mutation: compiled module lost one ring kernel
+    mod2 = hc.parse_module(_ring_hlo(n_ring=3))
+    res2 = hc.HloResult(kernel="ring")
+    hc.check_collectives(mod2, res2, hc.schedule_counts(sched),
+                         exact=True)
+    assert not res2.ok
+    assert any(d.kind == "missing-collective"
+               and d.detail["kind"] == "ring-dma"
+               for d in res2.diagnostics)
+
+
+def test_ring_model_counts_price_ring_classes():
+    """model_counts with ring=True collapses the ring count table
+    onto the ring-dma kind at the right multiplicities (bcast: KT;
+    LU exchange: KT*(P-1))."""
+    mc = hc.model_counts("getrf", 4, ring=True, grid=(2, 2))
+    assert mc["ring-dma"] == 4 + 4 * (2 - 1)
+    assert mc["all-gather"] == 8
+    mc_off = hc.model_counts("getrf", 4)
+    assert "ring-dma" not in mc_off

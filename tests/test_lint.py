@@ -8,7 +8,9 @@
   tiny DAGs of all four ops + the analysis.spmdcheck collective-
   schedule smoke over the cyclic kernels + the analysis.hlocheck
   compiled-artifact smoke over the cyclic kernels' post-GSPMD HLO
-  and one serving executable + the dplasma_tpu.tuning sweep → DB →
+  and one serving executable + the ring-smoke pass over the explicit
+  ICI-ring kernels' RingOp schedules and the ring.enable=off
+  bit-identity + the dplasma_tpu.tuning sweep → DB →
   driver --autotune consultation smoke) must exit 0 on the repo.
 """
 import pathlib
@@ -83,5 +85,6 @@ def test_lint_all_aggregate_is_clean(capsys):
     assert rc == 0, out.err
     for gate in ("lint_excepts", "jaxlint", "perfdiff-smoke",
                  "palcheck", "dagcheck-smoke", "spmdcheck-smoke",
-                 "serving-smoke", "hlocheck-smoke", "tune-smoke"):
+                 "serving-smoke", "hlocheck-smoke", "ring-smoke",
+                 "tune-smoke"):
         assert f"# {gate}: OK" in out.out

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 11
+    assert doc["schema"] == REPORT_SCHEMA == 12
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -164,6 +164,21 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
                          "applied": {"sweep.lookahead": 2},
                          "nb": 512, "measured_s": 0.84,
                          "entry_key": "potrf|n=8192|float32|g1x1"}]},
+        12: {"schema": 12, "name": "v12", "ops": [], "metrics": [],
+             "pipeline": {"sweep.lookahead": 1, "qr.agg_depth": 4,
+                          "lu.agg_depth": 4, "panel.kernel": "auto",
+                          "panel.qr": "tree", "panel.lu": "rec",
+                          "panel.tree_leaf": 2, "panel.rec_base": 8,
+                          "ring.enable": "auto"},
+             "scaling": [{"op": "potrf", "prec": "d", "n": 256,
+                          "nb": 32, "ring": "auto",
+                          "points": [
+                              {"chips": 1, "grid": [1, 1],
+                               "median_s": 0.42, "gflops": 13.3,
+                               "parallel_efficiency": 1.0},
+                              {"chips": 8, "grid": [2, 4],
+                               "median_s": 0.09, "gflops": 62.1,
+                               "parallel_efficiency": 0.58}]}]},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -419,7 +434,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 11
+    assert doc["schema"] == 12
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

@@ -36,11 +36,13 @@ def test_package_pallas_sites_verify_clean():
     contract passes every check."""
     res = pc.check_package()
     assert res.ok, res.format()
-    assert res.sites_found == 4    # pallas_kernels, _lu, _qr, _dd
+    assert res.sites_found == 6    # pallas_kernels, _lu, _qr, _dd,
+    #                              # _ring (bcast + shift)
     if res.skipped is None:
-        assert res.contracts == 5        # gemm epilogue + matmul +
+        assert res.contracts == 7        # gemm epilogue + matmul +
         #                                # lu panel + qr panel +
-        #                                # dd recombine
+        #                                # dd recombine + ring bcast
+        #                                # + ring shift
 
 
 def test_every_site_is_registered():

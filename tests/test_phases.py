@@ -252,7 +252,7 @@ def test_driver_phase_profile_acceptance(tmp_path, capsys, prog):
     overhead) to the attributed run time."""
     doc = _phase_run(tmp_path, prog)
     out = capsys.readouterr().out
-    assert doc["schema"] == 11
+    assert doc["schema"] == 12
     (op,) = doc["ops"]
     ph = op["phases"]
     spans = ph["spans"]
@@ -520,3 +520,66 @@ def test_perfdiff_compare_api_old_schema_docs():
     res = perfdiff.compare(old, new)
     assert not res["ok"] and res["worst"]["metric"] == "op.median_s"
     assert res["worst"]["regression"] == pytest.approx(1.0)
+
+
+# ------------------------------------------- the ici roofline component
+
+def test_ring_span_makes_ici_bound_reachable():
+    """The satellite this closes: roofline.expected_seconds' ``ici``
+    component was never validated against a measured span — no phase
+    table ever showed ``bound == "ici"``. With the ``ring`` span
+    (the cyclic wrappers' panel-broadcast microprogram) priced by
+    ring_phase_demand, the ici bound is reachable: at this shape the
+    panel-broadcast wire bytes dominate both the latency floor and
+    the (zero) flop/HBM demand."""
+    led = phases.PhaseLedger()
+    led.add("ring", 0.05)
+    model = roofline.phase_model("potrf", 512, 512, 64, 8,
+                                 lookahead=1, grid=(2, 2))
+    assert isinstance(model.get("ring"), dict)
+    assert model["ring"]["ici_bytes"] > 0
+    spans = roofline.attribute_phases(led, model)
+    (row,) = [r for r in spans if r["phase"] == "ring"]
+    assert row["bound"] == "ici"
+    assert 0 < row["expected_s"]
+    assert row["achieved_frac"] == pytest.approx(
+        row["expected_s"] / 0.05)
+
+
+def test_ring_phase_demand_gating():
+    """No ring demand on 1x1 grids or unmodelled classes; the priced
+    bytes follow the ring.enable resolution's schedule (psum on CPU
+    auto — both are valid lower bounds for the probe)."""
+    assert roofline.ring_phase_demand("potrf", 256, 256, 32, 8,
+                                      (1, 1)) is None
+    assert roofline.ring_phase_demand("gemm", 256, 256, 32, 8,
+                                      (2, 2)) is None
+    d = roofline.ring_phase_demand("getrf", 256, 256, 32, 8, (2, 2))
+    assert d["ici_bytes"] > 0
+    assert roofline.phase_model("potrf", 256, 256, 32, 8,
+                                grid=(1, 1)) is not None
+
+
+def test_cyclic_wrappers_emit_ring_span(devices8):
+    """potrf_cyclic under an active ledger runs the panel-broadcast
+    microprogram in a ``ring`` span (and never otherwise — the span
+    only fires while profiling is on, keeping the default path
+    untouched)."""
+    import numpy as np
+
+    from dplasma_tpu.descriptors import Dist
+    from dplasma_tpu.parallel import cyclic
+    from dplasma_tpu.parallel import mesh as pmesh
+
+    nb, nt = 4, 3
+    m = pmesh.make_mesh(2, 2, devices8)
+    with pmesh.use_grid(m):
+        A0 = generators.plghe(float(nt * nb), nt * nb, nb, seed=3872,
+                              dtype="float32")
+        C = cyclic.CyclicMatrix.from_tile(A0, Dist(P=2, Q=2))
+        with phases.profiling() as led:
+            out = cyclic.potrf_cyclic(C, "L")
+        assert np.isfinite(np.asarray(out.data)).all()
+    rows = {r["phase"]: r for r in led.summary()}
+    assert "ring" in rows and rows["ring"]["count"] == 1
+    assert rows["ring"]["measured_s"] > 0

@@ -271,14 +271,14 @@ def test_report_pipeline_section_schema_v6(tmp_path, capsys):
     assert rc == 0
     assert "#+ pipeline: sweep.lookahead=" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 11
+    assert doc["schema"] == 12
     # since v11 the section carries the FULL resolved knob vector
     # (autotuner evidence; --autotune runs add "tuning.source")
     assert set(doc["pipeline"]) == {"sweep.lookahead", "qr.agg_depth",
                                     "lu.agg_depth", "panel.kernel",
                                     "panel.qr", "panel.lu",
                                     "panel.tree_leaf",
-                                    "panel.rec_base"}
+                                    "panel.rec_base", "ring.enable"}
     # per-route panel-engine resolution is recorded, never raw "auto"
     assert doc["pipeline"]["panel.qr"] in ("chain", "tree", "pallas")
     assert doc["pipeline"]["panel.lu"] in ("chain", "rec", "pallas")

@@ -430,7 +430,7 @@ def test_driver_spmdcheck_end_to_end(tmp_path, capsys, devices8):
     assert rc == 0
     assert "spmdcheck[testing_dpotrf]" in out and "OK" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 11
+    assert doc["schema"] == 12
     (entry,) = doc["spmdcheck"]
     assert entry["ok"] and entry["op"] == "testing_dpotrf"
     assert entry["relation"] in ("no-collectives", "structural")
@@ -445,3 +445,116 @@ def test_driver_spmdcheck_flag_parses():
     assert ip.spmdcheck
     ip = parse_arguments(["-N", "64"])
     assert not ip.spmdcheck
+
+
+# --------------------------------------- explicit ICI ring kernels
+
+def test_ring_kernels_reconcile_exactly(devices8):
+    """The ring-wired cyclic kernels (ring=True statics) trace to the
+    ring collective classes and reconcile EXACTLY: the panel
+    broadcast becomes one ring_bcast@q per step, the LU winner-row
+    exchange P-1 ring_shift@p hops per step, everything else
+    unchanged."""
+    for op, extra in (("potrf", {f"psum@{pmesh.ROW_AXIS}": 4,
+                                 f"all_gather@{pmesh.ROW_AXIS}": 4}),
+                      ("getrf", {f"all_gather@{pmesh.ROW_AXIS}": 8,
+                                 f"ring_shift@{pmesh.ROW_AXIS}": 4}),
+                      ("geqrf", {f"psum@{pmesh.ROW_AXIS}": 16})):
+        m = _mesh(2, 2, devices8)
+        desc = cyclic.CyclicDesc(4 * NB, 4 * NB, NB, NB,
+                                 Dist(P=2, Q=2))
+        data = jnp.zeros((2, 2, desc.MTL * NB, desc.NTL * NB),
+                         jnp.float32)
+        KT = min(desc.MT, desc.NT)
+        jit = {"potrf": cyclic._potrf_cyclic_jit,
+               "getrf": cyclic._getrf_cyclic_jit,
+               "geqrf": cyclic._geqrf_cyclic_jit}[op]
+        kw = {"panel": "chain"} if op == "getrf" else {}
+        fn = partial(jit, desc=desc, mesh=m, lookahead=1, ring=True,
+                     **kw)
+        res = sp.check_kernel(fn, (data,), f"{op}_ring", op=op,
+                              KT=KT, lookahead=1, ring=True,
+                              grid=(2, 2))
+        assert res.ok, res.format(op)
+        assert res.relation == "=="
+        want = {f"ring_bcast@{pmesh.COL_AXIS}": KT}
+        want.update(extra)
+        assert res.counts == want
+
+
+def test_ring_partial_fallback_on_size1_axes(devices8):
+    """ring=True on a grid with a size-1 axis keeps the psum class on
+    that axis (the per-axis fallback): 4x1 getrf rings 'p' (the
+    winner-row exchange) while the panel broadcast stays psum@q."""
+    m = _mesh(4, 1, devices8)
+    desc = cyclic.CyclicDesc(4 * NB, 4 * NB, NB, NB, Dist(P=4, Q=1))
+    data = jnp.zeros((4, 1, desc.MTL * NB, desc.NTL * NB),
+                     jnp.float32)
+    KT = min(desc.MT, desc.NT)
+    fn = partial(cyclic._getrf_cyclic_jit, desc=desc, mesh=m,
+                 lookahead=0, panel="chain", ring=True)
+    res = sp.check_kernel(fn, (data,), "getrf_ring_4x1", op="getrf",
+                          KT=KT, lookahead=0, ring=True, grid=(4, 1))
+    assert res.ok, res.format("getrf 4x1 ring")
+    assert res.relation == "=="
+    assert res.counts[f"psum@{pmesh.COL_AXIS}"] == KT
+    assert res.counts[f"ring_shift@{pmesh.ROW_AXIS}"] == KT * 3
+
+
+def test_ring_expected_counts_tie_to_comm_model():
+    """The ring count table's classes must be exactly what
+    spmd_comm_model prices with ring=True, grid by grid — the
+    drift guard extended to the ring schedule."""
+    for op in ("potrf", "getrf", "geqrf"):
+        for grid in ((2, 2), (1, 4), (4, 1)):
+            exp = sp.expected_counts(op, 3, ring=True, grid=grid)
+            assert exp and all(v > 0 for v in exp.values())
+            assert sp.model_classes(op, ring=True, grid=grid) \
+                == set(exp)
+
+
+def test_ring_bcast_program_golden():
+    """The shipped panel-broadcast ring's abstract schedule (chunked
+    and unchunked, every root) drains with zero findings — the
+    verify-before-first-execution contract of kernels.pallas_ring."""
+    from dplasma_tpu.kernels import pallas_ring as pring
+    for n in (2, 3, 4, 8):
+        for root in range(n):
+            for chunks in (1, 4):
+                prog = pring.bcast_program(n, root, chunks)
+                assert sp.simulate_ring(
+                    f"bcast{n}r{root}c{chunks}", prog) == []
+
+
+def test_ring_allreduce_program_golden():
+    """The LU winner-row exchange's schedule (n-1 shift-and-add
+    hops) drains clean for every axis size the kernels run."""
+    from dplasma_tpu.kernels import pallas_ring as pring
+    for n in (2, 3, 4, 8):
+        assert sp.simulate_ring(f"rowsum{n}",
+                                pring.allreduce_program(n)) == []
+
+
+def test_ring_bcast_missing_wait_is_unpaired_semaphore():
+    """Mutation: the last rank of the broadcast chain drops its recv
+    wait — its inbound chunk signal is never drained, and the
+    diagnostic names the rank, the semaphore, and the kernel."""
+    from dplasma_tpu.kernels import pallas_ring as pring
+    prog = pring.bcast_program(4, root=0, chunks=1)
+    prog[3] = [op for op in prog[3] if op.kind != "wait"]
+    diags = sp.simulate_ring("panel_bcast_ring_q", prog)
+    (d,) = [d for d in diags if d.kind == "unpaired-semaphore"]
+    assert d.detail == {"rank": 3, "sem": "dma", "undrained": 1}
+    assert "panel_bcast_ring_q" in d.message
+
+
+def test_ring_bcast_missing_forward_deadlocks():
+    """Mutation: a middle rank refuses to forward — every rank past
+    it starves, and the simulator names the stuck waiter and the
+    peer whose send never comes."""
+    from dplasma_tpu.kernels import pallas_ring as pring
+    prog = pring.bcast_program(4, root=0, chunks=1)
+    prog[1] = [op for op in prog[1] if op.kind != "send"]
+    diags = sp.simulate_ring("panel_bcast_ring_q", prog)
+    assert any(d.kind == "deadlock" and d.detail["rank"] == 2
+               and d.detail["peer"] == 1 for d in diags)

@@ -453,7 +453,7 @@ def test_driver_autotune_consults_db(tmp_path, monkeypatch):
     assert rc == 0
     assert config._MCA_OVERRIDES == before
     doc = json.load(open(rj))
-    assert doc["schema"] == 11
+    assert doc["schema"] == 12
     t = doc["tuning"][0]
     assert t["source"] == "db"
     assert t["key"] == tdb.make_key("potrf", 32, "float32", (1, 1))
@@ -645,3 +645,67 @@ def test_sweep_e2e_acceptance(tmp_path, monkeypatch):
     _doc, default = _median(["-N", str(n)], "testing_spotrf")
     assert tuned <= default * 1.5   # noise slack; the winner beat or
     #                                 matched the default when measured
+
+
+# --------------------------------------- cyclic grids + the ring knob
+
+def test_candidate_configs_ring_modes():
+    """``ring_modes`` adds ring.enable to the knob vector (the
+    ring-vs-psum decision becomes tuned and stored); the mandatory
+    default-first candidate carries the CURRENT resolution, so the
+    baseline stays the out-of-the-box config."""
+    cands = search.candidate_configs(
+        "potrf", 64, nbs=[16], lookaheads=[0],
+        ring_modes=["off", "on"])
+    assert cands[0]["ring.enable"] == "auto"   # current default
+    modes = {c.get("ring.enable") for c in cands[1:]}
+    assert modes == {"off", "on"}
+    # without the knob the vector is unchanged (no spurious key)
+    plain = search.candidate_configs("potrf", 64, nbs=[16],
+                                     lookaheads=[0])
+    assert all("ring.enable" not in c for c in plain)
+
+
+def test_ring_knob_is_a_valid_db_knob(tmp_path):
+    """A stored winner carrying ring.enable round-trips through the
+    committed-DB gate (KNOB_NAMES knows it) and appliable() applies
+    it like any MCA knob."""
+    db = tdb.TuningDB()
+    knobs = tdb.resolved_knobs(nb=16, grid=(2, 2))
+    assert knobs["ring.enable"] == "auto"
+    knobs["ring.enable"] = "on"
+    db.put("potrf", 64, "float32", (2, 2), knobs, 1e-3)
+    p = str(tmp_path / "db.json")
+    db.save(p)
+    back = tdb.TuningDB.load(p)
+    assert back.check() == []
+    applied = tdb.appliable(back.get("potrf", 64, "float32",
+                                     (2, 2))["knobs"])
+    assert applied.get("ring.enable") == "on"
+
+
+def test_measure_config_cyclic_grid_runs_real_kernel(devices8):
+    """--grid 2x2 trials measure the realized block-cyclic kernels
+    (the programs ring.enable actually reshapes), not the GSPMD
+    single-chip ops: a tiny dpotrf trial on the 2x2 CPU mesh returns
+    a positive median and a knob vector pinned to the grid + ring
+    resolution."""
+    med, gf, knobs = search.measure_config(
+        "potrf", 16, "float32", (2, 2),
+        {"nb": 8, "sweep.lookahead": 0, "ring.enable": "off"},
+        nruns=1)
+    assert med > 0 and gf > 0
+    assert knobs["grid"] == "2x2"
+    assert knobs["ring.enable"] == "off"
+
+
+def test_candidate_configs_gemm_nb_axis_per_grid():
+    """The gemm nb-collapse applies to the single-chip XLA-dot path
+    only: cyclic-grid gemm keys keep the tile-size axis (gemm_cyclic's
+    SUMMA step count is shaped by nb)."""
+    flat = search.candidate_configs("gemm", 256, nbs=[32, 64],
+                                    lookaheads=[0])
+    assert len({c["nb"] for c in flat}) == 1      # collapsed
+    cyc = search.candidate_configs("gemm", 256, nbs=[32, 64],
+                                   lookaheads=[0], grid=(2, 2))
+    assert {32, 64} <= {c["nb"] for c in cyc}     # kept

@@ -9,6 +9,10 @@ consults::
     python tools/autotune.py sweep --ops potrf,getrf --sizes 256,512 \\
         --nbs 32,64,128 --lookaheads 0,1 --db tune_db.json \\
         --history bench_history.jsonl
+    python tools/autotune.py sweep --ops potrf --sizes 512 \\
+        --grid 2x2 --ring auto,on,off --db tune_db.json   # cyclic
+        # key space: trials run the realized block-cyclic kernels on
+        # the 2x2 mesh; ring-vs-psum is stored as a tuned decision
     python tools/autotune.py show --db tune_db.json
     python tools/autotune.py prune-report --db tune_db.json
     python tools/autotune.py export --db tune_db.json --out -
@@ -85,8 +89,8 @@ def cmd_sweep(ns) -> int:
         ops=ns.ops, sizes=ns.sizes, dtype=ns.dtype, grid=ns.grid,
         db_file=_db_arg(ns), nbs=ns.nbs, lookaheads=ns.lookaheads,
         agg_depths=ns.agg_depths, panel_kernels=ns.panel_kernels,
-        nruns=ns.nruns, margin=ns.margin, prune=not ns.no_prune,
-        history=ns.history, peaks=peaks,
+        ring_modes=ns.ring, nruns=ns.nruns, margin=ns.margin,
+        prune=not ns.no_prune, history=ns.history, peaks=peaks,
         gate_threshold=ns.gate_threshold, force=ns.force)
     stored = sum(1 for k in report["keys"]
                  if k.get("decision") == "stored")
@@ -204,6 +208,11 @@ def main(argv=None) -> int:
     sp.add_argument("--lookaheads", type=_csv_ints, default=None)
     sp.add_argument("--agg-depths", type=_csv_ints, default=None)
     sp.add_argument("--panel-kernels", type=_csv_strs, default=None)
+    sp.add_argument("--ring", type=_csv_strs, default=None,
+                    metavar="MODES",
+                    help="ring.enable candidates for the cyclic-grid "
+                         "key space (comma list of auto,on,off) — "
+                         "stores ring-vs-psum as a tuned decision")
     sp.add_argument("--nruns", type=int, default=None,
                     help="timed runs per trial (default MCA "
                          "tune.nruns)")

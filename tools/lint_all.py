@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Nine passes, in increasing cost order:
+Ten passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
@@ -36,7 +36,14 @@ Nine passes, in increasing cost order:
    GSPMD-inserted hidden collective fails here before it ever ships
    to hardware), and one serving batched executable must audit clean
    (donation/precision/anti-patterns);
-9. a ``dplasma_tpu.tuning`` smoke pass — a tiny 2-config dpotrf
+9. a ``ring-smoke`` pass — every shipped explicit-ICI-ring kernel's
+   abstract RingOp schedule (kernels.pallas_ring: panel-broadcast
+   ring from every owner column, chunked and unchunked, plus the LU
+   winner-row exchange) must drain in ``simulate_ring`` with zero
+   deadlock/unpaired-semaphore findings, and ``ring.enable=off`` /
+   ``auto`` must be bit-identical to the masked-psum cyclic kernels
+   on the 2x2 CPU mesh (CPU always falls back);
+10. a ``dplasma_tpu.tuning`` smoke pass — a tiny 2-config dpotrf
    sweep on the 1x1 grid must persist a winner to a fresh tuning DB,
    the DB must read back clean (``TuningDB.check``), and a
    subsequent driver ``--autotune`` run must provably consult it
@@ -434,6 +441,75 @@ def run_hlocheck_smoke() -> int:
     return bad
 
 
+def run_ring_smoke() -> int:
+    """The explicit-ICI-ring gate: (a) every shipped ring kernel's
+    abstract RingOp schedule (kernels.pallas_ring: the panel-broadcast
+    ring from every owner column, chunked and unchunked, and the LU
+    winner-row exchange) must drain in the spmdcheck simulator with
+    zero deadlock/unpaired-semaphore findings, over the grids the
+    cyclic kernels run; (b) ``ring.enable=off`` must be bit-identical
+    to the psum path on the 2x2 CPU mesh (and both ``off`` and
+    ``auto`` must resolve to the psum kernels on CPU — the
+    CPU-always-falls-back contract)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dplasma_tpu.analysis import spmdcheck as sp
+    from dplasma_tpu.descriptors import Dist
+    from dplasma_tpu.kernels import pallas_ring as pring
+    from dplasma_tpu.ops import generators
+    from dplasma_tpu.parallel import cyclic
+    from dplasma_tpu.parallel import mesh as pmesh
+    from dplasma_tpu.utils import config as _cfg
+
+    if not jax.config.jax_compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(_ROOT / ".jax_cache"))
+    bad = 0
+    for P, Q in ((2, 2), (1, 4), (2, 4), (4, 2)):
+        for name, prog in pring.kernel_programs(P, Q).items():
+            diags = sp.simulate_ring(f"{name}@{P}x{Q}", prog)
+            for d in diags:
+                sys.stderr.write(f"ring-smoke: {d.kind}: "
+                                 f"{d.message}\n")
+            bad += len(diags)
+    # (b) off = bit-identical psum path on the 2x2 CPU mesh
+    P, Q = 2, 2
+    if P * Q > len(jax.devices()):
+        print(f"# ring-smoke: {P}x{Q} identity leg skipped "
+              f"({len(jax.devices())} device(s) available)")
+        return bad
+    nb, nt = 4, 3
+    m = pmesh.make_mesh(P, Q)
+    d = Dist(P=P, Q=Q)
+    with pmesh.use_grid(m):
+        A0 = generators.plghe(float(nt * nb), nt * nb, nb, seed=3872,
+                              dtype="float32")
+        C = cyclic.CyclicMatrix.from_tile(A0, d)
+        for mode in ("off", "auto"):
+            with _cfg.override_scope({"ring.enable": mode},
+                                     label="ring-smoke"):
+                if cyclic._cyclic_ring(C.desc, C.dtype, m,
+                                       need_row=True):
+                    sys.stderr.write(
+                        f"ring-smoke: ring.enable={mode} resolved to "
+                        f"the ring path on a CPU backend (must fall "
+                        f"back)\n")
+                    bad += 1
+                via_mca = cyclic.potrf_cyclic(C, "L").data
+            direct = cyclic._potrf_cyclic_jit(
+                C.data, C.desc, m, cyclic._cyclic_lookahead(), False)
+            if not np.array_equal(np.asarray(via_mca),
+                                  np.asarray(direct)):
+                sys.stderr.write(
+                    f"ring-smoke: ring.enable={mode} output is not "
+                    f"bit-identical to the psum path on the "
+                    f"{P}x{Q} CPU mesh\n")
+                bad += 1
+    return bad
+
+
 def run_tune_smoke() -> int:
     """The autotuner's closed loop, CPU-fast: a tiny 2-config dpotrf
     sweep persists a winner into a fresh DB, the DB reads back clean
@@ -526,6 +602,7 @@ def main(argv=None) -> int:
                      ("spmdcheck-smoke", run_spmdcheck_smoke),
                      ("serving-smoke", run_serving_smoke),
                      ("hlocheck-smoke", run_hlocheck_smoke),
+                     ("ring-smoke", run_ring_smoke),
                      ("tune-smoke", run_tune_smoke)):
         n = fn()
         print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")
