@@ -98,6 +98,9 @@ class IParam:
     profile: Optional[str] = None    # DTPUPROF1 binary trace
     report: Optional[str] = None     # versioned JSON run-report
     jaxtrace: Optional[str] = None   # JAX/XLA profiler logdir
+    # live telemetry (--telemetry[=prom-file]): streaming metrics
+    # exporter + flight recorder, v13 "telemetry" report section
+    telemetry: Optional[str] = None
     # performance attribution (--phase-profile/--peaks-file)
     phase_profile: bool = False      # per-phase attributed pass (v5)
     peaks_file: Optional[str] = None  # roofline peaks source
@@ -197,6 +200,15 @@ Optional arguments:
                      model, DAG analytics; default file: report.json)
  --jaxtrace[=dir]  : capture a device-side JAX/XLA profiler trace into
                      dir (default: jax_trace)
+ --telemetry[=file]: live telemetry for this run: a streaming metrics
+                     exporter rewrites the Prometheus text snapshot
+                     in file (default: telemetry.prom) every MCA
+                     telemetry.interval_s seconds, and a bounded
+                     flight recorder of structured events (op starts/
+                     finishes, remediation rungs, injected faults)
+                     lands in the run-report (schema v13 "telemetry"
+                     section) — and on disk (MCA telemetry.flight_path)
+                     whenever a remediation ladder walks
  --phase-profile   : phase-level performance attribution: one extra
                      eager attributed pass after the timed loop, with
                      scoped phase timers (panel/lookahead/far_flush/
@@ -326,6 +338,8 @@ def _parse_arguments(args: list[str], ip: IParam) -> IParam:
                 ip.report = val if eq else "report.json"
             elif name == "jaxtrace":
                 ip.jaxtrace = val if eq else "jax_trace"
+            elif name == "telemetry":
+                ip.telemetry = val if eq else "telemetry.prom"
             elif name in _LONG:
                 field_, conv = _LONG[name]
                 if conv is None:
@@ -499,6 +513,19 @@ class Driver:
         self.prof.save_info("driver", name)
         self.prof.save_info("prec", getattr(ip, "prec", "d"))
         self.report = RunReport(name, ip)
+        # --telemetry: the live instruments — streaming Prometheus
+        # exporter over the run's metrics registry + a flight recorder
+        # of structured run events (v13 "telemetry" report section)
+        self.telemetry = None
+        if getattr(ip, "telemetry", None):
+            from dplasma_tpu.observability.telemetry import Telemetry
+            self.telemetry = Telemetry(rank=ip.rank)
+            self.telemetry.start_exporter(self.report.metrics,
+                                          ip.telemetry)
+            self.telemetry.flight.record(
+                "run_start", driver=name,
+                prec=getattr(ip, "prec", "d"), N=ip.N, NB=ip.NB,
+                grid=[ip.P, ip.Q])
         try:
             # cache now: the lookup can fail after a backend error
             self._cpu = jax.devices("cpu")[0]
@@ -629,6 +656,15 @@ class Driver:
             _cfg.pop_overrides(frame)
         self._mca_frames = []
         ip = self.ip
+        if getattr(self, "telemetry", None) is not None:
+            # final exporter flush + the v13 section, BEFORE the
+            # report writes below so the document carries it
+            self.telemetry.close()
+            self.report.add_telemetry(self.telemetry.summary())
+            if ip.rank == 0 and ip.loud >= 1 and self.telemetry.exporter:
+                ex = self.telemetry.exporter
+                print(f"#+ telemetry: {ex.flushes} snapshot(s) "
+                      f"exported to {ex.path}")
         if getattr(ip, "profile", None):
             try:
                 self.prof.write(ip.profile)
@@ -940,6 +976,9 @@ class Driver:
         from dplasma_tpu.resilience import inject as rinject
         from dplasma_tpu.utils import profiling
         ip, name = self.ip, label or self.name
+        tel = getattr(self, "telemetry", None)
+        if tel is not None:
+            tel.flight.record("op_start", op=name, flops=flops)
         resil = guard.enabled(ip)
         ladder = guard.Ladder(ip, name, fallbacks) if resil else None
         plan = None
@@ -1152,6 +1191,10 @@ class Driver:
             name, prec=ip.prec, flops=flops, enq_s=enq, warmup_s=warm,
             dest_s=dest, runs_s=times, gflops=gflops, xla=xla_info,
             comm=comm, dag=dag_info, phases=phase_info)
+        if tel is not None:
+            tel.flight.record("op_done", op=name, winner=self.winner,
+                              best_s=best, gflops=gflops,
+                              nruns=len(times))
         # roofline ledger: expected-vs-measured for the whole op
         # (schema v5 "roofline" section)
         rl_entry = None
@@ -1242,6 +1285,24 @@ class Driver:
         summary = ladder.summary(injection)
         self.winner = ladder.winner
         self.report.add_resilience(summary)
+        tel = getattr(self, "telemetry", None)
+        if tel is not None:
+            for f in (injection or {}).get("faults") or []:
+                tel.flight.record("inject", op=ladder.name, fault=f)
+            for a in summary["attempts"]:
+                tel.flight.record(
+                    "ladder", op=ladder.name, action=a["action"],
+                    label=a["label"], ok=a["ok"],
+                    classification=a["classification"])
+            tel.flight.record("remediation", op=ladder.name,
+                              outcome=summary["outcome"],
+                              winner=summary["winner"])
+            if summary["outcome"] != "clean":
+                # a walked ladder dumps its evidence to disk, exactly
+                # like a serving incident (MCA telemetry.flight_path)
+                path = tel.flight_dump_path()
+                if path:
+                    tel.flight.dump(path)
         reg = self.report.metrics
         lbl = dict(op=ladder.name, prec=self.ip.prec)
         reg.counter("resilience_attempts_total", **lbl).inc(

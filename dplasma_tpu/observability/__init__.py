@@ -30,18 +30,34 @@ skeleton in :mod:`dplasma_tpu.utils.profiling`:
   defaults), with a ``bound ∈ {mxu, hbm, ici, latency}`` label and
   ``achieved_frac``. ``tools/perfdiff.py`` closes the loop across
   runs (run-report vs run-report or vs the ``bench_history.jsonl``
-  ledger).
+  ledger);
+* :mod:`.tracing` — always-on, thread-safe per-request span trees
+  (the serving layer's live counterpart to :mod:`.phases`; Chrome
+  export + the ``tools/tracecat.py --merge`` span document);
+* :mod:`.telemetry` — the streaming half: a Prometheus text-snapshot
+  exporter with a periodic background flusher (MCA
+  ``telemetry.export_path``/``telemetry.interval_s``) and the
+  bounded flight recorder of structured events that rides the
+  run-report (schema v13 ``"telemetry"``) and dumps to disk on a
+  serving incident.
 """
-from dplasma_tpu.observability import phases, roofline
-from dplasma_tpu.observability.chrome import profile_to_chrome
+from dplasma_tpu.observability import phases, roofline, telemetry
+from dplasma_tpu.observability.chrome import (merge_to_chrome,
+                                              profile_to_chrome)
 from dplasma_tpu.observability.comm import comm_volume_model
 from dplasma_tpu.observability.dag import dag_stats
 from dplasma_tpu.observability.metrics import MetricsRegistry
 from dplasma_tpu.observability.report import REPORT_SCHEMA, RunReport
+from dplasma_tpu.observability.telemetry import (FlightRecorder,
+                                                 MetricsExporter,
+                                                 Telemetry)
+from dplasma_tpu.observability.tracing import Tracer
 from dplasma_tpu.observability.xla import capture_compiled
 
 __all__ = [
-    "MetricsRegistry", "RunReport", "REPORT_SCHEMA", "capture_compiled",
-    "comm_volume_model", "dag_stats", "phases", "profile_to_chrome",
-    "roofline",
+    "FlightRecorder", "MetricsExporter", "MetricsRegistry",
+    "RunReport", "REPORT_SCHEMA", "Telemetry", "Tracer",
+    "capture_compiled", "comm_volume_model", "dag_stats",
+    "merge_to_chrome", "phases", "profile_to_chrome", "roofline",
+    "telemetry",
 ]

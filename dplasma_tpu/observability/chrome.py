@@ -6,10 +6,19 @@ and ``chrome://tracing`` both load. Spans become complete ('X') events
 on a (pid, tid) = (rank, track) grid; run metadata (the
 ``save_[di]info`` pairs) rides in ``otherData`` and per-event flops in
 ``args`` so Perfetto queries can compute achieved rates per span.
+
+:func:`merge_to_chrome` is the multi-source fusion behind
+``tools/tracecat.py --merge``: per-rank DTPUPROF1 traces, serving span
+documents (:meth:`dplasma_tpu.observability.tracing.Tracer.to_doc`),
+and phase-ledger tables land in ONE document with distinct
+(pid, tid) = (rank, track) lanes, every timestamp rebased to the
+earliest real event and the event stream sorted time-monotone — a
+multichip run becomes one picture (each chip's ``ring``/``panel``/...
+phases side by side with the serving request lanes).
 """
 from __future__ import annotations
 
-from typing import Dict, Iterable, Tuple
+from typing import Dict, Iterable, List, Tuple
 
 
 def profile_to_chrome(events: Iterable[tuple], info: Dict[str, str],
@@ -33,14 +42,8 @@ def profile_to_chrome(events: Iterable[tuple], info: Dict[str, str],
     trace = []
     tracks = set()
     for e in evs:
-        nm, b, en, fl = e[0], e[1], e[2], e[3]
-        track = int(e[4]) if len(e) > 4 else 0
+        ev, track = _profile_event(e, pid, t0)
         tracks.add(track)
-        ev = {"name": nm, "cat": "span", "ph": "X",
-              "ts": (b - t0) / 1e3, "dur": max(en - b, 0) / 1e3,
-              "pid": pid, "tid": track}
-        if fl:
-            ev["args"] = {"flops": fl}
         trace.append(ev)
     # metadata events name the process/threads for the viewer UI
     meta = [{"name": "process_name", "ph": "M", "pid": pid,
@@ -51,3 +54,159 @@ def profile_to_chrome(events: Iterable[tuple], info: Dict[str, str],
     return {"traceEvents": meta + trace,
             "displayTimeUnit": "ms",
             "otherData": dict(info)}
+
+
+def _profile_event(e: tuple, pid: int, t0: int) -> Tuple[dict, int]:
+    """One decoded DTPUPROF1 event (4/5-tuple) -> a complete ('X')
+    event — the ONE conversion both the single-profile and the merge
+    views share. Returns (event, track)."""
+    nm, b, en, fl = e[0], e[1], e[2], e[3]
+    track = int(e[4]) if len(e) > 4 else 0
+    ev = {"name": nm, "cat": "span", "ph": "X",
+          "ts": (b - t0) / 1e3, "dur": max(en - b, 0) / 1e3,
+          "pid": pid, "tid": track}
+    if fl:
+        ev["args"] = {"flops": fl}
+    return ev, track
+
+
+def spans_to_chrome(spans: Iterable[dict], rank: int = 0,
+                    name: str = "serving") -> dict:
+    """Serving tracer spans -> a Chrome trace-event document (the
+    single-source face of the serving lane; :func:`merge_to_chrome`
+    embeds the same spans into a fused timeline)."""
+    evs = list(spans)
+    t0 = min((e["t0_ns"] for e in evs), default=0)
+    trace = []
+    tracks = set()
+    for e in evs:
+        tracks.add(int(e.get("track", 0)))
+        trace.append(_span_event(e, int(e.get("rank", rank)), t0))
+    meta = [{"name": "process_name", "ph": "M", "pid": rank,
+             "args": {"name": f"{name} rank {rank}"}}]
+    for tr in sorted(tracks):
+        meta.append({"name": "thread_name", "ph": "M", "pid": rank,
+                     "tid": tr, "args": {"name": f"serving lane {tr}"}})
+    trace.sort(key=lambda e: e["ts"])
+    return {"traceEvents": meta + trace, "displayTimeUnit": "ms",
+            "otherData": {"source": name, "rank": str(rank)}}
+
+
+def _span_event(span: dict, pid: int, t0: int) -> dict:
+    """One serving tracer span -> one complete ('X') event."""
+    ev = {"name": span["name"], "cat": "serving", "ph": "X",
+          "ts": (span["t0_ns"] - t0) / 1e3,
+          "dur": max(span["t1_ns"] - span["t0_ns"], 0) / 1e3,
+          "pid": pid, "tid": int(span.get("track", 0))}
+    args = dict(span.get("attrs") or {})
+    if span.get("request") is not None:
+        args["request"] = span["request"]
+    if span.get("parent", -1) >= 0:
+        args["parent"] = span["parent"]
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def merge_to_chrome(profiles: Iterable[Tuple[Iterable[tuple], Dict[str, str]]] = (),
+                    span_docs: Iterable[dict] = (),
+                    phase_tables: Iterable[Tuple[str, List[dict]]] = (),
+                    name: str = "merged") -> dict:
+    """Fuse traces from three sources into one multi-lane timeline.
+
+    * ``profiles`` — ``(events, info)`` pairs, one per rank (decoded
+      DTPUPROF1 5-tuples); each keeps its ``(pid, tid)`` =
+      (rank, track) grid. Two profiles claiming the same rank get
+      distinct pids (first wins the raw rank; collisions shift up).
+    * ``span_docs`` — serving span documents (``Tracer.to_doc()``);
+      each gets its own pid above every profile rank, one tid per
+      dispatch-thread lane, request ids in ``args``.
+    * ``phase_tables`` — ``(label, rows)`` with
+      :meth:`~dplasma_tpu.observability.phases.PhaseLedger.summary`
+      rows. A ledger records durations, not wall timestamps, so its
+      lane is *synthetic*: the self-time spans are laid end-to-end
+      from the merged timeline's origin — an honest aggregate lane
+      (disjoint self-times sum to the attributed run), clearly
+      labelled ``(synthetic layout)``.
+
+    Every real timestamp is rebased to the earliest event across all
+    sources; the merged ``traceEvents`` stream is sorted
+    time-monotone (metadata first).
+    """
+    profs = [(list(evs), dict(info)) for evs, info in profiles]
+    sdocs = [dict(d) for d in span_docs]
+    tables = [(str(lbl), list(rows)) for lbl, rows in phase_tables]
+    # global origin over every REAL timestamp (profile ns + span ns)
+    t0s = []
+    for evs, _info in profs:
+        t0s.extend(e[1] for e in evs)
+    for d in sdocs:
+        t0s.extend(s["t0_ns"] for s in d.get("spans") or [])
+    t0 = min(t0s, default=0)
+
+    meta: List[dict] = []
+    trace: List[dict] = []
+    used_pids = set()
+
+    def claim_pid(want: int) -> int:
+        pid = want
+        while pid in used_pids:
+            pid += 1
+        used_pids.add(pid)
+        return pid
+
+    other: Dict[str, str] = {"merged": name}
+    for i, (evs, info) in enumerate(profs):
+        try:
+            rank = int(info.get("rank", i))
+        except (TypeError, ValueError):
+            rank = i
+        pid = claim_pid(rank)
+        src = info.get("source", f"rank{rank}")
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"{name} rank {rank}"}})
+        tracks = set()
+        for e in evs:
+            ev, track = _profile_event(e, pid, t0)
+            tracks.add(track)
+            trace.append(ev)
+        for tr in sorted(tracks):
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tr, "args": {"name": f"track {tr}"}})
+        for k, v in info.items():
+            other[f"{src}:{k}"] = str(v)
+    base = (max(used_pids) + 1) if used_pids else 0
+    for i, d in enumerate(sdocs):
+        pid = claim_pid(base + i + 1000)
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"serving spans [{i}] "
+                                      f"(rank {d.get('rank', 0)})"}})
+        tracks = set()
+        for s in d.get("spans") or []:
+            tracks.add(int(s.get("track", 0)))
+            trace.append(_span_event(s, pid, t0))
+        for tr in sorted(tracks):
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tr,
+                         "args": {"name": f"serving lane {tr}"}})
+    for i, (label, rows) in enumerate(tables):
+        pid = claim_pid(base + i + 2000)
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"phases: {label} "
+                                      f"(synthetic layout)"}})
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": "phase self-time"}})
+        cursor = 0.0    # µs from the merged origin, end-to-end
+        for row in rows:
+            dur_us = float(row.get("measured_s", 0.0)) * 1e6
+            ev = {"name": str(row.get("phase", "?")), "cat": "phase",
+                  "ph": "X", "ts": cursor, "dur": max(dur_us, 0.0),
+                  "pid": pid, "tid": 0,
+                  "args": {"count": row.get("count"),
+                           "measured_s": row.get("measured_s"),
+                           "total_s": row.get("total_s")}}
+            trace.append(ev)
+            cursor += max(dur_us, 0.0)
+    trace.sort(key=lambda e: e["ts"])
+    return {"traceEvents": meta + trace, "displayTimeUnit": "ms",
+            "otherData": other}

@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 12``).
+"""The versioned JSON run-report (``"schema": 13``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -93,6 +93,20 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                                  # scaling curves; efficiency =
                                  # T_1 / (chips * T_chips), higher
                                  # is better)
+     "telemetry": {"spans": {"enabled", "opened", "closed",
+                             "recorded", "dropped", "balanced"},
+                   "exporter": {"path", "interval_s",
+                                "flushes"} | null,
+                   "flight_recorder": {"capacity", "recorded",
+                                       "dropped",
+                                       "events": [{"seq", "t_ns",
+                                                   "kind",
+                                                   ...}]}},   # (v13,
+                                 # observability.telemetry: the live
+                                 # instruments' end-of-run summary —
+                                 # tracing span ledger, streaming
+                                 # exporter provenance, and the
+                                 # flight recorder's event ring)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
@@ -126,9 +140,15 @@ per-chip-count scaling curves of the cyclic factorizations —
 ``tools/multichip.py`` runs each op over 1/2/4/8 chips and records
 median seconds, GFlop/s, and parallel efficiency per point, gated
 higher-better through perfdiff) plus the ``ring.enable`` key in
-``"pipeline"`` (the explicit-ICI-ring knob, kernels.pallas_ring). All
+``"pipeline"`` (the explicit-ICI-ring knob, kernels.pallas_ring);
+13 adds ``"telemetry"`` (the live-instrument summary —
+observability.telemetry/tracing: the always-on serving span ledger,
+the streaming Prometheus exporter's provenance, and the flight
+recorder's bounded event ring, dumped whole so an incident report
+carries its own evidence; servebench's ``"serving"`` entries gain
+``trace_overhead_frac``, which perfdiff gates lower-better). All
 additive — v1 readers of the other keys are unaffected; this reader
-accepts <= 12 (:func:`load_report` tolerates every v1-v12 vintage,
+accepts <= 13 (:func:`load_report` tolerates every v1-v13 vintage,
 filling the always-present keys).
 """
 from __future__ import annotations
@@ -141,7 +161,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 12
+REPORT_SCHEMA = 13
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -151,8 +171,12 @@ def run_stats(runs_s: List[float]) -> dict:
     the report timings and the metrics snapshot. A no-runs entry
     (``nruns=0`` dry runs) carries explicit nulls for every statistic
     so the document still serializes/round-trips cleanly."""
-    h = Histogram()
-    h.samples = list(runs_s)
+    # exact_cap = the run count: report statistics stay EXACT at any
+    # nruns (the bounded default exists for unbounded serving
+    # traffic, not for a list we hold in full right here)
+    h = Histogram(exact_cap=len(runs_s))
+    for v in runs_s:
+        h.observe(v)
     s = h.stats()
     return {"nruns": len(runs_s), "runs_s": list(runs_s),
             "best_s": s["min"],
@@ -179,6 +203,7 @@ class RunReport:
         self.hlocheck: List[dict] = []  # --hlocheck audits (v10)
         self.tuning: List[dict] = []    # --autotune consultations (v11)
         self.scaling: List[dict] = []   # per-chip-count curves (v12)
+        self.telemetry: Optional[dict] = None  # live instruments (v13)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
         self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
@@ -259,6 +284,13 @@ class RunReport:
         self.scaling.append(summary)
         return summary
 
+    def add_telemetry(self, summary: dict) -> dict:
+        """Record the live-instrument summary (schema v13; see
+        observability.telemetry.Telemetry.summary — span ledger,
+        exporter provenance, the flight recorder's event ring)."""
+        self.telemetry = summary
+        return summary
+
     def add_roofline(self, entry: dict) -> dict:
         """Record one per-op roofline ledger entry (schema v5; see
         observability.roofline.op_roofline)."""
@@ -300,6 +332,8 @@ class RunReport:
             doc["tuning"] = self.tuning
         if self.scaling:
             doc["scaling"] = self.scaling
+        if self.telemetry is not None:
+            doc["telemetry"] = self.telemetry
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
         if self.roofline:
@@ -334,7 +368,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v11) loads: the schema history is purely
+    Every older vintage (v1-v12) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

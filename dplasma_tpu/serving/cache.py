@@ -200,6 +200,10 @@ class ExecutableCache:
             else _cfg.mca_get_int("serving.cache_capacity", 32), 1)
         self.metrics = metrics if metrics is not None \
             else MetricsRegistry()
+        #: optional flight recorder (observability.telemetry): the
+        #: service points this at its ring so evictions/invalidations
+        #: become structured events a production incident can replay
+        self.recorder = None
         self._d: "collections.OrderedDict[CacheKey, Entry]" = \
             collections.OrderedDict()
         # the service dispatches from caller AND timer threads: every
@@ -246,9 +250,13 @@ class ExecutableCache:
                                                key))
             self._d[key] = entry
             while len(self._d) > self.capacity:
-                self._d.popitem(last=False)
+                old_key, old = self._d.popitem(last=False)
                 self.metrics.counter(
                     "serving_cache_evictions_total").inc()
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "cache_evict", op=old_key.op, n=old_key.n,
+                        batch=old_key.batch, hits=old.hits)
             self.metrics.gauge("serving_cache_entries").set(
                 len(self._d))
             return entry
@@ -295,6 +303,10 @@ class ExecutableCache:
                     "serving_cache_invalidations_total").inc()
                 self.metrics.gauge("serving_cache_entries").set(
                     len(self._d))
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "cache_invalidate", op=key.op, n=key.n,
+                        batch=key.batch)
             return gone
 
     def stats(self) -> dict:

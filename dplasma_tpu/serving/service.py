@@ -29,6 +29,20 @@ cache marks such executables tainted and the service drops them after
 dispatch, so retries re-compile clean (the serving analogue of
 ``inject.disarm`` clearing jax's trace caches).
 
+Telemetry (:mod:`dplasma_tpu.observability.telemetry`): every submit
+is stamped with a monotonically increasing ``request_id`` (on the
+:class:`SolveFuture`, in ``meta``, and in every ``#+ serving:``
+verbose line and remediation stderr note, so a failed batch-mate is
+attributable); the always-on tracer records a span tree per request —
+``queue_wait`` → ``batch`` (``batch_form``/``cache``/``dispatch``) →
+``scatter_gate`` → each ``ladder:<rung>`` — and the flight recorder
+keeps a bounded ring of structured events (submits, dispatches, gate
+failures, ladder rungs, injections, cache evictions) that is dumped
+to MCA ``telemetry.flight_path`` the moment a request fails its gate
+and walks the ladder. Live gauges (``serving_queue_depth``,
+``serving_inflight_batches``, ``serving_cache_entries``) feed the
+streaming Prometheus exporter.
+
 Conventions: ``A`` is the full matrix (posv reads the lower triangle
 of a full symmetric operand); ``b`` may be 1-D (a single right-hand
 side — the result is returned 1-D) or ``(n, nrhs)``. The IR ops
@@ -45,12 +59,18 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dplasma_tpu.observability.metrics import MetricsRegistry
+from dplasma_tpu.observability import telemetry as tel_mod
+from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 from dplasma_tpu.resilience import guard, inject
 from dplasma_tpu.serving import batched
 from dplasma_tpu.serving import cache as cache_mod
 from dplasma_tpu.utils import config as _cfg
 
+_cfg.mca_register(
+    "serving.verbose", "0",
+    "Verbosity of the SolverService: >=1 prints '#+ serving:' lines "
+    "(dispatches, gate failures, ladder rungs) with the request id "
+    "every line is attributable to.")
 _cfg.mca_register(
     "serving.max_batch", "16",
     "Batching bound of the SolverService scheduler: a compatible "
@@ -100,14 +120,18 @@ class _Request:
     future: "SolveFuture"
     t_submit: float
     kwargs: dict
+    rid: int = 0           # the stamped request id
+    t_submit_ns: int = 0   # wall-clock twin of t_submit (tracing)
 
 
 class SolveFuture:
     """Handle for one submitted solve. ``result()`` drives the
     scheduler if the request is still pending (a blocked caller is a
     latency bound, not a deadlock), then returns the solution;
-    ``meta`` carries latency, batch, verification, and the resilience
-    summary when the request walked the ladder."""
+    ``request_id`` is the service-stamped monotone id every telemetry
+    span, flight-recorder event, and verbose/stderr line about this
+    request carries; ``meta`` carries latency, batch, verification,
+    and the resilience summary when the request walked the ladder."""
 
     def __init__(self, service: "SolverService", group):
         self._service = service
@@ -115,6 +139,7 @@ class SolveFuture:
         self._event = threading.Event()
         self._value = None
         self._error: Optional[BaseException] = None
+        self.request_id: int = 0
         self.meta: dict = {}
 
     def done(self) -> bool:
@@ -152,7 +177,9 @@ class SolverService:
                  max_wait_ms: Optional[float] = None,
                  cache: Optional[cache_mod.ExecutableCache] = None,
                  metrics: Optional[MetricsRegistry] = None,
-                 max_retries: Optional[int] = None, check: bool = True):
+                 max_retries: Optional[int] = None, check: bool = True,
+                 telemetry: Optional[tel_mod.Telemetry] = None,
+                 verbose: Optional[int] = None):
         self.nb = int(nb)
         self.max_batch = max(
             max_batch if max_batch is not None
@@ -172,6 +199,14 @@ class SolverService:
         self.cache = cache if cache is not None \
             else cache_mod.ExecutableCache(metrics=self.metrics)
         self.check = bool(check)
+        # the live instruments: always-on span tracer + flight
+        # recorder (module docstring); cache evictions/invalidations
+        # land in the same flight ring
+        self.telemetry = telemetry if telemetry is not None \
+            else tel_mod.Telemetry()
+        self.cache.recorder = self.telemetry.flight
+        self.verbose = int(verbose) if verbose is not None \
+            else _cfg.mca_get_int("serving.verbose", 0)
         self.resilience: List[dict] = []   # ladder summaries
         # per-cache-key tuning-DB consultation memo (the serving face
         # of dplasma_tpu.tuning: resolved ONCE per key so the same key
@@ -189,6 +224,9 @@ class SolverService:
         self._latencies: List[float] = []
         self._batches = 0
         self._requests = 0
+        self._next_rid = 0      # monotone request-id stamp
+        self._queued = 0        # live queue depth (gauge)
+        self._inflight = 0      # live in-flight batches (gauge)
 
     # ------------------------------------------------------ submission
     def submit(self, op: str, A, b, **kwargs) -> SolveFuture:
@@ -222,15 +260,20 @@ class SolverService:
         fut = SolveFuture(self, group)
         req = _Request(op=op, a=a, b=bb, vec=vec, n=n, nrhs=nrhs,
                        future=fut, t_submit=time.perf_counter(),
-                       kwargs=dict(kwargs))
+                       kwargs=dict(kwargs),
+                       t_submit_ns=time.time_ns())
         dispatch_now = None
         with self._lock:
             self._requests += 1
+            self._next_rid += 1
+            req.rid = fut.request_id = self._next_rid
             self.metrics.counter("serving_requests_total", op=op).inc()
             lst = self._pending.setdefault(group, [])
             lst.append(req)
+            self._queued += 1
             if len(lst) >= self.max_batch:
                 dispatch_now = self._pending.pop(group)
+                self._queued -= len(dispatch_now)
                 self._cancel_timer(group)
             elif len(lst) == 1 and self.max_wait_ms > 0:
                 t = threading.Timer(self.max_wait_ms / 1000.0,
@@ -238,6 +281,12 @@ class SolverService:
                 t.daemon = True
                 self._timers[group] = t
                 t.start()
+            # published under the lock, like _drive's update: a gauge
+            # set after release could land out of order against a
+            # racing submit and stick a stale depth in the exporter
+            self.metrics.gauge("serving_queue_depth").set(self._queued)
+        self.telemetry.flight.record("submit", request=req.rid, op=op,
+                                     n=n, nrhs=nrhs)
         if dispatch_now:
             self._dispatch(group, dispatch_now)
         return fut
@@ -252,6 +301,10 @@ class SolverService:
         with self._lock:
             reqs = self._pending.pop(group, None)
             self._cancel_timer(group)
+            if reqs:
+                self._queued -= len(reqs)
+                self.metrics.gauge("serving_queue_depth").set(
+                    self._queued)
         if reqs:
             self._dispatch(group, reqs)
 
@@ -270,6 +323,7 @@ class SolverService:
             for t in self._timers.values():
                 t.cancel()
             self._timers.clear()
+        self.telemetry.close()     # final exporter flush, if running
 
     # -------------------------------------------------------- dispatch
     def _stack(self, key: cache_mod.CacheKey, reqs: List[_Request]):
@@ -346,94 +400,169 @@ class SolverService:
 
     def _run(self, key: cache_mod.CacheKey, reqs: List[_Request]):
         """Compile-or-hit + dispatch one bucket-shaped batch; returns
-        (X, bwds, info). The tuning-DB consultation's knobs scope the
-        compile (a cache hit never re-traces, so the overrides only
-        matter on a miss — and the memoized consultation keeps them
-        identical per key). Tainted entries (compiled while a fault
-        plan fired — poisoned for life) are dropped so any retry
-        re-compiles clean."""
+        (X, bwds, info, cache_hit). The tuning-DB consultation's knobs
+        scope the compile (a cache hit never re-traces, so the
+        overrides only matter on a miss — and the memoized
+        consultation keeps them identical per key). Tainted entries
+        (compiled while a fault plan fired — poisoned for life) are
+        dropped so any retry re-compiles clean."""
         import jax.numpy as jnp
-        As, bs = self._stack(key, reqs)
-        Aj, bj = jnp.asarray(As), jnp.asarray(bs)   # ONE transfer
-        tune = self._tuning_for(key)
-        builder = self._builder(key, reqs[0].kwargs,
-                                nb=tune["nb"] if tune else None)
-        if tune and tune["applied"]:
-            # the override scope is process-global and LIFO: hold
-            # _TUNE_LOCK for the whole push..pop so concurrent
-            # dispatch threads never interleave their frames
-            with _TUNE_LOCK, _cfg.override_scope(tune["applied"],
-                                                 label="serving-tune"):
+        tracer = self.telemetry.tracer
+        with tracer.span("batch_form", op=key.op, batch=len(reqs)):
+            As, bs = self._stack(key, reqs)
+            Aj, bj = jnp.asarray(As), jnp.asarray(bs)  # ONE transfer
+        with tracer.span("cache", op=key.op) as cattrs:
+            # probed ONCE; the span attr, the flight event, and the
+            # verbose line all reuse this answer (a racing eviction
+            # between two probes would make them disagree)
+            hit = cattrs["hit"] = key in self.cache
+            tune = self._tuning_for(key)
+            builder = self._builder(key, reqs[0].kwargs,
+                                    nb=tune["nb"] if tune else None)
+            if tune and tune["applied"]:
+                # the override scope is process-global and LIFO: hold
+                # _TUNE_LOCK for the whole push..pop so concurrent
+                # dispatch threads never interleave their frames
+                with _TUNE_LOCK, \
+                        _cfg.override_scope(tune["applied"],
+                                            label="serving-tune"):
+                    entry = self.cache.get(key, builder, Aj, bj)
+            else:
                 entry = self.cache.get(key, builder, Aj, bj)
-        else:
-            entry = self.cache.get(key, builder, Aj, bj)
-        out = entry.fn(Aj, bj)
+        with tracer.span("dispatch", op=key.op, batch=len(reqs)):
+            out = entry.fn(Aj, bj)
+            res = (np.asarray(out[0]), np.asarray(out[1]),
+                   out[2] if len(out) > 2 else None, hit)
         if entry.tainted:
             self.cache.invalidate(key)
-        return (np.asarray(out[0]), np.asarray(out[1]),
-                out[2] if len(out) > 2 else None)
+        return res
 
     def _dispatch(self, group, reqs: List[_Request]) -> None:
         import jax.numpy as jnp
         key = group._replace(batch=cache_mod.bucket_batch(len(reqs)))
-        try:
-            X, bwds, info = self._run(key, reqs)
-        except Exception as exc:       # compile/dispatch failure:
-            for r in reqs:             # every request fails loudly
-                r.future._fail(exc)
-            raise
+        tracer = self.telemetry.tracer
+        rids = [r.rid for r in reqs]
+        # queue-wait spans close here, retroactively: the wait ended
+        # the moment this dispatch picked the group up
+        now_ns = time.time_ns()
+        for r in reqs:
+            # no attrs: the request's op is on its submit event, and
+            # this add() runs per request on the always-on hot path
+            tracer.add("queue_wait", r.t_submit_ns, now_ns,
+                       request=r.rid)
         with self._lock:
-            self._batches += 1
-        self.metrics.counter("serving_batches_total").inc()
-        self.metrics.histogram("serving_batch_size").observe(len(reqs))
-        first_exc: Optional[BaseException] = None
-        nfailed = 0
-        for i, r in enumerate(reqs):
-            # per-request isolation: a raising remediation (the solo
-            # recompile, an escalation route) must fail THIS future
-            # only — the remaining batch-mates still resolve, and no
-            # caller blocks forever on an unresolved future
-            try:
-                x = X[i, :r.n, :r.nrhs]
-                if inject.armed():
-                    # per-request response tap (module docstring) —
-                    # only pay the round-trip while a plan is live
-                    x = np.asarray(inject.tap("serving",
-                                              jnp.asarray(x)))
-                meta = {"batch": len(reqs), "batched": True,
-                        "bucket": (key.n, key.nrhs, key.batch)}
-                if info is not None:
-                    meta["refine"] = self._refine_meta(info, i)
-                ok, health, verdict = self._verify(
-                    r, x, meta.get("refine"),
-                    bwd=None if inject.armed() else float(bwds[i]))
-                meta.update(verdict)
-                if not ok:
-                    x, meta = self._remediate(r, x, health, meta,
-                                              batch_key=key)
-                # latency is the user-visible submit->resolve span,
-                # INCLUDING any remediation walk this request took
-                lat = time.perf_counter() - r.t_submit
-                meta["latency_s"] = lat
+            self._inflight += 1
+            self.metrics.gauge("serving_inflight_batches").set(
+                self._inflight)
+        try:
+            with tracer.span("batch", op=key.op, requests=rids,
+                             batch=len(reqs)) as battrs:
+                try:
+                    X, bwds, info, hit = self._run(key, reqs)
+                    battrs["cached"] = hit
+                except Exception as exc:   # compile/dispatch failure:
+                    for r in reqs:         # every request fails loudly
+                        r.future._fail(exc)
+                    self.telemetry.flight.record(
+                        "dispatch_error", op=key.op, requests=rids,
+                        error=repr(exc))
+                    raise
+                self.telemetry.flight.record(
+                    "dispatch", op=key.op, batch=len(reqs),
+                    requests=rids,
+                    bucket=[key.n, key.nrhs, key.batch],
+                    cache="hit" if hit else "miss")
+                if self.verbose >= 1:
+                    print(f"#+ serving: dispatch op={key.op} "
+                          f"batch={len(reqs)} "
+                          f"bucket=({key.n},{key.nrhs},{key.batch}) "
+                          f"reqs={rids} "
+                          f"cache={'hit' if hit else 'miss'}",
+                          flush=True)
                 with self._lock:
-                    self._latencies.append(lat)
-                self.metrics.histogram("serving_latency_s").observe(
-                    lat)
-                r.future._resolve(x[:, 0] if r.vec else x, meta)
-            except Exception as exc:
-                r.future._fail(exc)
-                first_exc = first_exc or exc
-                nfailed += 1
-        if first_exc is not None:
-            # delivered to the owning futures above; do NOT re-raise —
-            # dispatch may be running inside an INNOCENT batch-mate's
-            # result()/submit() call (or a timer thread), and a
-            # foreign request's failure must not surface there. One
-            # stderr note so timer-thread failures aren't invisible.
-            import sys
-            sys.stderr.write(
-                f"#! serving: {nfailed} request(s) failed in "
-                f"dispatch: {first_exc!r}\n")
+                    self._batches += 1
+                self.metrics.counter("serving_batches_total").inc()
+                self.metrics.histogram("serving_batch_size").observe(
+                    len(reqs))
+                first_exc: Optional[BaseException] = None
+                failed_rids: List[int] = []
+                for i, r in enumerate(reqs):
+                    # per-request isolation: a raising remediation (the
+                    # solo recompile, an escalation route) must fail
+                    # THIS future only — the remaining batch-mates
+                    # still resolve, and no caller blocks forever on
+                    # an unresolved future
+                    try:
+                        self._scatter_one(key, reqs, r, i, X, bwds,
+                                          info, jnp)
+                    except Exception as exc:
+                        r.future._fail(exc)
+                        first_exc = first_exc or exc
+                        failed_rids.append(r.rid)
+                if first_exc is not None:
+                    # delivered to the owning futures above; do NOT
+                    # re-raise — dispatch may be running inside an
+                    # INNOCENT batch-mate's result()/submit() call (or
+                    # a timer thread), and a foreign request's failure
+                    # must not surface there. One stderr note (request
+                    # ids named) so timer-thread failures aren't
+                    # invisible or unattributable.
+                    import sys
+                    sys.stderr.write(
+                        f"#! serving: {len(failed_rids)} request(s) "
+                        f"failed in dispatch "
+                        f"(reqs={failed_rids}): {first_exc!r}\n")
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self.metrics.gauge("serving_inflight_batches").set(
+                    self._inflight)
+
+    def _scatter_one(self, key, reqs: List[_Request], r: _Request,
+                     i: int, X, bwds, info, jnp) -> None:
+        """Scatter + gate + (if needed) remediate ONE request of a
+        dispatched batch, resolving its future."""
+        tracer = self.telemetry.tracer
+        with tracer.span("scatter_gate", request=r.rid,
+                         op=r.op) as gattrs:
+            x = X[i, :r.n, :r.nrhs]
+            if inject.armed():
+                # per-request response tap (module docstring) — only
+                # pay the round-trip while a plan is live
+                nfaults0 = len(inject.faults())
+                x = np.asarray(inject.tap("serving", jnp.asarray(x)))
+                if len(inject.faults()) > nfaults0:
+                    self.telemetry.flight.record(
+                        "inject", request=r.rid, op=r.op,
+                        fault=inject.faults()[-1])
+            meta = {"request_id": r.rid, "batch": len(reqs),
+                    "batched": True,
+                    "bucket": (key.n, key.nrhs, key.batch)}
+            if info is not None:
+                meta["refine"] = self._refine_meta(info, i)
+            ok, health, verdict = self._verify(
+                r, x, meta.get("refine"),
+                bwd=None if inject.armed() else float(bwds[i]))
+            meta.update(verdict)
+            gattrs["ok"] = bool(ok)
+        if not ok:
+            self.telemetry.flight.record(
+                "gate_fail", request=r.rid, op=r.op, verdict=verdict,
+                health={k: health[k] for k in ("nan", "inf", "ok")})
+            if self.verbose >= 1:
+                print(f"#+ serving: req={r.rid} gate FAILED "
+                      f"verdict={verdict} -> remediation ladder",
+                      flush=True)
+            x, meta = self._remediate(r, x, health, meta,
+                                      batch_key=key)
+        # latency is the user-visible submit->resolve span, INCLUDING
+        # any remediation walk this request took
+        lat = time.perf_counter() - r.t_submit
+        meta["latency_s"] = lat
+        with self._lock:
+            self._latencies.append(lat)
+        self.metrics.histogram("serving_latency_s").observe(lat)
+        r.future._resolve(x[:, 0] if r.vec else x, meta)
 
     @staticmethod
     def _refine_meta(info, i: int) -> dict:
@@ -494,7 +623,7 @@ class SolverService:
         bucket 1) through the same stack/build path as the batched
         dispatch — a fresh executable when the batched one was dropped
         as tainted."""
-        X, _bwds, info = self._run(self._solo_key(r), [r])
+        X, _bwds, info, _hit = self._run(self._solo_key(r), [r])
         return X[0, :r.n, :r.nrhs], (
             self._refine_meta(info, 0) if info is not None else None)
 
@@ -535,6 +664,7 @@ class SolverService:
         ladder.record(guard.ACTION_PRIMARY, f"batched[{meta['batch']}]",
                       ok=False, classification=cls, health=health)
         self.metrics.counter("serving_faults_total", op=r.op).inc()
+        tracer = self.telemetry.tracer
         while True:
             nxt = ladder.next_action(cls)
             if nxt is None:
@@ -560,12 +690,22 @@ class SolverService:
                                      op=r.op).inc()
             # remediation runs clean, like the driver ladder's rungs
             # (a transient fault does not recur on recompute)
-            with inject.suppressed():
-                if fn is not None:
-                    x2, rmeta = fn(r)
-                else:
-                    x2, rmeta = self._solo(r)
-            ok2, health2, verdict2 = self._verify(r, x2, rmeta)
+            with tracer.span(f"ladder:{action}", request=r.rid,
+                             op=r.op, label=label) as lattrs:
+                with inject.suppressed():
+                    if fn is not None:
+                        x2, rmeta = fn(r)
+                    else:
+                        x2, rmeta = self._solo(r)
+                ok2, health2, verdict2 = self._verify(r, x2, rmeta)
+                lattrs["ok"] = bool(ok2)
+            self.telemetry.flight.record(
+                "ladder", request=r.rid, op=r.op, action=action,
+                label=label, ok=bool(ok2))
+            if self.verbose >= 1:
+                print(f"#+ serving: req={r.rid} ladder rung "
+                      f"{action}:{label} "
+                      f"{'ok' if ok2 else 'failed'}", flush=True)
             ladder.record(action, label, ok2,
                           classification=None if ok2
                           else ladder.classify(health2, None, False),
@@ -585,6 +725,21 @@ class SolverService:
             self.resilience.append(summary)
         if summary["outcome"] == "failed":
             self.metrics.counter("serving_failed_total", op=r.op).inc()
+        self.telemetry.flight.record(
+            "remediation", request=r.rid, op=r.op,
+            outcome=summary["outcome"], winner=summary["winner"],
+            attempts=len(summary["attempts"]))
+        if self.verbose >= 1:
+            print(f"#+ serving: req={r.rid} remediation outcome="
+                  f"{summary['outcome']} winner={summary['winner']}",
+                  flush=True)
+        # the incident carries its own evidence: a request that failed
+        # its gate and walked the ladder dumps the flight ring to disk
+        # (MCA telemetry.flight_path; empty = in-memory only, the ring
+        # still lands in the run-report's telemetry section)
+        dump_path = self.telemetry.flight_dump_path()
+        if dump_path:
+            self.telemetry.flight.dump(dump_path)
         return x, meta
 
     # --------------------------------------------------------- summary
@@ -592,12 +747,20 @@ class SolverService:
         """Zero the request/batch/latency/remediation records (the
         cache and its counters stay): benches call this after a
         warmup pass so the summary covers measured traffic only —
-        a warmup compile latency is not service latency."""
+        a warmup compile latency is not service latency. The
+        telemetry instruments reset with them (warmup spans/events
+        and warmup latency observations are compile noise, not
+        traffic), but the request-id stamp stays monotone."""
         with self._lock:
             self._latencies.clear()
             self.resilience.clear()
             self._batches = 0
             self._requests = 0
+        self.telemetry.clear()
+        for name in ("serving_latency_s", "serving_batch_size"):
+            h = self.metrics.get(name)
+            if isinstance(h, Histogram):
+                h.reset()
 
     def summary(self) -> dict:
         """The run-report schema-v8 ``"serving"`` entry for this

@@ -141,6 +141,13 @@ class TestParseArguments:
         assert (ip.profile, ip.report, ip.jaxtrace) == \
             ("a.prof", "b.json", "tr")
 
+    def test_telemetry_flag(self):
+        assert self._parse(["-N", "8"]).telemetry is None
+        assert self._parse(["-N", "8", "--telemetry"]).telemetry \
+            == "telemetry.prom"
+        assert self._parse(["-N", "8", "--telemetry=t.prom"]) \
+            .telemetry == "t.prom"
+
 
 def test_driver_per_run_stats_printed(capsys):
     """-v>=2 prints per-run lines and the min/median/max spread (the

@@ -11,7 +11,11 @@
   and one serving executable + the ring-smoke pass over the explicit
   ICI-ring kernels' RingOp schedules and the ring.enable=off
   bit-identity + the dplasma_tpu.tuning sweep → DB →
-  driver --autotune consultation smoke) must exit 0 on the repo.
+  driver --autotune consultation smoke + the telemetry smoke: a
+  traced serving burst must leave a balanced span ledger, a
+  Prometheus-parseable exporter snapshot, and a flight-recorder ring
+  that round-trips through the v13 run-report) must exit 0 on the
+  repo.
 """
 import pathlib
 import sys
@@ -86,5 +90,5 @@ def test_lint_all_aggregate_is_clean(capsys):
     for gate in ("lint_excepts", "jaxlint", "perfdiff-smoke",
                  "palcheck", "dagcheck-smoke", "spmdcheck-smoke",
                  "serving-smoke", "hlocheck-smoke", "ring-smoke",
-                 "tune-smoke"):
+                 "tune-smoke", "telemetry-smoke"):
         assert f"# {gate}: OK" in out.out

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 12
+    assert doc["schema"] == REPORT_SCHEMA == 13
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -179,6 +179,33 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
                               {"chips": 8, "grid": [2, 4],
                                "median_s": 0.09, "gflops": 62.1,
                                "parallel_efficiency": 0.58}]}]},
+        13: {"schema": 13, "name": "v13", "ops": [], "metrics": [],
+             "telemetry": {
+                 "spans": {"enabled": True, "opened": 42,
+                           "closed": 42, "recorded": 42,
+                           "dropped": 0, "balanced": True},
+                 "exporter": {"path": "telemetry.prom",
+                              "interval_s": 10.0, "flushes": 3},
+                 "flight_recorder": {
+                     "capacity": 256, "recorded": 5, "dropped": 0,
+                     "events": [
+                         {"seq": 0, "t_ns": 1, "kind": "submit",
+                          "request": 1, "op": "posv", "n": 12,
+                          "nrhs": 1},
+                         {"seq": 1, "t_ns": 2, "kind": "dispatch",
+                          "op": "posv", "batch": 1, "requests": [1],
+                          "bucket": [12, 4, 1], "cache": "miss"},
+                         {"seq": 2, "t_ns": 3, "kind": "gate_fail",
+                          "request": 1, "op": "posv",
+                          "verdict": {"ok": False}},
+                         {"seq": 3, "t_ns": 4, "kind": "ladder",
+                          "request": 1, "op": "posv",
+                          "action": "retry", "label": "posv",
+                          "ok": True},
+                         {"seq": 4, "t_ns": 5, "kind": "remediation",
+                          "request": 1, "op": "posv",
+                          "outcome": "remediated",
+                          "winner": "posv", "attempts": 2}]}}},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -434,7 +461,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 12
+    assert doc["schema"] == 13
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

@@ -1,0 +1,457 @@
+"""Production telemetry: the bounded metrics histogram, the always-on
+span tracer, the Prometheus text exporter, the flight recorder, and
+the driver ``--telemetry`` acceptance path.
+
+The serving-side integration (request ids, span taxonomy, the
+injected-fault flight dump) is covered in tests/test_serving.py; the
+tracecat merge mode in tests/test_tracecat.py; the repo-wide gate in
+tools/lint_all.py ``telemetry-smoke`` (tests/test_lint.py)."""
+import json
+import math
+import threading
+
+import pytest
+
+from dplasma_tpu.observability import telemetry as tel
+from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
+from dplasma_tpu.observability.tracing import Tracer
+
+
+# --------------------------------------------------- bounded histogram
+
+def test_histogram_small_sets_stay_exact():
+    """The run-report timing path: small sample sets keep the raw
+    values, so every stats() figure is the historical exact result
+    (bit-compatible keys AND values)."""
+    h = Histogram()
+    for t in (0.4, 0.2, 0.3):
+        h.observe(t)
+    s = h.stats()
+    assert set(s) == {"count", "sum", "min", "max", "mean", "median",
+                      "stddev"}
+    assert s["count"] == 3 and s["min"] == 0.2 and s["max"] == 0.4
+    assert s["median"] == 0.3
+    assert s["stddev"] == pytest.approx(0.0816496580927726)
+    assert h.percentile(0) == 0.2 and h.percentile(100) == 0.4
+    assert json.loads(json.dumps(s)) == s
+
+
+def test_histogram_empty_and_reset():
+    h = Histogram()
+    assert h.stats() == {"count": 0, "sum": 0.0, "min": None,
+                         "max": None, "mean": None, "median": None,
+                         "stddev": None}
+    assert h.percentile(50) is None
+    h.observe(1.0)
+    h.reset()
+    assert h.stats()["count"] == 0 and h.bucket_count() == 0
+
+
+def test_histogram_million_observes_stays_o_buckets():
+    """THE memory regression the rewrite exists for: a million
+    observations must cost O(buckets), not O(n) — the old raw-list
+    histogram made sustained serving traffic an unbounded leak."""
+    h = Histogram()
+    for i in range(1_000_000):
+        h.observe((i % 997 + 1) * 1e-4)
+    s = h.stats()
+    assert s["count"] == 1_000_000
+    # the whole retained state: bounded bucket dict (raw list dropped)
+    assert h.bucket_count() < 200
+    assert h._exact is None
+    # exact moments survive the spill
+    assert s["min"] == pytest.approx(1e-4)
+    assert s["max"] == pytest.approx(997e-4)
+    # naive running sum over 1e6 floats: ~1e-5 relative drift is fp,
+    # not a bug
+    assert s["mean"] == pytest.approx(499e-4, rel=1e-4)
+
+
+def test_histogram_spilled_percentiles_interpolate():
+    """Past the exact cap, percentiles come from log-bucket
+    interpolation — within one bucket width (~±4.5%) of exact."""
+    import random
+    rng = random.Random(3872)
+    vals = [rng.lognormvariate(0.0, 1.0) for _ in range(5000)]
+    h = Histogram()
+    for v in vals:
+        h.observe(v)
+    ordered = sorted(vals)
+    for p in (10, 50, 90, 99):
+        exact = ordered[round(p / 100 * (len(ordered) - 1))]
+        got = h.percentile(p)
+        assert abs(got - exact) / exact < 0.06, (p, exact, got)
+    s = h.stats()
+    assert s["median"] == pytest.approx(h.percentile(50))
+    assert s["stddev"] == pytest.approx(
+        math.sqrt(sum((v - s["mean"]) ** 2 for v in vals) / len(vals)),
+        rel=1e-6)
+
+
+def test_histogram_concurrent_observe_across_spill():
+    """Regression (review r14): the exact->bucket spill is a
+    check-then-act — unlocked, threads racing the 513th observation
+    crashed on the dropped raw list and lost moment updates. Observe
+    from several threads straddling the cap; totals must be exact."""
+    import sys
+    h = Histogram()
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    try:
+        nthreads, per = 8, 200      # 1600 total, cap at 512
+
+        def work():
+            for _ in range(per):
+                h.observe(1.0)
+
+        threads = [threading.Thread(target=work)
+                   for _ in range(nthreads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sys.setswitchinterval(prev)
+    s = h.stats()
+    assert s["count"] == nthreads * per
+    assert s["sum"] == pytest.approx(float(nthreads * per))
+
+
+def test_histogram_zero_and_negative_buckets():
+    h = Histogram()
+    for v in [-5.0, 0.0, 0.0, 2.0] * 300:
+        h.observe(v)
+    s = h.stats()
+    assert s["min"] == -5.0 and s["max"] == 2.0
+    assert h.percentile(0) == -5.0 and h.percentile(100) == 2.0
+    # the zero bucket sits between the signed rungs
+    assert h.percentile(50) == pytest.approx(0.0, abs=1e-12)
+
+
+# -------------------------------------------------------------- tracer
+
+def test_tracer_span_tree_and_balance():
+    tr = Tracer(enabled=True, rank=3)
+    with tr.span("outer", op="posv") as attrs:
+        attrs["late"] = 1
+        with tr.span("inner", request=7):
+            pass
+    spans = {s["name"]: s for s in tr.spans()}
+    assert spans["inner"]["parent"] == spans["outer"]["sid"]
+    assert spans["inner"]["request"] == 7
+    assert spans["outer"]["attrs"] == {"op": "posv", "late": 1}
+    assert spans["outer"]["rank"] == 3
+    assert spans["outer"]["t1_ns"] >= spans["outer"]["t0_ns"]
+    assert tr.balanced()
+    s = tr.summary()
+    assert s["opened"] == s["closed"] == s["recorded"] == 2
+    assert s["balanced"] and s["dropped"] == 0
+
+
+def test_tracer_balanced_through_raising_body():
+    tr = Tracer(enabled=True)
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("body died")
+    assert tr.balanced()
+    assert tr.spans()[0]["name"] == "boom"
+
+
+def test_tracer_threads_get_distinct_lanes_and_unique_sids():
+    tr = Tracer(enabled=True, capacity=100000)
+    barrier = threading.Barrier(4)   # all alive while lanes allocate
+    # (a lane is only RECYCLED from a dead thread — live ones never
+    # share; without the barrier a fast thread could finish before a
+    # slow one starts and legitimately hand its lane over)
+
+    def work():
+        with tr.span("first"):       # allocates this thread's lane
+            pass
+        barrier.wait(10.0)
+        for _ in range(499):
+            with tr.span("w"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = tr.spans()
+    sids = [s["sid"] for s in spans]
+    assert len(sids) == len(set(sids)) == 2000
+    assert len({s["track"] for s in spans}) == 4
+    assert tr.balanced()
+
+
+def test_tracer_recycles_dead_thread_lanes():
+    """Regression (review r14): the scheduler spawns a fresh Timer
+    thread per batch window — without lane recycling, _states grew by
+    one permanent entry per short-lived thread forever. Dead lanes
+    are reused (bounded by max CONCURRENT threads) and recycled lanes
+    still allocate unique span ids."""
+    tr = Tracer(enabled=True, capacity=100000)
+
+    def one_span():
+        with tr.span("timer"):
+            pass
+
+    for _ in range(50):             # 50 sequential short-lived threads
+        t = threading.Thread(target=one_span)
+        t.start()
+        t.join()
+    # main thread's lane + ONE recycled worker lane, not 50
+    assert len(tr._states) <= 2, len(tr._states)
+    spans = tr.spans()
+    sids = [s["sid"] for s in spans]
+    assert len(sids) == len(set(sids)) == 50
+    assert tr.balanced()
+    s = tr.summary()
+    assert s["opened"] == s["closed"] == 50
+
+
+def test_tracer_ring_bound_counts_drops():
+    tr = Tracer(enabled=True, capacity=8)
+    for i in range(20):
+        with tr.span(f"s{i}"):
+            pass
+    s = tr.summary()
+    assert s["recorded"] == 8 and s["dropped"] == 12
+    assert s["balanced"]
+    # the ring keeps the newest
+    assert [x["name"] for x in tr.spans()] == \
+        [f"s{i}" for i in range(12, 20)]
+
+
+def test_tracer_disabled_is_noop_but_attrs_still_flow():
+    tr = Tracer(enabled=False)
+    with tr.span("x", op="posv") as attrs:
+        attrs["hit"] = True
+        assert attrs["op"] == "posv"     # callers may read back
+    tr.add("qw", 1, 2, request=1)
+    assert tr.spans() == [] and tr.balanced()
+
+
+def test_tracer_save_and_chrome_export(tmp_path):
+    tr = Tracer(enabled=True, rank=2)
+    with tr.span("dispatch", request=5, op="gesv"):
+        pass
+    p = str(tmp_path / "spans.json")
+    tr.save(p)
+    doc = json.load(open(p))
+    assert doc["dplasma_serving_spans"] == 1 and doc["rank"] == 2
+    assert doc["spans"][0]["name"] == "dispatch"
+    ch = tr.to_chrome()
+    evs = [e for e in ch["traceEvents"] if e["ph"] == "X"]
+    assert evs[0]["args"]["request"] == 5
+    assert json.loads(json.dumps(ch)) == ch
+
+
+# ----------------------------------------------------- prometheus text
+
+def test_prometheus_text_round_trips_through_parser():
+    reg = MetricsRegistry()
+    reg.counter("serving_requests_total", op="posv").inc(3)
+    reg.gauge("serving_queue_depth").set(2.0)
+    h = reg.histogram("serving_latency_s")
+    for v in (0.01, 0.02, 0.03):
+        h.observe(v)
+    text = tel.prometheus_text(reg)
+    fams = tel.parse_prometheus_text(text)
+    assert fams["serving_requests_total"]["type"] == "counter"
+    (name, labels, value), = [
+        s for s in fams["serving_requests_total"]["samples"]]
+    assert labels == {"op": "posv"} and value == 3.0
+    assert fams["serving_queue_depth"]["samples"][0][2] == 2.0
+    lat = fams["serving_latency_s"]
+    assert lat["type"] == "summary"
+    names = {s[0] for s in lat["samples"]}
+    assert {"serving_latency_s", "serving_latency_s_count",
+            "serving_latency_s_sum", "serving_latency_s_min",
+            "serving_latency_s_max"} <= names
+    q = {s[1].get("quantile"): s[2] for s in lat["samples"]
+         if s[1].get("quantile")}
+    assert q["0.5"] == pytest.approx(0.02)
+
+
+def test_prometheus_parser_rejects_malformed():
+    with pytest.raises(ValueError):
+        tel.parse_prometheus_text("orphan_sample 1.0\n")
+    with pytest.raises(ValueError):
+        tel.parse_prometheus_text(
+            "# TYPE x gauge\nx{bad} 1.0\n")
+    with pytest.raises(ValueError):
+        tel.parse_prometheus_text("# TYPE x gauge\nx notanumber\n")
+
+
+def test_prometheus_label_escaping_round_trips_exactly():
+    """The reader is the writer's inverse: quotes, backslashes,
+    newlines, commas, and braces inside label values come back
+    byte-identical (review r14: the first parser split on bare commas
+    and truncated at the first '}')."""
+    reg = MetricsRegistry()
+    nasty = 'say "hi",\n {braces} \\ done'
+    reg.counter("c", what=nasty, op="posv,gesv").inc()
+    fams = tel.parse_prometheus_text(tel.prometheus_text(reg))
+    (_, labels, value), = fams["c"]["samples"]
+    assert labels == {"what": nasty, "op": "posv,gesv"}
+    assert value == 1.0
+
+
+def test_histogram_exact_cap_override_keeps_big_runs_exact():
+    """review r14: report.run_stats passes exact_cap=len(runs) so a
+    513-run report's median stays exact, never bucket-interpolated."""
+    from dplasma_tpu.observability.report import run_stats
+    runs = [1.0 + 0.001 * i for i in range(600)]
+    rs = run_stats(runs)
+    import statistics
+    assert rs["median_s"] == statistics.median(runs)
+    h = Histogram(exact_cap=600)
+    for v in runs:
+        h.observe(v)
+    assert h._exact is not None and h.percentile(50) == \
+        statistics.median(runs)
+
+
+# ------------------------------------------------------------ exporter
+
+def test_metrics_exporter_flush_and_rates(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("serving_requests_total", op="posv").inc(10)
+    p = str(tmp_path / "t.prom")
+    ex = tel.MetricsExporter(reg, p, interval_s=60.0)
+    ex.flush()
+    assert ex.flushes == 1
+    fams = tel.parse_prometheus_text(open(p).read())
+    assert "serving_requests_total" in fams
+    # a second flush after more traffic derives a positive rate gauge
+    reg.counter("serving_requests_total", op="posv").inc(5)
+    ex.flush()
+    fams = tel.parse_prometheus_text(open(p).read())
+    rate = fams["serving_request_rate"]["samples"][0][2]
+    assert rate > 0
+
+
+def test_metrics_exporter_background_thread(tmp_path):
+    import time
+    reg = MetricsRegistry()
+    reg.gauge("g").set(1.0)
+    p = str(tmp_path / "bg.prom")
+    ex = tel.MetricsExporter(reg, p, interval_s=0.05)
+    ex.start()
+    time.sleep(0.25)
+    ex.stop()
+    assert ex.flushes >= 3          # start + periodic + final
+    tel.parse_prometheus_text(open(p).read())
+    flushes = ex.flushes
+    time.sleep(0.12)                # thread is really gone
+    assert ex.flushes == flushes
+
+
+# ----------------------------------------------------- flight recorder
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    fr = tel.FlightRecorder(capacity=4)
+    for i in range(7):
+        fr.record("submit", request=i, op="posv")
+    evs = fr.events()
+    assert [e["request"] for e in evs] == [3, 4, 5, 6]
+    assert [e["seq"] for e in evs] == [3, 4, 5, 6]   # seq is global
+    s = fr.summary()
+    assert s["capacity"] == 4 and s["recorded"] == 7
+    assert s["dropped"] == 3                # truncation is visible
+    p = fr.dump(str(tmp_path / "flight.json"))
+    doc = json.load(open(p))
+    assert doc["dplasma_flight_recorder"] == 1
+    assert [e["kind"] for e in doc["events"]] == ["submit"] * 4
+    fr.clear()
+    assert fr.events() == [] and fr.summary()["recorded"] == 0
+
+
+def test_flight_recorder_dump_failure_is_logged_not_raised(tmp_path,
+                                                           capsys):
+    fr = tel.FlightRecorder(capacity=4)
+    fr.record("submit", request=1)
+    assert fr.dump(str(tmp_path / "no" / "such" / "dir.json")) is None
+    assert "flight recorder" in capsys.readouterr().err
+
+
+# --------------------------------------------------------- the facade
+
+def test_telemetry_facade_summary_shape(tmp_path):
+    t = tel.Telemetry(rank=1)
+    with t.tracer.span("x"):
+        pass
+    t.flight.record("submit", request=1)
+    reg = MetricsRegistry()
+    reg.gauge("g").set(1.0)
+    assert t.start_exporter(reg, path="") is None     # inert, no path
+    ex = t.start_exporter(reg, path=str(tmp_path / "t.prom"),
+                          interval_s=60.0)
+    assert ex is not None and ex.flushes >= 1
+    s = t.summary()
+    assert s["spans"]["balanced"] and s["spans"]["recorded"] == 1
+    assert s["exporter"]["flushes"] >= 1
+    assert s["flight_recorder"]["events"][0]["kind"] == "submit"
+    t.close()
+    assert json.loads(json.dumps(s)) == s
+
+
+def test_telemetry_flight_dump_path_is_mca_tier():
+    from dplasma_tpu.utils import config as _cfg
+    t = tel.Telemetry()
+    assert t.flight_dump_path() == ""
+    with _cfg.override_scope({"telemetry.flight_path": "f.json"}):
+        assert t.flight_dump_path() == "f.json"
+    assert t.flight_dump_path() == ""
+
+
+# ------------------------------------------- driver --telemetry (e2e)
+
+def test_driver_telemetry_e2e(tmp_path, capsys):
+    """--telemetry end to end: the exporter snapshot parses as
+    Prometheus text and the v13 report carries the telemetry section
+    with the run's flight events."""
+    from dplasma_tpu.drivers import main as drv_main
+    from dplasma_tpu.observability.report import load_report
+    prom = str(tmp_path / "t.prom")
+    rj = str(tmp_path / "r.json")
+    rc = drv_main(["-N", "32", f"--telemetry={prom}",
+                   f"--report={rj}", "-v=1"], prog="testing_spotrf")
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "#+ telemetry:" in out
+    doc = load_report(rj)
+    assert doc["schema"] == 13
+    t = doc["telemetry"]
+    assert t["exporter"]["path"] == prom and t["exporter"]["flushes"] >= 1
+    kinds = [e["kind"] for e in t["flight_recorder"]["events"]]
+    assert kinds[0] == "run_start"
+    assert "op_start" in kinds and "op_done" in kinds
+    fams = tel.parse_prometheus_text(open(prom).read())
+    assert "gflops_best" in fams and "run_seconds" in fams
+
+
+def test_driver_telemetry_records_remediation(tmp_path):
+    """An injected driver fault lands its ladder walk in the flight
+    recorder (inject/ladder/remediation events), and the dump-on-
+    incident file appears when MCA telemetry.flight_path is set."""
+    from dplasma_tpu.drivers import main as drv_main
+    from dplasma_tpu.observability.report import load_report
+    from dplasma_tpu.utils import config as _cfg
+    rj = str(tmp_path / "r.json")
+    fp = str(tmp_path / "flight.json")
+    with _cfg.override_scope({"telemetry.flight_path": fp}):
+        rc = drv_main(["-N", "32", "--telemetry=" + str(
+            tmp_path / "t.prom"), f"--report={rj}",
+            "--inject=nan@potrf:1:1", "--max-retries=1"],
+            prog="testing_spotrf")
+    assert rc == 0
+    doc = load_report(rj)
+    kinds = [e["kind"] for e in
+             doc["telemetry"]["flight_recorder"]["events"]]
+    assert "inject" in kinds and "ladder" in kinds \
+        and "remediation" in kinds
+    dump = json.load(open(fp))
+    assert dump["dplasma_flight_recorder"] == 1
+    assert any(e["kind"] == "remediation" for e in dump["events"])

@@ -1,10 +1,12 @@
 """tools/tracecat.py: DTPUPROF1 -> Perfetto (Chrome trace-event)
 conversion — multi-rank/track lane round-trips, the --info and --lax
-CLI modes, and torn-tail behavior."""
+CLI modes, torn-tail behavior, and the merge mode that fuses per-rank
+traces + phase ledgers + serving spans into one multi-lane timeline."""
 import json
 
 import pytest
 
+from dplasma_tpu.observability.tracing import Tracer
 from dplasma_tpu.utils import profiling
 from tools import tracecat
 
@@ -100,3 +102,128 @@ def test_profile_load_tracks_roundtrip(tmp_path):
     assert sorted(e["tid"] for e in spans) == [0, 7]
     with pytest.raises(Exception):
         tracecat.convert(str(tmp_path / "nope.prof"))
+
+
+# ----------------------------------------------------------- merge mode
+
+def _serving_spans(path, rank=0, base_ns=5_000_000):
+    """A real Tracer's span doc: two request lanes with nesting."""
+    tr = Tracer(enabled=True, rank=rank)
+    tr.add("queue_wait", base_ns, base_ns + 1000, request=1)
+    with tr.span("batch", requests=[1]):
+        with tr.span("dispatch"):
+            pass
+    tr.save(str(path))
+    return tr
+
+
+def test_merge_fuses_ranks_phases_and_serving(tmp_path):
+    """THE merge contract: two synthetic rank traces + a phase ledger
+    + serving spans round-trip into one Perfetto JSON with distinct
+    (rank, track) lanes and monotone timestamps."""
+    for rank in (0, 1):
+        _write_profile(tmp_path / f"r{rank}.prof", rank=rank,
+                       tracks=(0, 1))
+    _serving_spans(tmp_path / "spans.json", rank=0)
+    ledger = [{"phase": "panel", "count": 3, "measured_s": 0.5,
+               "total_s": 0.5},
+              {"phase": "ring", "count": 2, "measured_s": 0.25,
+               "total_s": 0.25}]
+    with open(tmp_path / "ledger.json", "w") as f:
+        json.dump(ledger, f)
+    out = tmp_path / "merged.json"
+    rc = tracecat.main(["--merge",
+                        str(tmp_path / "r0.prof"),
+                        str(tmp_path / "r1.prof"),
+                        "--serving", str(tmp_path / "spans.json"),
+                        "--phases", str(tmp_path / "ledger.json"),
+                        "-o", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    # monotone timestamps across the WHOLE merged stream
+    ts = [e["ts"] for e in spans]
+    assert ts == sorted(ts)
+    assert min(ts) == 0.0                      # rebased to the origin
+    # distinct (pid, tid) lanes: both ranks keep their grid, serving
+    # and phases get their own pids
+    assert {e["pid"] for e in spans
+            if e["cat"] == "span"} == {0, 1}
+    assert {e["tid"] for e in spans if e["pid"] == 0
+            and e["cat"] == "span"} == {0, 1}
+    serving = [e for e in spans if e["cat"] == "serving"]
+    phase = [e for e in spans if e["cat"] == "phase"]
+    assert serving and phase
+    assert {e["pid"] for e in serving}.isdisjoint({0, 1})
+    assert {e["pid"] for e in phase}.isdisjoint(
+        {e["pid"] for e in serving} | {0, 1})
+    # request attribution survives the merge
+    assert any(e.get("args", {}).get("request") == 1 for e in serving)
+    # the synthetic phase lane lays self-times end to end
+    rows = sorted(phase, key=lambda e: e["ts"])
+    assert [e["name"] for e in rows] == ["panel", "ring"]
+    assert rows[1]["ts"] == pytest.approx(rows[0]["dur"])
+    # lane names are declared for the viewer
+    meta = {(e["pid"], e.get("tid")): e["args"]["name"]
+            for e in doc["traceEvents"] if e["ph"] == "M"
+            and e["name"] == "thread_name"}
+    assert any("serving lane" in v for v in meta.values())
+    assert json.loads(json.dumps(doc)) == doc
+
+
+def test_merge_accepts_report_phases_section(tmp_path):
+    """--phases also reads a run-report: each op's phases.spans rows
+    become one labelled synthetic lane."""
+    _write_profile(tmp_path / "r0.prof", rank=0, tracks=(0,))
+    report = {"schema": 13, "name": "x", "metrics": [],
+              "ops": [{"label": "testing_dpotrf",
+                       "phases": {"spans": [
+                           {"phase": "panel", "count": 2,
+                            "measured_s": 0.1}]}}]}
+    with open(tmp_path / "rep.json", "w") as f:
+        json.dump(report, f)
+    doc = tracecat.merge([str(tmp_path / "r0.prof")],
+                         phases=[str(tmp_path / "rep.json")])
+    phase = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and e["cat"] == "phase"]
+    assert [e["name"] for e in phase] == ["panel"]
+    procs = [e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert any("testing_dpotrf" in p and "synthetic" in p
+               for p in procs)
+    (tmp_path / "bad.json").write_text('{"ops": []}')
+    with pytest.raises(ValueError):
+        tracecat._load_phase_tables(str(tmp_path / "bad.json"))
+
+
+def test_merge_lax_honors_torn_tail(tmp_path):
+    """--lax applies to every .prof input of a merge: a torn rank
+    trace merges (minus the torn record) instead of refusing."""
+    n0 = _write_profile(tmp_path / "ok.prof", rank=0, tracks=(0,))
+    n1 = _write_profile(tmp_path / "torn.prof", rank=1, tracks=(0,))
+    raw = (tmp_path / "torn.prof").read_bytes()
+    (tmp_path / "torn.prof").write_bytes(raw[:-4])
+    with pytest.raises(Exception):
+        tracecat.merge([str(tmp_path / "ok.prof"),
+                        str(tmp_path / "torn.prof")], strict=True)
+    doc = tracecat.merge([str(tmp_path / "ok.prof"),
+                          str(tmp_path / "torn.prof")], strict=False)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == n0 + n1 - 1
+    assert {e["pid"] for e in spans} == {0, 1}
+    # the CLI face: strict merge exits 1, --lax exits 0
+    assert tracecat.main(["--merge", str(tmp_path / "ok.prof"),
+                          str(tmp_path / "torn.prof"),
+                          "-o", str(tmp_path / "m.json")]) == 1
+    assert tracecat.main(["--merge", "--lax",
+                          str(tmp_path / "ok.prof"),
+                          str(tmp_path / "torn.prof"),
+                          "-o", str(tmp_path / "m.json")]) == 0
+
+
+def test_cli_rejects_merge_flags_without_merge(tmp_path, capsys):
+    _write_profile(tmp_path / "a.prof", rank=0)
+    _write_profile(tmp_path / "b.prof", rank=1)
+    assert tracecat.main([str(tmp_path / "a.prof"),
+                          str(tmp_path / "b.prof")]) == 2
+    assert "--merge" in capsys.readouterr().err

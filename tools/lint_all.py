@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Ten passes, in increasing cost order:
+Eleven passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
@@ -48,7 +48,15 @@ Ten passes, in increasing cost order:
    the DB must read back clean (``TuningDB.check``), and a
    subsequent driver ``--autotune`` run must provably consult it
    (v11 ``"tuning"`` report section: source ``db``, the winner's
-   tile size applied, scoped overrides restored at close).
+   tile size applied, scoped overrides restored at close);
+11. a ``telemetry-smoke`` pass — a tiny serving burst with tracing on:
+   the span ledger must balance (every open has a close) and carry
+   the per-request span taxonomy, the streaming exporter's file must
+   parse as Prometheus text (``telemetry.parse_prometheus_text``)
+   with the serving families present, and the flight-recorder dump
+   must round-trip through the schema-v13 run-report
+   (``report.load_report``) with its submit/dispatch event sequence
+   intact.
 
 Usage: ``python tools/lint_all.py`` — prints ``file:line: message``
 per violation / one line per failed smoke case, exits nonzero on any.
@@ -591,6 +599,109 @@ def run_tune_smoke() -> int:
     return bad
 
 
+def run_telemetry_smoke() -> int:
+    """The live-telemetry gate, CPU-fast: a tiny serving burst with
+    tracing ON must leave a balanced span ledger carrying the
+    per-request taxonomy, the exporter snapshot must parse as
+    Prometheus text with the serving families present, and the flight
+    recorder's ring must round-trip through the schema-v13 run-report
+    with its submit -> dispatch sequence intact."""
+    import json as _json
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from dplasma_tpu.observability import telemetry as tel
+    from dplasma_tpu.observability.report import (REPORT_SCHEMA,
+                                                  RunReport,
+                                                  load_report)
+    from dplasma_tpu.serving import SolverService
+
+    if not jax.config.jax_compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(_ROOT / ".jax_cache"))
+    bad = 0
+    rng = np.random.default_rng(3872)
+    n, nrhs = 6, 2
+    svc = SolverService(nb=4, max_batch=4, max_wait_ms=0)
+    if not svc.telemetry.tracer.enabled:
+        sys.stderr.write("telemetry-smoke: tracing is not on by "
+                         "default\n")
+        bad += 1
+    for _ in range(2):      # two bursts: miss then hit on the cache
+        futs = []
+        for _i in range(3):
+            g = rng.standard_normal((n, n)).astype(np.float32)
+            a = g @ g.T + n * np.eye(n, dtype=np.float32)
+            b = rng.standard_normal((n, nrhs)).astype(np.float32)
+            futs.append(svc.submit("posv", a, b))
+        svc.flush()
+        for f in futs:
+            f.result(120.0)
+    # (a) span ledger: balanced, and the request taxonomy present
+    tr = svc.telemetry.tracer
+    if not tr.balanced():
+        sys.stderr.write("telemetry-smoke: span ledger unbalanced "
+                         f"({tr.summary()})\n")
+        bad += 1
+    names = {s["name"] for s in tr.spans()}
+    for want in ("queue_wait", "batch", "batch_form", "cache",
+                 "dispatch", "scatter_gate"):
+        if want not in names:
+            sys.stderr.write(f"telemetry-smoke: span {want!r} missing "
+                             f"from the taxonomy ({sorted(names)})\n")
+            bad += 1
+    if not all(f.request_id > 0 for f in futs):
+        sys.stderr.write("telemetry-smoke: futures lack stamped "
+                         "request ids\n")
+        bad += 1
+    with tempfile.TemporaryDirectory() as td:
+        # (b) exporter file parses as Prometheus text
+        ex = tel.MetricsExporter(svc.metrics, f"{td}/t.prom",
+                                 interval_s=60.0)
+        ex.flush()
+        try:
+            fams = tel.parse_prometheus_text(
+                open(f"{td}/t.prom").read())
+        except (OSError, ValueError) as exc:
+            sys.stderr.write(f"telemetry-smoke: exporter file does "
+                             f"not parse: {exc}\n")
+            return bad + 1
+        for fam in ("serving_requests_total", "serving_latency_s",
+                    "serving_queue_depth", "serving_cache_entries"):
+            if fam not in fams or not fams[fam]["samples"]:
+                sys.stderr.write(f"telemetry-smoke: family {fam!r} "
+                                 f"missing from the exporter "
+                                 f"snapshot\n")
+                bad += 1
+        # (c) flight-recorder dump round-trips through load_report
+        rep = RunReport("telemetry-smoke")
+        rep.add_telemetry(svc.telemetry.summary())
+        rj = f"{td}/r.json"
+        rep.write(rj)
+        try:
+            doc = load_report(rj)
+        except (OSError, ValueError) as exc:
+            sys.stderr.write(f"telemetry-smoke: report round-trip "
+                             f"failed: {exc}\n")
+            return bad + 1
+        t = doc.get("telemetry") or {}
+        evs = (t.get("flight_recorder") or {}).get("events") or []
+        kinds = [e.get("kind") for e in evs]
+        if doc.get("schema") != REPORT_SCHEMA or "submit" not in kinds \
+                or "dispatch" not in kinds:
+            sys.stderr.write(f"telemetry-smoke: flight recorder did "
+                             f"not round-trip (schema="
+                             f"{doc.get('schema')}, kinds={kinds})\n")
+            bad += 1
+        if _json.loads(_json.dumps(t)) != t:
+            sys.stderr.write("telemetry-smoke: telemetry section is "
+                             "not JSON-stable\n")
+            bad += 1
+    return bad
+
+
 def main(argv=None) -> int:
     pkg = _ROOT / "dplasma_tpu"
     bad = 0
@@ -603,7 +714,8 @@ def main(argv=None) -> int:
                      ("serving-smoke", run_serving_smoke),
                      ("hlocheck-smoke", run_hlocheck_smoke),
                      ("ring-smoke", run_ring_smoke),
-                     ("tune-smoke", run_tune_smoke)):
+                     ("tune-smoke", run_tune_smoke),
+                     ("telemetry-smoke", run_telemetry_smoke)):
         n = fn()
         print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")
         bad += n

@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v11), the bench
+(``--report`` from any driver, any schema vintage v1-v13), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -25,7 +25,16 @@ Comparable metrics extracted from each document:
   (``<label>.hlocheck.hbm_peak_bytes``, lower is better) from a
   run-report's ``hlocheck`` section (schema v10) — HBM regressions
   gate like time regressions (``--metric-threshold
-  hbm_peak_bytes=FRAC`` for a custom bound).
+  hbm_peak_bytes=FRAC`` for a custom bound);
+* the serving layer's tracing cost
+  (``serving.trace_overhead_frac``, lower is better) from a
+  run-report's ``serving`` section (schema v13, servebench's
+  tracing-on-vs-off measurement) — an always-on tracer that stops
+  being ~free gates like a time regression. The metric is
+  noise-dominated near zero, so its DEFAULT threshold is wide
+  (100% relative, ``DEFAULT_METRIC_THRESHOLDS``) and only
+  order-of-magnitude growth trips the gate; the absolute < 5%
+  budget is asserted by servebench itself and the test suite.
 
 Exit codes: 0 = no regression, 1 = regression past threshold,
 2 = unusable input (unreadable doc, or a candidate with no
@@ -45,6 +54,11 @@ import sys
 from typing import Dict, Optional
 
 DEFAULT_THRESHOLD = 0.10   # 10% relative regression
+
+#: per-metric-suffix default thresholds (caller --metric-threshold
+#: still wins): trace overhead is a near-zero, noise-dominated
+#: fraction — a 10% RELATIVE bound would flag 0.020 -> 0.023
+DEFAULT_METRIC_THRESHOLDS = {"trace_overhead_frac": 1.0}
 
 
 # ------------------------------------------------------------- loading
@@ -153,6 +167,16 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
         if isinstance(g, (int, float)) and g > 0:
             out[f"{lbl}.gflops"] = {"value": float(g),
                                     "better": "higher"}
+    for s in doc.get("serving") or []:
+        # the tracing-on overhead servebench measures (schema v13):
+        # lower is better — the always-on tracer staying ~free is a
+        # gated property, not a hope
+        if not isinstance(s, dict):
+            continue
+        v = s.get("trace_overhead_frac")
+        if isinstance(v, (int, float)) and v >= 0:
+            out["serving.trace_overhead_frac"] = {
+                "value": float(v), "better": "lower"}
     for e in doc.get("hlocheck") or []:
         # compiled-artifact peak memory (schema v10): lower is
         # better — a grown peak is an HBM regression exactly like a
@@ -210,8 +234,11 @@ def compare(old_doc: dict, new_doc: dict,
         else:
             ratio = (nv - ov) / ov if better == "lower" \
                 else (ov - nv) / ov
+        suffix = name.rsplit(".", 1)[-1]
         th = per_metric.get(
-            name, per_metric.get(name.rsplit(".", 1)[-1], threshold))
+            name, per_metric.get(
+                suffix, DEFAULT_METRIC_THRESHOLDS.get(
+                    suffix, threshold)))
         rows.append({"metric": name, "old": ov, "new": nv,
                      "better": better, "regression": ratio,
                      "threshold": th, "worse": ratio > th})

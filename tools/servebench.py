@@ -11,11 +11,19 @@ then records:
   speedup (the serving layer's reason to exist — dispatch and compile
   amortization across a request batch);
 * per-request **latency p50/p99** (submit -> result, the user-visible
-  measure batching trades against);
-* executable **cache hit-rate** and compile seconds.
+  measure batching trades against) — read back from the service's
+  bounded **telemetry histograms** (``serving_latency_s``), the same
+  instruments a production scrape sees, not a bench-local list;
+* executable **cache hit-rate** and compile seconds;
+* the **tracing overhead**: each rep runs one tracing-OFF pass
+  (tracer disabled) before the tracing-ON production pass, and
+  ``trace_overhead_frac = (best_on - best_off) / best_off`` lands in
+  the summary and as a lower-better ledger entry — an always-on
+  tracer that stops being ~free gates like a time regression.
 
-Everything lands in a run-report schema v8 ``"serving"`` section
-(``--report``), in the ``bench_history.jsonl`` ledger (``--history`` /
+Everything lands in a run-report ``"serving"`` section (``--report``;
+schema v13 adds the ``"telemetry"`` section — span ledger, flight
+recorder), in the ``bench_history.jsonl`` ledger (``--history`` /
 ``DPLASMA_BENCH_HISTORY``), and — with ``--gate`` — is compared
 against the newest prior ledger entry by ``tools/perfdiff.py``
 (latency entries declare ``"better": "lower"``; a baseline predating
@@ -23,14 +31,20 @@ the serving metrics gates informationally).
 
 ``--inject=KIND@STAGE[:RATE[:COUNT]]`` (or ``DPLASMA_INJECT``) arms
 the PR 2 fault injector for the measured service pass: a corrupted
-request walks the per-request remediation ladder and the outcome
-counts land in the report.
+request walks the per-request remediation ladder, the outcome counts
+land in the report, and the flight recorder dumps the whole event
+ring (submit → dispatch → gate_fail → each ladder rung, every event
+naming its request id) to ``--flight`` (default ``flight.json`` once
+``--inject`` or ``--telemetry`` is on) — the incident carries its own
+evidence.
 
 Usage::
 
     python tools/servebench.py                  # defaults, prints doc
     python tools/servebench.py --gate           # self-gate vs ledger
     python tools/servebench.py --inject=nan@serving:1:1 -v
+    python tools/servebench.py --telemetry=serve.prom \\
+        --spans=spans.json      # + streaming exporter + merge input
 """
 from __future__ import annotations
 
@@ -143,13 +157,29 @@ def main(argv=None) -> int:
                     help="fault spec KIND@STAGE[:RATE[:COUNT]] for the "
                          "measured service pass (default env "
                          "DPLASMA_INJECT)")
+    ap.add_argument("--telemetry", nargs="?", const="telemetry.prom",
+                    default=None, metavar="PROM",
+                    help="start the streaming metrics exporter "
+                         "(Prometheus text snapshot, default file "
+                         "telemetry.prom)")
+    ap.add_argument("--flight", default=None, metavar="FILE",
+                    help="flight-recorder dump file for gate-failed/"
+                         "remediated requests (default flight.json "
+                         "when --inject or --telemetry is on)")
+    ap.add_argument("--spans", default=None, metavar="FILE",
+                    help="save the measured passes' tracing spans "
+                         "(tools/tracecat.py --merge input)")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     ns = ap.parse_args(argv)
 
+    import contextlib
+
+    from dplasma_tpu.observability.metrics import Histogram
     from dplasma_tpu.observability.report import RunReport
     from dplasma_tpu.resilience import inject
     from dplasma_tpu.serving import SolverService
     from dplasma_tpu.serving.cache import ExecutableCache
+    from dplasma_tpu.utils import config as _cfg
 
     ops = [o.strip() for o in ns.ops.split(",") if o.strip()]
     sizes = [int(s) for s in ns.sizes.split(",") if s.strip()]
@@ -168,11 +198,14 @@ def main(argv=None) -> int:
                         cache=ExecutableCache(metrics=None))
     svc.metrics = report.metrics
     svc.cache.metrics = report.metrics
+    if ns.telemetry:
+        svc.telemetry.start_exporter(report.metrics, ns.telemetry)
 
     # warmup: populate the executable cache (service) and the
     # per-shape jit cache (loop) — steady-state is what we measure.
     # The warmup's latencies are compile time, not service latency:
-    # reset the service's stats so summary() covers measured traffic
+    # reset the service's stats (and telemetry — warmup spans/events
+    # are compile noise) so summary() covers measured traffic
     run_service(svc, reqs)
     fns: dict = {}
     run_loop(reqs, ns.nb, fns)
@@ -180,27 +213,60 @@ def main(argv=None) -> int:
 
     spec = ns.inject or os.environ.get("DPLASMA_INJECT")
     plan = inject.parse_plan(spec, ns.seed) if spec else None
-    best_svc = best_loop = float("inf")
-    lats = []          # POOLED over every measured rep — the gated
-    faults = []        # p50/p99 must not ride one noisy final pass
-    for _ in range(max(ns.reps, 1)):
+    flight = ns.flight or ("flight.json"
+                           if (spec or ns.telemetry) else None)
+    flight_cm = _cfg.override_scope({"telemetry.flight_path": flight},
+                                    label="servebench-flight") \
+        if flight else contextlib.nullcontext()
+    best_svc = best_off = best_loop = float("inf")
+    lats = []          # POOLED over every measured rep (crosscheck /
+    faults = []        # fallback for the histogram percentiles)
+    with flight_cm:
+        # CLEAN measured reps: each pairs one tracing-OFF pass (the
+        # overhead baseline) with one tracing-ON pass (the production
+        # mode the throughput/latency figures describe). Fault
+        # injection runs SEPARATELY below — a remediation walk's solo
+        # recompile would otherwise masquerade as tracing overhead.
+        for _ in range(max(ns.reps, 1)):
+            svc.telemetry.tracer.enabled = False
+            wall_off, _lat_off, _ = run_service(svc, reqs)
+            svc.telemetry.tracer.enabled = True
+            best_off = min(best_off, wall_off)
+            wall, lat, _futs = run_service(svc, reqs)
+            best_svc = min(best_svc, wall)
+            lats.extend(lat)
+            lwall, _ = run_loop(reqs, ns.nb, fns)
+            best_loop = min(best_loop, lwall)
+        # the gated p50/p99 come from the service's bounded telemetry
+        # histogram — the SAME instrument a production scrape reads,
+        # pooled over every clean measured pass (read before the
+        # injected passes so remediation walks don't skew them)
+        lat_h = report.metrics.get("serving_latency_s")
+        if isinstance(lat_h, Histogram) and lat_h.stats()["count"]:
+            p50 = lat_h.percentile(50)
+            p99 = lat_h.percentile(99)
+            lat_src = "telemetry-histogram"
+        else:                  # unreachable with traffic; stay honest
+            slat = sorted(lats)
+            p50, p99 = _pct(slat, 50), _pct(slat, 99)
+            lat_src = "pooled-list"
         if plan is not None:
-            inject.arm(plan)
-        wall, lat, _futs = run_service(svc, reqs)
-        if plan is not None:
-            faults += inject.disarm()
-        best_svc = min(best_svc, wall)
-        lats.extend(lat)
-        lwall, _ = run_loop(reqs, ns.nb, fns)
-        best_loop = min(best_loop, lwall)
+            # injected passes: tracing on (the incident evidence —
+            # flight dump, ladder spans — must come from the
+            # production mode), excluded from the throughput figures
+            for _ in range(max(ns.reps, 1)):
+                inject.arm(plan)
+                run_service(svc, reqs)
+                faults += inject.disarm()
+    if ns.spans:
+        svc.telemetry.tracer.save(ns.spans)
 
     nreq = len(reqs)
     sps = nreq / best_svc
     loop_sps = nreq / best_loop
     speedup = sps / loop_sps if loop_sps else None
-    slat = sorted(lats)
-    p50 = _pct(slat, 50)
-    p99 = _pct(slat, 99)
+    overhead = max((best_svc - best_off) / best_off, 0.0) \
+        if best_off > 0 else None
     summary = svc.summary()
     summary.update({
         "workload": {"requests": nreq, "ops": ops, "sizes": sizes,
@@ -209,9 +275,14 @@ def main(argv=None) -> int:
                      "reps": ns.reps},
         "solves_per_s": sps, "loop_solves_per_s": loop_sps,
         "speedup_vs_loop": speedup,
-        "measured_latency_s": {"p50": p50, "p99": p99},
+        "measured_latency_s": {"p50": p50, "p99": p99,
+                               "source": lat_src},
+        "trace_overhead_frac": overhead,
+        "trace_on_s": best_svc, "trace_off_s": best_off,
+        "flight_dump": flight,
         "injected_faults": len(faults)})
     report.add_serving(summary)
+    report.add_telemetry(svc.telemetry.summary())
     hit_rate = summary["cache"]["hit_rate"]
     entries = [
         {"metric": "serving.solves_per_s", "value": sps},
@@ -221,6 +292,13 @@ def main(argv=None) -> int:
         {"metric": "serving.p99_ms", "value": 1e3 * p99,
          "better": "lower"},
     ]
+    if overhead is not None:
+        entries.append({"metric": "serving.trace_overhead_frac",
+                        "value": overhead, "better": "lower"})
+        if overhead > 0.05:
+            print(f"#! servebench: tracing-on overhead "
+                  f"{100 * overhead:.1f}% exceeds the 5% budget",
+                  file=sys.stderr)
     if hit_rate is not None:
         entries.append({"metric": "serving.cache_hit_rate",
                         "value": hit_rate})
@@ -234,11 +312,15 @@ def main(argv=None) -> int:
                       "speedup_vs_loop": round(speedup, 3),
                       "p50_ms": round(1e3 * p50, 3),
                       "p99_ms": round(1e3 * p99, 3),
+                      "trace_overhead_frac":
+                          None if overhead is None
+                          else round(overhead, 4),
                       "cache_hit_rate": hit_rate,
                       "remediated": summary["remediated"],
                       "failed": summary["failed"]}), flush=True)
     if ns.verbose:
         print(json.dumps(summary, indent=1, default=str))
+    svc.close()
 
     if ns.report:
         report.write(ns.report)

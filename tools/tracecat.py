@@ -10,6 +10,22 @@ to the Chrome trace-event schema for Perfetto / chrome://tracing::
     python tools/tracecat.py --info run.prof     # metadata kv only
 
 Truncated traces (a run killed mid-write) convert with ``--lax``.
+
+**Merge mode** fuses several sources into ONE multi-lane timeline —
+the multichip picture (one pid lane per rank, the ``ring``/``panel``
+phases visible per chip) plus the serving layer's request spans and a
+phase ledger, on one rebased, time-monotone axis::
+
+    python tools/tracecat.py --merge r0.prof r1.prof \\
+        --serving spans.json --phases report.json -o merged.json
+
+``--serving`` takes a span document
+(:meth:`dplasma_tpu.observability.tracing.Tracer.save` /
+``tools/servebench.py --spans``); ``--phases`` takes either a
+run-report with per-op ``"phases"`` sections or a raw
+``PhaseLedger.summary()`` row list (durations only — its lane is a
+synthetic end-to-end layout, labelled as such). Both flags repeat.
+``--lax`` applies to every ``.prof`` input.
 """
 from __future__ import annotations
 
@@ -32,19 +48,101 @@ def convert(path: str, strict: bool = True) -> dict:
                              name=os.path.basename(path))
 
 
+def _load_phase_tables(path: str) -> list:
+    """Phase rows from one ``--phases`` input: a run-report (each op's
+    ``"phases"]["spans"]`` becomes one labelled table), a raw row
+    list, or ``{"phases": [rows]}``."""
+    with open(path) as f:
+        doc = json.load(f)
+    base = os.path.basename(path)
+    if isinstance(doc, list):
+        return [(base, doc)]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a phase ledger or run-report")
+    if isinstance(doc.get("phases"), list):
+        return [(base, doc["phases"])]
+    tables = []
+    for op in doc.get("ops") or []:
+        ph = (op or {}).get("phases")
+        if isinstance(ph, dict) and isinstance(ph.get("spans"), list):
+            tables.append((f"{base}:{op.get('label', '?')}",
+                           ph["spans"]))
+    if not tables:
+        raise ValueError(f"{path}: no phase rows found (want a "
+                         f"run-report with \"phases\" sections or a "
+                         f"PhaseLedger.summary() row list)")
+    return tables
+
+
+def _load_span_doc(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "spans" not in doc:
+        raise ValueError(f"{path}: not a serving span document "
+                         f"(want Tracer.save output)")
+    return doc
+
+
+def merge(trace_paths, serving=(), phases=(), strict: bool = True,
+          name: str = "merged") -> dict:
+    """Fuse rank traces + serving spans + phase ledgers into one
+    Chrome trace-event document (observability.chrome.merge_to_chrome
+    does the lane/timebase work)."""
+    from dplasma_tpu.observability.chrome import merge_to_chrome
+    from dplasma_tpu.utils.profiling import decode_wire_events
+
+    from dplasma_tpu import native
+    profiles = []
+    for p in trace_paths:
+        raw, info = native.read_trace(p, strict=strict)
+        info = dict(info)
+        info.setdefault("source", os.path.basename(p))
+        profiles.append((decode_wire_events(raw), info))
+    span_docs = [_load_span_doc(p) for p in serving]
+    tables = []
+    for p in phases:
+        tables.extend(_load_phase_tables(p))
+    return merge_to_chrome(profiles, span_docs, tables, name=name)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tracecat", description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="DTPUPROF1 file (driver --profile=)")
+    ap.add_argument("trace", nargs="+",
+                    help="DTPUPROF1 file(s) (driver --profile=); "
+                         "several only with --merge")
     ap.add_argument("-o", "--output", default=None,
                     help="output JSON path (default: stdout)")
     ap.add_argument("--lax", action="store_true",
                     help="tolerate a truncated final record")
     ap.add_argument("--info", action="store_true",
                     help="print the metadata kv pairs only")
+    ap.add_argument("--merge", action="store_true",
+                    help="fuse every input (rank traces + --serving "
+                         "spans + --phases ledgers) into one "
+                         "multi-lane timeline")
+    ap.add_argument("--serving", action="append", default=[],
+                    metavar="SPANS_JSON",
+                    help="serving span document to merge "
+                         "(Tracer.save / servebench --spans); "
+                         "repeatable, requires --merge")
+    ap.add_argument("--phases", action="append", default=[],
+                    metavar="LEDGER_JSON",
+                    help="phase ledger (run-report with \"phases\" "
+                         "or raw summary rows) to merge as a "
+                         "synthetic lane; repeatable, requires "
+                         "--merge")
     ns = ap.parse_args(argv)
+    if not ns.merge and (len(ns.trace) > 1 or ns.serving or ns.phases):
+        sys.stderr.write("tracecat: multiple traces / --serving / "
+                         "--phases require --merge\n")
+        return 2
     try:
-        doc = convert(ns.trace, strict=not ns.lax)
+        if ns.merge:
+            doc = merge(ns.trace, serving=ns.serving,
+                        phases=ns.phases, strict=not ns.lax)
+        else:
+            doc = convert(ns.trace[0], strict=not ns.lax)
     except (OSError, ValueError, EOFError) as exc:
         sys.stderr.write(f"tracecat: {exc}\n")
         return 1
