@@ -40,6 +40,20 @@ wrongness can enter:
   vs the ``hlocheck.hbm_budget`` knob, and host-callback /
   copy-volume anti-patterns. Driven by ``--hlocheck``, the serving
   executable cache, and ``tools/lint_all.py``.
+* :mod:`.threadcheck` — the lock-discipline verifier over the
+  serving/telemetry concurrency surface: a declared guarded-state
+  registry (class attribute → owning lock) checked by five AST rules
+  (guarded access outside the lock, check-then-act, lock-order
+  cycles with the full cycle named, unregistered thread spawns,
+  publish-outside-lock contracts). Driven by ``tools/lint_all.py``.
+* :mod:`.racefuzz` — the dynamic half: seeded, replayable thread
+  schedules (caller/timer/exporter mix, barrier-synchronized under a
+  tiny switch interval) driven against invariant probes — cache
+  hit+miss+eviction conservation, the histogram spill transition,
+  counter conservation, override-stack LIFO integrity, the balanced
+  tracer span ledger, flight-ring drop accounting, publish-under-
+  lock gauges — so every race class a past review round caught by
+  eye has a named static rule AND a replayable dynamic regression.
 """
 from dplasma_tpu.analysis.dagcheck import (DagCheckError, check_dag,
                                            rank_of_dist)
@@ -55,10 +69,17 @@ from dplasma_tpu.analysis.spmdcheck import (SpmdCheckError,
                                             check_kernel, check_ring,
                                             extract_schedule,
                                             simulate_ring)
+from dplasma_tpu.analysis.threadcheck import ThreadCheckError
+from dplasma_tpu.analysis.threadcheck import \
+    check_package as threadcheck_package
+from dplasma_tpu.analysis.threadcheck import \
+    verify_package as threadcheck_verify
 
 __all__ = ["DagCheckError", "check_dag", "rank_of_dist",
            "jaxlint_file", "jaxlint_tree",
            "SpmdCheckError", "check_kernel", "check_ring",
            "extract_schedule", "simulate_ring",
            "PalCheckError", "check_contract", "check_package",
-           "HloCheckError", "check_executable", "verify_executable"]
+           "HloCheckError", "check_executable", "verify_executable",
+           "ThreadCheckError", "threadcheck_package",
+           "threadcheck_verify"]

@@ -114,11 +114,14 @@ _SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*ok(?:=(\w+))?")
 Violation = Tuple[int, str, str]          # (line, code, message)
 
 
-def _suppressions(src: str) -> dict:
-    """line -> suppressed code ('' = all) from `# jaxlint: ok` comments."""
+def _suppressions(src: str, pattern=None) -> dict:
+    """line -> suppressed code ('' = all) from `# jaxlint: ok`
+    comments (``pattern`` lets sibling linters — threadcheck — reuse
+    the scanner with their own marker)."""
     out = {}
+    pattern = pattern or _SUPPRESS_RE
     for ln, text in enumerate(src.splitlines(), 1):
-        m = _SUPPRESS_RE.search(text)
+        m = pattern.search(text)
         if m:
             out[ln] = m.group(1) or ""
     return out

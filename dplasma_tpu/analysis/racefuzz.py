@@ -1,0 +1,575 @@
+"""Deterministic schedule-fuzz race harness — the dynamic half of the
+thread-discipline verifier.
+
+:mod:`dplasma_tpu.analysis.threadcheck` proves the lock discipline
+statically; this module *runs* the concurrency surface under seeded
+thread schedules and checks the invariants every past review round
+verified by eye. One probe per historical race class:
+
+* ``cache_lru`` — caller+timer threads hammer an
+  :class:`~dplasma_tpu.serving.cache.ExecutableCache` (compiles
+  stubbed via the ``_compile`` hook) with interleaved get/invalidate/
+  stats; invariant: **hit+miss+eviction conservation** — every get is
+  a hit or a miss, every admitted entry is resident, evicted, or
+  invalidated, residency never exceeds capacity (the r8-vii class:
+  an unlocked ``move_to_end`` racing eviction breaks this with a
+  ``KeyError``).
+* ``histogram_spill`` — concurrent ``observe`` across the
+  exact→bucket spill boundary; invariant: ``count == Σ buckets`` and
+  the percentile path never sees a half-spilled state (r14-i).
+* ``counters`` — concurrent counter incs / gauge adds / histogram
+  observes; invariant: **exact conservation** (``value == Σ incs`` —
+  an unlocked ``value += x`` loses increments between threads).
+* ``override_stack`` — threads push/pop scoped MCA overrides under
+  the sanctioned serialization; invariant: **LIFO integrity** — no
+  RuntimeError, depth returns to zero, no leaked override (r11-i).
+* ``tracer_ledger`` — threads open/close nested spans and add
+  external ones; invariant: the **span ledger balances** (every open
+  has a close, per-lane stacks drain).
+* ``flight_ring`` — concurrent ``record`` into a bounded ring;
+  invariant: recorded == Σ ops, dropped == recorded - kept, event
+  seqs strictly increasing (no torn/duplicated slots).
+* ``gauge_publish`` — the r14-vii model: a depth counter and its
+  gauge must publish in one critical section; invariant: the gauge
+  agrees with the state at quiescence and no stale publish was
+  observed mid-run.
+
+**Determinism contract**: the *schedule* — which ops each thread runs,
+in which per-thread order — is a pure function of ``(probe, seed)``
+(seeded stdlib RNG, no wall clock), recorded on every
+:class:`ProbeResult` so a failing run is replayable; the harness
+shrinks ``sys.setswitchinterval`` so the OS explores many
+interleavings of that schedule per run. For the disciplined targets
+the invariants hold under EVERY interleaving, so same seed → same
+schedule → same verdict; the regression tests drive the same probes
+against reverted-fix variants (amplified with :func:`yield_point`
+between their check and act) and watch the invariants break.
+
+``fuzz()`` returns the gate summary — ``schedules_run`` /
+``invariant_failures`` — that ``tools/lint_all.py``'s threadcheck
+gate prints and ``tools/perfdiff.py`` extracts (a silently shrinking
+fuzz surface gates like a perf regression). CLI::
+
+    python -m dplasma_tpu.analysis.racefuzz --seeds 0,1,2,3 \\
+        --report racefuzz.json     # {"racefuzz": {...}} for perfdiff
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+#: fixed seeds of the lint-gate smoke (tests may widen)
+DEFAULT_SEEDS: Tuple[int, ...] = (0, 1, 2, 3)
+#: scheduler switch interval while a schedule runs (restored after):
+#: small enough that the OS explores many interleavings per schedule
+SWITCH_INTERVAL = 1e-5
+
+
+def yield_point() -> None:
+    """Cooperative scheduling point — ``time.sleep(0)`` yields the
+    GIL. The disciplined probes call it inside critical sections
+    (where it must be harmless); reverted-fix regression variants
+    call it between their check and their act to make the historical
+    race fire deterministically instead of once a fortnight."""
+    time.sleep(0)
+
+
+@dataclasses.dataclass
+class ProbeResult:
+    """One (probe, seed) schedule replay: the verdict, every violated
+    invariant, and the exact replayable schedule."""
+
+    probe: str
+    seed: int
+    ok: bool
+    failures: List[str]
+    schedule: dict            # {"threads": [[op, ...], ...]}
+
+    def as_dict(self) -> dict:
+        return {"probe": self.probe, "seed": self.seed, "ok": self.ok,
+                "failures": list(self.failures)}
+
+
+def _rng(probe: str, seed: int) -> random.Random:
+    """The schedule RNG: seeded from the (probe, seed) pair via the
+    stable string path (never ``hash()`` — it is salted per
+    process)."""
+    return random.Random(f"racefuzz:{probe}:{seed}")
+
+
+def _run_threads(workers: Sequence[Callable[[], None]],
+                 switch_interval: float) -> List[str]:
+    """Run the workers barrier-synchronized under a tiny scheduler
+    switch interval (restored afterwards); returns the repr of every
+    exception any worker raised."""
+    errors: List[str] = []
+    barrier = threading.Barrier(len(workers))
+
+    def _wrap(fn):
+        def go():
+            barrier.wait()
+            try:
+                fn()
+            except BaseException as exc:
+                errors.append(f"{type(exc).__name__}: {exc}")
+        return go
+
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(switch_interval)
+    try:
+        threads = [threading.Thread(target=_wrap(fn), daemon=True)
+                   for fn in workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        for t in threads:
+            if t.is_alive():
+                errors.append("worker did not drain (possible "
+                              "deadlock)")
+    finally:
+        sys.setswitchinterval(prev)
+    return errors
+
+
+# ---------------------------------------------------------- the probes
+
+def make_stub_cache(capacity: int = 4):
+    """An :class:`ExecutableCache` whose ``_compile`` hook is stubbed
+    (no jax, no compile — the probe fuzzes the LOCK discipline, not
+    XLA); ``compiles`` counts admissions (incremented under the cache
+    lock, so it is exact)."""
+    from dplasma_tpu.serving import cache as cache_mod
+
+    class _StubCache(cache_mod.ExecutableCache):
+        def __init__(self, cap):
+            super().__init__(capacity=cap)
+            self.compiles = 0
+
+        def _compile(self, key, build, args):      # under _lock
+            self.compiles += 1
+            return cache_mod.Entry(fn=lambda *a: None, key=key,
+                                   compile_s=0.0, tainted=False)
+
+    return _StubCache(capacity)
+
+
+def _cache_keys(n: int = 10) -> list:
+    from dplasma_tpu.serving import cache as cache_mod
+    return [cache_mod.CacheKey(op="posv", n=8 * (i + 1), dtype="f32",
+                               batch=1, nrhs=4, grid=(1, 1),
+                               pipeline=(1, 4), precision="")
+            for i in range(n)]
+
+
+def _probe_cache_lru(seed: int, nthreads: int, nops: int,
+                     factory: Optional[Callable] = None
+                     ) -> Tuple[List[str], dict]:
+    cache = (factory or make_stub_cache)()
+    keys = _cache_keys()
+    rng = _rng("cache_lru", seed)
+    plans = [[("get", rng.randrange(len(keys)))
+              if rng.random() < 0.7 else
+              ("invalidate", rng.randrange(len(keys)))
+              if rng.random() < 0.7 else ("stats",)
+              for _ in range(nops)] for _ in range(nthreads)]
+
+    def worker(plan):
+        def go():
+            for op in plan:
+                if op[0] == "get":
+                    cache.get(keys[op[1]], lambda: None)
+                elif op[0] == "invalidate":
+                    cache.invalidate(keys[op[1]])
+                else:
+                    cache.stats()
+        return go
+
+    errors = _run_threads([worker(p) for p in plans],
+                          SWITCH_INTERVAL)
+    failures = list(errors)
+    gets = sum(1 for p in plans for op in p if op[0] == "get")
+
+    def _c(name):
+        m = cache.metrics.get(name)
+        return int(m.value) if m is not None else 0
+
+    hits, misses = _c("serving_cache_hits_total"), \
+        _c("serving_cache_misses_total")
+    evs = _c("serving_cache_evictions_total")
+    invs = _c("serving_cache_invalidations_total")
+    if hits + misses != gets:
+        failures.append(f"hit+miss conservation broken: "
+                        f"{hits}+{misses} != {gets} gets")
+    if misses != cache.compiles:
+        failures.append(f"every miss must compile exactly once: "
+                        f"{misses} misses, {cache.compiles} compiles")
+    if evs + invs + len(cache) != misses:
+        failures.append(f"admission conservation broken: "
+                        f"evicted({evs}) + invalidated({invs}) + "
+                        f"resident({len(cache)}) != admitted"
+                        f"({misses})")
+    if len(cache) > cache.capacity:
+        failures.append(f"residency {len(cache)} exceeds capacity "
+                        f"{cache.capacity}")
+    return failures, {"threads": plans}
+
+
+def _probe_histogram_spill(seed: int, nthreads: int, nops: int,
+                           factory: Optional[Callable] = None
+                           ) -> Tuple[List[str], dict]:
+    from dplasma_tpu.observability.metrics import Histogram
+    h = factory() if factory is not None else Histogram(exact_cap=8)
+    rng = _rng("histogram_spill", seed)
+    plans = [[round(rng.uniform(-4.0, 4.0), 3) for _ in range(nops)]
+             for _ in range(nthreads)]
+
+    def worker(plan):
+        def go():
+            for v in plan:
+                h.observe(v)
+                h.percentile(50.0)      # reader racing the spill
+        return go
+
+    errors = _run_threads([worker(p) for p in plans],
+                          SWITCH_INTERVAL)
+    failures = list(errors)
+    total = nthreads * nops
+    try:
+        st = h.stats()
+        if st["count"] != total:
+            failures.append(f"count {st['count']} != {total} "
+                            f"observes")
+        bsum = sum(h._buckets.values())
+        if bsum != total:
+            failures.append(f"sum(buckets) {bsum} != {total} "
+                            f"observes (torn spill transition)")
+    except Exception as exc:
+        # a torn spill state (the r14-i class) can corrupt the
+        # accumulators themselves — that is a verdict, not a harness
+        # crash
+        failures.append(f"stats() raised {type(exc).__name__}: {exc} "
+                        f"(torn spill state)")
+    return failures, {"threads": plans}
+
+
+def _probe_counters(seed: int, nthreads: int, nops: int,
+                    factory: Optional[Callable] = None
+                    ) -> Tuple[List[str], dict]:
+    from dplasma_tpu.observability.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    if factory is not None:   # regression variants swap the Counter
+        reg._metrics[("racefuzz_total", ())] = factory()
+        reg._families["racefuzz_total"] = "counter"
+    rng = _rng("counters", seed)
+    plans = [[("inc",) if rng.random() < 0.5 else
+              ("gadd", 1 if rng.random() < 0.5 else -1)
+              for _ in range(nops)] for _ in range(nthreads)]
+
+    def worker(plan):
+        def go():
+            for op in plan:
+                if op[0] == "inc":
+                    reg.counter("racefuzz_total").inc()
+                else:
+                    reg.gauge("racefuzz_depth").add(op[1])
+        return go
+
+    errors = _run_threads([worker(p) for p in plans],
+                          SWITCH_INTERVAL)
+    failures = list(errors)
+    incs = sum(1 for p in plans for op in p if op[0] == "inc")
+    net = sum(op[1] for p in plans for op in p if op[0] == "gadd")
+    cval = reg.counter("racefuzz_total").value
+    gval = reg.gauge("racefuzz_depth").value
+    if cval != float(incs):
+        failures.append(f"counter lost increments: value {cval} != "
+                        f"{incs} incs")
+    if gval != float(net):
+        failures.append(f"gauge lost adjustments: value {gval} != "
+                        f"net {net}")
+    return failures, {"threads": plans}
+
+
+def _probe_override_stack(seed: int, nthreads: int, nops: int,
+                          factory: Optional[Callable] = None
+                          ) -> Tuple[List[str], dict]:
+    from dplasma_tpu.utils import config as _cfg
+    # the sanctioned serialization (the serving layer's _TUNE_LOCK
+    # contract); a regression factory supplies a no-op lock to model
+    # the r11-i revert
+    lock = factory() if factory is not None else threading.Lock()
+    rng = _rng("override_stack", seed)
+    plans = [[rng.randrange(1, 9) for _ in range(nops)]
+             for _ in range(nthreads)]
+    before = dict(_cfg._MCA_OVERRIDES)
+
+    def worker(tid, plan):
+        def go():
+            for v in plan:
+                with lock, _cfg.override_scope(
+                        {"racefuzz.knob": str(v)},
+                        label=f"racefuzz-{tid}"):
+                    # a real (tiny) dwell inside the scope: harmless
+                    # under the sanctioned lock, but it holds the
+                    # push..pop window open so the r11-i revert (no
+                    # serialization) interleaves its pops reliably
+                    time.sleep(5e-5)
+        return go
+
+    errors = _run_threads(
+        [worker(i, p) for i, p in enumerate(plans)], SWITCH_INTERVAL)
+    failures = list(errors)
+    # scrub any frames a broken variant leaked so later probes/tests
+    # see a clean stack (only racefuzz's own frames are popped)
+    while _cfg._OVERRIDE_STACK and \
+            _cfg._OVERRIDE_STACK[-1].label.startswith("racefuzz"):
+        _cfg.pop_overrides(_cfg._OVERRIDE_STACK[-1])
+    leaked = _cfg._MCA_OVERRIDES.get("racefuzz.knob")
+    if leaked is not None:
+        _cfg._MCA_OVERRIDES.pop("racefuzz.knob", None)
+        failures.append(f"override leaked past its scope: "
+                        f"racefuzz.knob={leaked!r}")
+    if _cfg._MCA_OVERRIDES != before:
+        failures.append("override map not restored to its pre-probe "
+                        "state")
+    return failures, {"threads": plans}
+
+
+def _probe_tracer_ledger(seed: int, nthreads: int, nops: int,
+                         factory: Optional[Callable] = None
+                         ) -> Tuple[List[str], dict]:
+    from dplasma_tpu.observability.tracing import Tracer
+    tr = factory() if factory is not None else \
+        Tracer(enabled=True, capacity=128)
+    rng = _rng("tracer_ledger", seed)
+    plans = [[("span", rng.randrange(3)) if rng.random() < 0.8
+              else ("add",) for _ in range(nops)]
+             for _ in range(nthreads)]
+
+    def worker(tid, plan):
+        def go():
+            for op in plan:
+                if op[0] == "span":
+                    with tr.span("outer", request=tid):
+                        for _ in range(op[1]):
+                            with tr.span("inner"):
+                                pass
+                else:
+                    t0 = time.time_ns()
+                    tr.add("ext", t0, t0 + 10, request=tid)
+        return go
+
+    errors = _run_threads(
+        [worker(i, p) for i, p in enumerate(plans)], SWITCH_INTERVAL)
+    failures = list(errors)
+    if not tr.balanced():
+        failures.append(f"span ledger unbalanced at quiescence: "
+                        f"{tr.summary()}")
+    with tr._lock:
+        depths = [len(st["stack"]) for st in tr._states]
+    if any(depths):
+        failures.append(f"per-lane span stacks did not drain: "
+                        f"{depths}")
+    tr.spans()          # rehydration must not raise mid-traffic
+    return failures, {"threads": plans}
+
+
+def _probe_flight_ring(seed: int, nthreads: int, nops: int,
+                       factory: Optional[Callable] = None
+                       ) -> Tuple[List[str], dict]:
+    from dplasma_tpu.observability.telemetry import FlightRecorder
+    fr = factory() if factory is not None else \
+        FlightRecorder(capacity=16)
+    rng = _rng("flight_ring", seed)
+    plans = [[rng.randrange(100) for _ in range(nops)]
+             for _ in range(nthreads)]
+
+    def worker(tid, plan):
+        def go():
+            for v in plan:
+                fr.record("racefuzz", thread=tid, v=v)
+        return go
+
+    errors = _run_threads(
+        [worker(i, p) for i, p in enumerate(plans)], SWITCH_INTERVAL)
+    failures = list(errors)
+    total = nthreads * nops
+    s = fr.summary()
+    if s["recorded"] != total:
+        failures.append(f"recorded {s['recorded']} != {total} ops "
+                        f"(torn seq increments)")
+    if s["dropped"] != total - len(s["events"]):
+        failures.append(f"drop accounting broken: dropped="
+                        f"{s['dropped']}, recorded {total}, kept "
+                        f"{len(s['events'])}")
+    seqs = [e["seq"] for e in s["events"]]
+    if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+        failures.append("event seqs not strictly increasing "
+                        "(duplicated/reordered ring slots)")
+    return failures, {"threads": plans}
+
+
+class GaugePublisher:
+    """The disciplined r14-vii publisher: the depth and its gauge
+    mutate in ONE critical section, so the gauge can never lag the
+    state it mirrors. The regression variant publishes after release
+    (with a :func:`yield_point` in the window) and counts the stale
+    publishes it observes in ``anomalies``."""
+
+    def __init__(self, gauge):
+        self.lock = threading.Lock()
+        self.depth = 0
+        self.gauge = gauge
+        self.anomalies = 0
+
+    def adjust(self, d: int) -> None:
+        with self.lock:
+            self.depth += d
+            self.gauge.set(self.depth)
+            if self.gauge.value != self.depth:
+                self.anomalies += 1
+
+
+def _probe_gauge_publish(seed: int, nthreads: int, nops: int,
+                         factory: Optional[Callable] = None
+                         ) -> Tuple[List[str], dict]:
+    from dplasma_tpu.observability.metrics import Gauge
+    gauge = Gauge()
+    pub = factory(gauge) if factory is not None \
+        else GaugePublisher(gauge)
+    rng = _rng("gauge_publish", seed)
+    plans = [[1 if rng.random() < 0.5 else -1 for _ in range(nops)]
+             for _ in range(nthreads)]
+
+    def worker(plan):
+        def go():
+            for d in plan:
+                pub.adjust(d)
+        return go
+
+    errors = _run_threads([worker(p) for p in plans],
+                          SWITCH_INTERVAL)
+    failures = list(errors)
+    expect = sum(d for p in plans for d in p)
+    if pub.depth != expect:
+        failures.append(f"depth {pub.depth} != scheduled net "
+                        f"{expect} (lost updates)")
+    if gauge.value != float(pub.depth):
+        failures.append(f"gauge {gauge.value} disagrees with the "
+                        f"state it mirrors ({pub.depth}) at "
+                        f"quiescence — stale publish stuck")
+    if pub.anomalies:
+        failures.append(f"{pub.anomalies} stale publish(es) observed "
+                        f"mid-run (gauge lagged its state)")
+    return failures, {"threads": plans}
+
+
+#: probe name -> implementation; the keys ARE the fuzz surface the
+#: lint gate sizes (perfdiff gates schedules_run against shrinking)
+PROBES: Dict[str, Callable] = {
+    "cache_lru": _probe_cache_lru,
+    "histogram_spill": _probe_histogram_spill,
+    "counters": _probe_counters,
+    "override_stack": _probe_override_stack,
+    "tracer_ledger": _probe_tracer_ledger,
+    "flight_ring": _probe_flight_ring,
+    "gauge_publish": _probe_gauge_publish,
+}
+
+
+# ----------------------------------------------------------- driving
+
+def run_probe(name: str, seed: int, *, nthreads: int = 4,
+              nops: int = 150,
+              factory: Optional[Callable] = None) -> ProbeResult:
+    """Replay one (probe, seed) schedule; ``factory`` swaps the
+    target for a variant (the reverted-fix regression tests)."""
+    fn = PROBES.get(name)
+    if fn is None:
+        raise KeyError(f"unknown racefuzz probe {name!r} "
+                       f"(have: {sorted(PROBES)})")
+    failures, schedule = fn(seed, nthreads, nops, factory)
+    return ProbeResult(probe=name, seed=seed, ok=not failures,
+                       failures=failures, schedule=schedule)
+
+
+def fuzz(seeds: Sequence[int] = DEFAULT_SEEDS,
+         probes: Optional[Sequence[str]] = None, *,
+         nthreads: int = 4, nops: int = 150) -> dict:
+    """Run every probe over every seed; returns the gate summary::
+
+        {"schedules_run": .., "invariant_failures": ..,
+         "probes": {name: [ProbeResult.as_dict(), ..]}, ...}
+
+    ``schedules_run`` is the fuzz surface (probes x seeds) perfdiff
+    gates against silent shrinkage; ``invariant_failures`` counts
+    every violated invariant across all schedules (0 on a healthy
+    tree)."""
+    names = list(probes) if probes is not None else sorted(PROBES)
+    results: Dict[str, List[ProbeResult]] = {}
+    failures = 0
+    for name in names:
+        results[name] = []
+        for seed in seeds:
+            r = run_probe(name, seed, nthreads=nthreads, nops=nops)
+            results[name].append(r)
+            failures += len(r.failures)
+    return {"schedules_run": len(names) * len(seeds),
+            "invariant_failures": failures,
+            "seeds": list(seeds), "nthreads": nthreads, "nops": nops,
+            "probes": {n: [r.as_dict() for r in rs]
+                       for n, rs in results.items()}}
+
+
+def summary_doc(res: dict) -> dict:
+    """The perfdiff-comparable document: ``{"racefuzz": {...}}`` —
+    ``schedules_run`` gates higher-better (a shrinking fuzz surface
+    is a regression), ``invariant_failures`` lower-better."""
+    return {"racefuzz": {
+        "schedules_run": res["schedules_run"],
+        "invariant_failures": res["invariant_failures"],
+        "seeds": res["seeds"], "probes": sorted(res["probes"])}}
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="racefuzz", description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", default="0,1,2,3",
+                    help="comma-separated schedule seeds")
+    ap.add_argument("--probe", action="append", default=None,
+                    help="probe name (repeatable; default: all)")
+    ap.add_argument("--nthreads", type=int, default=4)
+    ap.add_argument("--nops", type=int, default=150,
+                    help="ops per thread per schedule")
+    ap.add_argument("--report", default="",
+                    help="write the perfdiff-comparable "
+                         "{'racefuzz': ...} JSON doc here")
+    ns = ap.parse_args(argv)
+    seeds = [int(s) for s in ns.seeds.split(",") if s.strip()]
+    res = fuzz(seeds, ns.probe, nthreads=ns.nthreads, nops=ns.nops)
+    for name, rs in sorted(res["probes"].items()):
+        bad = [r for r in rs if not r["ok"]]
+        print(f"# racefuzz[{name}]: {len(rs)} schedule(s), "
+              f"{'OK' if not bad else f'{len(bad)} FAILED'}")
+        for r in bad:
+            for f in r["failures"]:
+                sys.stderr.write(f"racefuzz[{name} seed={r['seed']}]"
+                                 f": {f}\n")
+    print(f"# racefuzz: schedules_run={res['schedules_run']} "
+          f"invariant_failures={res['invariant_failures']}")
+    if ns.report:
+        with open(ns.report, "w") as f:
+            json.dump(summary_doc(res), f, indent=1)
+            f.write("\n")
+    return 0 if res["invariant_failures"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1010 @@
+"""Static lock-discipline verifier for the serving/telemetry
+concurrency surface — the fourth verifier.
+
+The analysis package proves the tile DAG (dagcheck), the SPMD
+collective schedule (spmdcheck), and the compiled HLO (hlocheck);
+nothing verified the *thread* interleavings, and concurrency has been
+the repo's dominant hand-caught bug class: the unlocked LRU
+``move_to_end`` racing eviction (r8-vii), the Histogram exact→bucket
+spill check-then-act (r14-i), interleaved MCA override-stack pops
+(r11-i), out-of-order gauge publishes (r14-vii). This module encodes
+the discipline those reviews enforced by eye as a declared
+guarded-state registry (:data:`GUARDS`: class attribute → owning
+lock) plus five AST rules over ``serving/``, ``observability/``,
+``tuning/``, ``resilience/`` (its Watchdog owns the package's one
+other Timer), and ``utils/config.py``:
+
+* **T001 guarded-access-outside-lock** — a :data:`GUARDS`-registered
+  attribute read or written in a method body without the owning lock
+  lexically held (``with self.<lock>:``). Attributes registered mode
+  ``"w"`` guard writes only (a single read of a float/int is
+  GIL-atomic; the read-modify-write is not); mode ``"rw"`` guards
+  both. ``__init__`` is exempt (construction happens-before
+  publication), and registry ``under_lock`` helpers are assumed
+  called with the lock held (their call sites are checked instead).
+  Also fired for module-guard contracts (:data:`CALL_UNDER`): e.g.
+  the MCA override stack is process-global and strictly LIFO, so
+  ``override_scope``/``push_overrides`` calls inside ``serving/``
+  must hold ``_TUNE_LOCK``.
+* **T002 check-then-act** — a guarded read in a branch condition
+  evaluated *outside* the lock whose body then acquires the lock and
+  mutates guarded state: the classic lost-update window (the r14-i
+  spill class). Acquire around the whole check+act instead.
+* **T003 lock-order-cycle** — a cycle in the package's
+  lock-acquisition graph (edges from lexical ``with`` nesting, from
+  calls made under a held lock to methods known to acquire another
+  lock — the callee's class lock or a module lock it takes, resolved
+  via the declared ``receivers`` typing hints — and from
+  :data:`EXTRA_EDGES`). The diagnostic names the full cycle with
+  every edge's site, like dagcheck names a dependence cycle. A
+  self-edge on a non-reentrant (plain ``Lock``) class is reported as
+  a self-deadlock; reentrant (``RLock``) classes may self-nest.
+* **T004 unregistered-thread-spawn** — a ``threading.Thread`` /
+  ``threading.Timer`` construction (any import spelling — bare and
+  aliased names resolve) outside the :data:`THREAD_SITES` allowlist.
+  Every thread the package spawns must be a known, accounted-for
+  concurrency source: the batch-window timer, the exporter daemon,
+  and the resilience Watchdog's run-timeout timer are the registered
+  mix the racefuzz harness models.
+* **T005 publish-outside-lock** — a metric the contract says must be
+  published under a lock (:data:`PUBLISH_UNDER`) ``set()`` outside
+  it. The r14-vii class: a gauge set after release can land out of
+  order against a racing update and stick a stale value in the
+  streaming exporter forever.
+
+Suppress a finding with a trailing ``# threadcheck: ok`` (or
+``# threadcheck: ok=T00x``) comment, mirroring jaxlint.
+
+Static approximation, by design: lock scopes are lexical (a lock
+acquired in a helper and released in another is already a discipline
+violation here), receiver types come from the declared registry (not
+inference), and the call graph is one level deep through those
+declarations. The dynamic complement — seeded thread schedules
+replayed against invariant probes — is :mod:`dplasma_tpu.analysis.
+racefuzz`; both are enforced from ``tools/lint_all.py``'s
+``threadcheck`` gate.
+
+Usage: ``python -m dplasma_tpu.analysis.threadcheck [root ...]`` —
+exits nonzero and prints ``file:line: CODE message`` per violation.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+from dplasma_tpu.analysis.jaxlint import _dotted
+
+#: package subtrees / files the verifier sweeps (repo-relative posix) —
+#: the layers that run under the serving thread mix (caller + timer +
+#: exporter daemon), plus resilience/ (its Watchdog owns the one
+#: other Timer in the package — T004 must see every spawn site for
+#: the enumerable-surface claim to be true)
+SCAN_DIRS = ("dplasma_tpu/serving", "dplasma_tpu/observability",
+             "dplasma_tpu/tuning", "dplasma_tpu/resilience")
+SCAN_FILES = ("dplasma_tpu/utils/config.py",)
+
+
+@dataclass(frozen=True)
+class Guard:
+    """Declared locking contract of one class.
+
+    ``lock`` is the owning lock attribute; ``attrs`` maps guarded
+    attribute → mode (``"rw"`` = reads and writes need the lock,
+    ``"w"`` = writes only, single reads are GIL-atomic);
+    ``under_lock`` names helper methods whose bodies assume the lock
+    (every call site must already hold it); ``lockfree`` maps
+    attributes that are lock-free BY DESIGN to their one-line
+    justification (the checker skips them but the registry documents
+    why); ``receivers`` maps ``self.<path>`` attribute chains to the
+    registered class they hold (the typing hints the lock-graph
+    walk resolves calls through); ``reentrant`` says whether the lock
+    is an ``RLock`` (self-nesting legal)."""
+
+    lock: str
+    attrs: Mapping[str, str] = field(default_factory=dict)
+    under_lock: frozenset = frozenset()
+    lockfree: Mapping[str, str] = field(default_factory=dict)
+    receivers: Mapping[str, str] = field(default_factory=dict)
+    reentrant: bool = False
+
+
+#: the guarded-state registry: every lock-owning class on the
+#: serving/telemetry surface, its guarded attributes, and its declared
+#: escape hatches. A new lock-owning class in the scanned packages
+#: belongs here — an unregistered class is simply unchecked, so the
+#: registry IS the coverage statement.
+GUARDS: Dict[str, Guard] = {
+    # serving/cache.py — caller + timer threads both dispatch through
+    # get(): every OrderedDict access is lock-protected (the r8-vii
+    # class: an unlocked hit's move_to_end races eviction into
+    # KeyError); compiles serialize under the same RLock.
+    "ExecutableCache": Guard(
+        lock="_lock", attrs={"_d": "rw"},
+        under_lock=frozenset({"_compile"}),
+        receivers={"metrics": "MetricsRegistry",
+                   "recorder": "FlightRecorder"},
+        reentrant=True),
+    # serving/service.py — the scheduler state shared by caller,
+    # timer, and (via metrics) exporter threads.
+    "SolverService": Guard(
+        lock="_lock",
+        attrs={"_pending": "rw", "_timers": "rw", "_keys": "rw",
+               "_tuning": "rw", "_latencies": "rw", "resilience": "rw",
+               "_batches": "rw", "_requests": "rw", "_next_rid": "rw",
+               "_queued": "rw", "_inflight": "rw"},
+        under_lock=frozenset({"_cancel_timer"}),
+        receivers={"cache": "ExecutableCache",
+                   "metrics": "MetricsRegistry",
+                   "telemetry.flight": "FlightRecorder",
+                   "telemetry.tracer": "Tracer"},
+        reentrant=True),
+    # observability/metrics.py — serving observes from caller AND
+    # timer threads while the exporter reads percentiles; the spill
+    # transition (r14-i) is a check-then-act that crashes unlocked.
+    "Histogram": Guard(
+        lock="_lock",
+        attrs={"_count": "rw", "_sum": "rw", "_sumsq": "rw",
+               "_min": "rw", "_max": "rw", "_buckets": "rw",
+               "_exact": "rw"},
+        under_lock=frozenset({"_percentile", "_stats", "_zero"}),
+        reentrant=True),
+    # Counter.inc / Gauge.add are read-modify-writes: two threads'
+    # `value += x` interleaving loses increments. Single reads of the
+    # float stay lock-free (mode "w").
+    "Counter": Guard(lock="_lock", attrs={"value": "w"}),
+    "Gauge": Guard(lock="_lock", attrs={"value": "w"}),
+    "MetricsRegistry": Guard(
+        lock="_lock", attrs={"_families": "rw", "_metrics": "rw"}),
+    # observability/telemetry.py
+    "FlightRecorder": Guard(
+        lock="_lock", attrs={"_d": "rw", "_seq": "rw"}),
+    # the flusher daemon vs start()/stop()/manual flush(): the rate
+    # memo is a check-then-act and the tmp-file rename is not
+    # idempotent, so flushes serialize. `flushes` is a counter (RMW);
+    # `_thread` is the spawn/teardown check-then-act (double start =
+    # an orphan flusher rewriting the export file forever).
+    "MetricsExporter": Guard(
+        lock="_lock",
+        attrs={"_prev_counts": "rw", "_prev_t": "rw", "flushes": "w",
+               "_thread": "rw"},
+        under_lock=frozenset({"_update_rates"}),
+        receivers={"registry": "MetricsRegistry"}),
+    # observability/tracing.py — the hot path is lock-free BY DESIGN:
+    # each thread owns its lane dict, finished spans commit via the
+    # GIL-atomic append of a bounded deque. Only lane creation and the
+    # summary/clear paths take the lock.
+    "Tracer": Guard(
+        lock="_lock", attrs={"_states": "rw"},
+        lockfree={"_spans": "bounded deque; per-span append and "
+                            "snapshot iteration are GIL-atomic — the "
+                            "always-on hot path must not take a lock "
+                            "per span"}),
+}
+
+#: module-level locks the scanned packages share (a `with <NAME>:` on
+#: one of these names is a lock acquisition wherever it appears)
+MODULE_LOCKS: Set[str] = {"_TUNE_LOCK"}
+
+#: (file, qualname) sites allowed to construct threading.Thread/Timer:
+#: the batch-window timer and the exporter daemon are the package's
+#: only sanctioned thread sources (racefuzz models exactly this mix)
+THREAD_SITES: Set[Tuple[str, str]] = {
+    ("dplasma_tpu/serving/service.py", "SolverService.submit"),
+    ("dplasma_tpu/observability/telemetry.py", "MetricsExporter.start"),
+    # the run-timeout watchdog (one daemon Timer per guarded region,
+    # cancelled on exit — resilience/guard.py)
+    ("dplasma_tpu/resilience/guard.py", "Watchdog.__enter__"),
+}
+
+#: metric name -> lock id that must be held at every `.gauge(name).set`
+#: call site (the r14-vii publish-under-lock contracts: these gauges
+#: must publish in the same critical section that computed them, or a
+#: racing update can overwrite a fresher value with a stale one)
+PUBLISH_UNDER: Dict[str, str] = {
+    "serving_queue_depth": "SolverService._lock",
+    "serving_inflight_batches": "SolverService._lock",
+    "serving_cache_entries": "ExecutableCache._lock",
+}
+
+#: callee name -> (package prefix, lock id): calls that mutate
+#: process-global state (the MCA override stack is strictly LIFO,
+#: r11-i) must hold the named lock when made from the threaded
+#: packages. utils/config.py itself stays lock-free by contract — it
+#: is trace-time host code; the serving layer is the one caller that
+#: runs it from concurrent dispatch threads.
+CALL_UNDER: Dict[str, Tuple[str, str]] = {
+    "override_scope": ("dplasma_tpu/serving", "_TUNE_LOCK"),
+    "push_overrides": ("dplasma_tpu/serving", "_TUNE_LOCK"),
+}
+
+#: declared lock-graph edges the one-level receiver walk cannot see
+#: (src lock, dst lock, why) — they participate in cycle detection
+EXTRA_EDGES: Sequence[Tuple[str, str, str]] = (
+    ("MetricsRegistry._lock", "Histogram._lock",
+     "MetricsRegistry.snapshot() reads each histogram's stats() "
+     "under the registry lock"),
+)
+
+#: method names whose call mutates the receiver container
+_MUTATORS = {"append", "appendleft", "extend", "insert", "add",
+             "remove", "discard", "pop", "popitem", "popleft",
+             "clear", "update", "setdefault", "move_to_end", "sort"}
+
+_SUPPRESS_RE = re.compile(r"#\s*threadcheck:\s*ok(?:=(\w+))?")
+
+Violation = Tuple[int, str, str]          # (line, code, message)
+
+
+def _suppressions(src: str) -> dict:
+    """line -> suppressed code ('' = all) from `# threadcheck: ok`
+    (jaxlint's scanner, with this linter's marker)."""
+    from dplasma_tpu.analysis.jaxlint import \
+        _suppressions as _jl_suppressions
+    return _jl_suppressions(src, pattern=_SUPPRESS_RE)
+
+
+def _self_attr(node) -> Optional[str]:
+    """'x' for a bare ``self.x`` attribute node, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _spawn_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local spellings of ``threading.Thread``/``Timer`` in one
+    module: ``import threading as th`` and ``from threading import
+    Thread/Timer [as X]`` both resolve to the canonical dotted name,
+    so T004 cannot be dodged by import style."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                if al.name == "threading" and al.asname:
+                    out[f"{al.asname}.Thread"] = "threading.Thread"
+                    out[f"{al.asname}.Timer"] = "threading.Timer"
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "threading":
+                for al in node.names:
+                    if al.name in ("Thread", "Timer"):
+                        out[al.asname or al.name] = \
+                            f"threading.{al.name}"
+    return out
+
+
+def _receiver_path(node) -> Optional[Tuple[str, str]]:
+    """For a call func node ``self.a.b.m`` return ('a.b', 'm');
+    ('', 'm') for a direct ``self.m``; None when the chain does not
+    root at ``self``."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    meth = node.attr
+    parts = []
+    cur = node.value
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name) and cur.id == "self":
+        return ".".join(reversed(parts)), meth
+    return None
+
+
+# ------------------------------------------------------- lock graph
+
+@dataclass(frozen=True)
+class LockEdge:
+    """One observed/declared acquisition order: ``src`` held while
+    ``dst`` is acquired, at ``site`` (file:line) via ``why``."""
+
+    src: str
+    dst: str
+    site: str
+    why: str
+
+
+class LockGraph:
+    """Accumulated lock-acquisition order graph + cycle finder."""
+
+    def __init__(self):
+        self.edges: List[LockEdge] = []
+        self._seen: Set[Tuple[str, str]] = set()
+
+    def add(self, src: str, dst: str, site: str, why: str) -> None:
+        if (src, dst) not in self._seen:
+            self._seen.add((src, dst))
+            self.edges.append(LockEdge(src, dst, site, why))
+
+    def locks(self) -> List[str]:
+        out = set()
+        for e in self.edges:
+            out.add(e.src)
+            out.add(e.dst)
+        return sorted(out)
+
+    def cycles(self, reentrant: Optional[Set[str]] = None
+               ) -> List[List[LockEdge]]:
+        """Every elementary cycle (deduplicated by canonical
+        rotation); self-edges on reentrant locks are legal nesting,
+        not deadlocks."""
+        reentrant = reentrant or set()
+        adj: Dict[str, List[LockEdge]] = {}
+        for e in self.edges:
+            if e.src == e.dst and e.src in reentrant:
+                continue
+            adj.setdefault(e.src, []).append(e)
+        found: Dict[tuple, List[LockEdge]] = {}
+
+        def dfs(node: str, path: List[LockEdge], on_path: List[str]):
+            for e in adj.get(node, ()):
+                if e.dst in on_path:
+                    i = on_path.index(e.dst)
+                    cyc = path[i:] + [e]
+                    nodes = tuple(x.src for x in cyc)
+                    k = min(range(len(nodes)), key=lambda j: nodes[j])
+                    canon = nodes[k:] + nodes[:k]
+                    if canon not in found:
+                        found[canon] = cyc
+                    continue
+                if len(path) < 16:
+                    dfs(e.dst, path + [e], on_path + [e.dst])
+
+        for start in list(adj):
+            dfs(start, [], [start])
+        return list(found.values())
+
+
+def _cycle_message(cyc: List[LockEdge]) -> str:
+    """Name the FULL cycle, every edge sited — the dagcheck
+    convention (a deadlock diagnostic that doesn't name the loop is a
+    hunt, not a finding)."""
+    chain = " -> ".join([e.src for e in cyc] + [cyc[0].src])
+    sites = "; ".join(f"{e.src} -> {e.dst} at {e.site} ({e.why})"
+                      for e in cyc)
+    if len(cyc) == 1 and cyc[0].src == cyc[0].dst:
+        return (f"self-deadlock on non-reentrant {cyc[0].src}: "
+                f"re-acquired while held at {cyc[0].site} "
+                f"({cyc[0].why})")
+    return f"lock-order cycle: {chain} [{sites}]"
+
+
+# ------------------------------------------------------ result object
+
+@dataclass(frozen=True)
+class ThreadDiagnostic:
+    """One verification failure: rule code, message, and the site."""
+
+    kind: str        # T001..T005
+    message: str
+    site: str = ""   # "file:line" ("" for package-level graph findings)
+
+    def as_dict(self) -> dict:
+        return {"kind": self.kind, "message": self.message,
+                "site": self.site}
+
+
+@dataclass
+class ThreadCheckResult:
+    """Outcome of :func:`check_package` (JSON-able via
+    :meth:`summary`)."""
+
+    ok: bool = True
+    files: int = 0
+    classes: int = 0          # registered classes actually seen
+    locks: List[str] = field(default_factory=list)
+    edges: int = 0
+    diagnostics: List[ThreadDiagnostic] = field(default_factory=list)
+
+    def add(self, kind: str, message: str, site: str = "") -> None:
+        self.ok = False
+        self.diagnostics.append(ThreadDiagnostic(kind, message, site))
+
+    @property
+    def counts(self) -> dict:
+        out: dict = {}
+        for d in self.diagnostics:
+            out[d.kind] = out.get(d.kind, 0) + 1
+        return out
+
+    def summary(self) -> dict:
+        return {"ok": self.ok, "files": self.files,
+                "classes": self.classes, "locks": list(self.locks),
+                "edges": self.edges, "counts": self.counts,
+                "diagnostics": [d.as_dict()
+                                for d in self.diagnostics]}
+
+    def format(self, name: str = "package") -> str:
+        head = (f"#+ threadcheck[{name}]: {self.files} file(s), "
+                f"{self.classes} guarded class(es), "
+                f"{len(self.locks)} lock(s), {self.edges} order "
+                f"edge(s): "
+                + ("OK" if self.ok else
+                   " ".join(f"{k}={v}" for k, v in
+                            sorted(self.counts.items()))))
+        lines = [head]
+        for d in self.diagnostics:
+            where = f" [{d.site}]" if d.site else ""
+            lines.append(f"#! threadcheck[{name}]: {d.kind} "
+                         f"{d.message}{where}")
+        return "\n".join(lines)
+
+
+class ThreadCheckError(ValueError):
+    """The scanned tree failed lock-discipline verification."""
+
+    def __init__(self, result: ThreadCheckResult):
+        self.result = result
+        lines = [f"{d.kind} {d.message}"
+                 for d in result.diagnostics[:8]]
+        more = len(result.diagnostics) - len(lines)
+        if more > 0:
+            lines.append(f"... and {more} more")
+        super().__init__("thread-discipline verification failed:\n  "
+                         + "\n  ".join(lines))
+
+
+# ------------------------------------------------------ the AST walk
+
+def _with_locks(m, guard: Optional[Guard]) -> Set[str]:
+    """Lock ids a method body acquires directly: its class lock
+    (``with self.<lock>``) and any module lock (``with <NAME>``)."""
+    out: Set[str] = set()
+    for sub in ast.walk(m):
+        if isinstance(sub, (ast.With, ast.AsyncWith)):
+            for item in sub.items:
+                if guard is not None and \
+                        _self_attr(item.context_expr) == guard.lock:
+                    out.add(guard.lock)        # placeholder, fixed up
+                dn = _dotted(item.context_expr)
+                if dn and dn.rsplit(".", 1)[-1] in MODULE_LOCKS:
+                    out.add(dn.rsplit(".", 1)[-1])
+    return out
+
+
+def _acquirers_of(classes: Dict[str, ast.ClassDef],
+                  guards: Mapping[str, Guard]
+                  ) -> Dict[str, Dict[str, Set[str]]]:
+    """class -> method -> lock ids the method (transitively, within
+    the class) acquires: the class's own lock AND any module lock —
+    so a call made under a held lock into a callee that takes
+    ``_TUNE_LOCK`` still lands its edge in the order graph.
+    ``under_lock`` helpers ASSUME the class lock — they are not
+    acquirers of it (calling one under the lock is legal nesting),
+    though module locks they take still count."""
+    out: Dict[str, Dict[str, Set[str]]] = {}
+    for cname, node in classes.items():
+        guard = guards.get(cname)
+        if guard is None:
+            continue
+        own = f"{cname}.{guard.lock}"
+        methods = {n.name: n for n in node.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        acq: Dict[str, Set[str]] = {}
+        for mname, m in methods.items():
+            locks = {own if l == guard.lock else l
+                     for l in _with_locks(m, guard)}
+            if mname in guard.under_lock:
+                locks.discard(own)
+            acq[mname] = locks
+        changed = True
+        while changed:          # one-class call-through fixpoint
+            changed = False
+            for mname, m in methods.items():
+                for sub in ast.walk(m):
+                    if isinstance(sub, ast.Call):
+                        rp = _receiver_path(sub.func)
+                        if rp is not None and rp[0] == "" \
+                                and rp[1] in acq:
+                            extra = acq[rp[1]] - acq[mname]
+                            if mname in guard.under_lock:
+                                extra = extra - {own}
+                            if extra:
+                                acq[mname] |= extra
+                                changed = True
+        out[cname] = {m: s for m, s in acq.items() if s}
+    return out
+
+
+class _Checker:
+    """Single-module pass: walks each function with the lexical
+    held-lock set, checking T001/T002/T004/T005 and collecting T003
+    lock-order edges into ``graph``."""
+
+    def __init__(self, rel: str, guards: Mapping[str, Guard],
+                 acquirers: Mapping[str, Dict[str, Set[str]]],
+                 graph: LockGraph,
+                 spawn_names: Optional[Dict[str, str]] = None):
+        self.rel = rel
+        self.guards = guards
+        self.acquirers = acquirers
+        self.graph = graph
+        self.spawn_names = spawn_names or {}
+        self.out: List[Violation] = []
+        self.cls: Optional[str] = None       # registered class name
+        self.qual: str = ""                  # Class.method / function
+
+    # ---------------------------------------------------- utilities
+    def _guard(self) -> Optional[Guard]:
+        return self.guards.get(self.cls) if self.cls else None
+
+    def _own_lock(self) -> Optional[str]:
+        g = self._guard()
+        return f"{self.cls}.{g.lock}" if g else None
+
+    def _site(self, lineno: int) -> str:
+        return f"{self.rel}:{lineno}"
+
+    def _lock_of_with_item(self, expr) -> Optional[str]:
+        """Lock id acquired by one with-item expr, if any."""
+        sa = _self_attr(expr)
+        g = self._guard()
+        if sa is not None and g is not None and sa == g.lock:
+            return self._own_lock()
+        dn = _dotted(expr)
+        if dn and dn.rsplit(".", 1)[-1] in MODULE_LOCKS:
+            return dn.rsplit(".", 1)[-1]
+        return None
+
+    def _acquire(self, lock: str, held: Tuple[str, ...],
+                 lineno: int, why: str) -> Tuple[str, ...]:
+        for h in held:
+            self.graph.add(h, lock, self._site(lineno), why)
+        if lock not in held:
+            held = held + (lock,)
+        return held
+
+    # ------------------------------------------------- access check
+    def _check_access(self, attr: str, write: bool,
+                      held: Tuple[str, ...], lineno: int) -> None:
+        g = self._guard()
+        if g is None:
+            return
+        if attr in g.lockfree:
+            return
+        mode = g.attrs.get(attr)
+        if mode is None:
+            return
+        if self._own_lock() in held:
+            return
+        if mode == "w" and not write:
+            return
+        what = "written" if write else "read"
+        self.out.append((lineno, "T001",
+                         f"guarded attribute {self.cls}.{attr} "
+                         f"{what} outside `with self.{g.lock}` in "
+                         f"{self.qual} (GUARDS: {attr} -> {g.lock})"))
+
+    # -------------------------------------------------- expressions
+    def _scan_target(self, node, held: Tuple[str, ...]) -> None:
+        """Assignment-target scan: the *container* being stored into
+        is a write access."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self._scan_target(elt, held)
+            return
+        if isinstance(node, ast.Starred):
+            self._scan_target(node.value, held)
+            return
+        sa = _self_attr(node)
+        if sa is not None:
+            self._check_access(sa, True, held, node.lineno)
+            return
+        if isinstance(node, ast.Subscript):
+            sa = _self_attr(node.value)
+            if sa is not None:
+                self._check_access(sa, True, held, node.lineno)
+            else:
+                self._scan(node.value, held)
+            self._scan(node.slice, held)
+            return
+        if isinstance(node, ast.Attribute):
+            self._scan(node.value, held)
+            return
+        # plain Name / anything else: nothing guarded
+        for child in ast.iter_child_nodes(node):
+            self._scan(child, held)
+
+    def _scan_call(self, node: ast.Call,
+                   held: Tuple[str, ...]) -> None:
+        dn = _dotted(node.func)
+        callee = dn.rsplit(".", 1)[-1] if dn else ""
+        # T004: unregistered thread spawn (any import spelling)
+        canon = dn if dn in ("threading.Thread", "threading.Timer") \
+            else self.spawn_names.get(dn)
+        if canon is not None:
+            if (self.rel, self.qual) not in THREAD_SITES:
+                self.out.append((node.lineno, "T004",
+                                 f"unregistered thread spawn site: "
+                                 f"{canon}(...) in {self.qual} — "
+                                 f"every spawned thread must be "
+                                 f"declared in threadcheck."
+                                 f"THREAD_SITES so the concurrency "
+                                 f"surface stays enumerable"))
+        # T005: publish-under-lock contracts
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "set" \
+                and isinstance(node.func.value, ast.Call):
+            inner = node.func.value
+            if isinstance(inner.func, ast.Attribute) \
+                    and inner.func.attr == "gauge" and inner.args \
+                    and isinstance(inner.args[0], ast.Constant):
+                gname = inner.args[0].value
+                need = PUBLISH_UNDER.get(gname)
+                if need is not None and need not in held:
+                    self.out.append((node.lineno, "T005",
+                                     f"gauge {gname!r} published "
+                                     f"outside {need} in {self.qual}"
+                                     f" — the contract publishes it "
+                                     f"in the critical section that "
+                                     f"computed it (a set after "
+                                     f"release can land out of "
+                                     f"order and stick a stale "
+                                     f"value in the exporter)"))
+        # T001 (module-guard contracts): override-stack discipline
+        cu = CALL_UNDER.get(callee)
+        if cu is not None and self.rel.startswith(cu[0]) \
+                and cu[1] not in held:
+            self.out.append((node.lineno, "T001",
+                             f"{callee}(...) called in {self.qual} "
+                             f"without holding {cu[1]}: the MCA "
+                             f"override stack is process-global and "
+                             f"strictly LIFO — concurrent scopes "
+                             f"interleave their pops into "
+                             f"RuntimeErrors and leaked overrides"))
+        # mutator call on a guarded container: a write access (the
+        # receiver is consumed here — re-scanning it would double-
+        # report the same access as a read)
+        receiver_done = False
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            sa = _self_attr(node.func.value)
+            if sa is not None:
+                self._check_access(sa, True, held, node.lineno)
+                receiver_done = True
+        # T003 edges: a call under a held lock into a registered
+        # class's acquiring method (receiver resolved via the
+        # declared typing hints; '' = a self-call). The callee's
+        # acquired set carries its class lock AND any module lock it
+        # takes, so a helper that grabs _TUNE_LOCK under a held class
+        # lock still lands its inversion edge.
+        rp = _receiver_path(node.func)
+        if rp is not None and held:
+            path, meth = rp
+            target = None
+            if path == "":
+                target = self.cls
+            else:
+                g = self._guard()
+                if g is not None:
+                    target = g.receivers.get(path)
+            if target is not None:
+                for tlock in sorted(
+                        self.acquirers.get(target, {}).get(meth, ())):
+                    for h in held:
+                        self.graph.add(
+                            h, tlock, self._site(node.lineno),
+                            f"call self."
+                            f"{path + '.' if path else ''}"
+                            f"{meth}() under {h}")
+        # recurse: func chain reads + arguments
+        if isinstance(node.func, ast.Attribute):
+            if not receiver_done:
+                self._scan(node.func.value, held)
+        else:
+            self._scan(node.func, held)
+        for a in node.args:
+            self._scan(a, held)
+        for kw in node.keywords:
+            self._scan(kw.value, held)
+
+    def _scan(self, node, held: Tuple[str, ...]) -> None:
+        """Read-position expression scan."""
+        if node is None:
+            return
+        if isinstance(node, ast.Call):
+            self._scan_call(node, held)
+            return
+        sa = _self_attr(node)
+        if sa is not None:
+            self._check_access(sa, False, held, node.lineno)
+            return
+        if isinstance(node, ast.Attribute):
+            self._scan(node.value, held)
+            return
+        if isinstance(node, ast.Lambda):
+            self._scan(node.body, ())     # deferred: runs lock-less
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan(child, held)
+
+    # --------------------------------------------------- statements
+    def _reads_guarded(self, expr) -> List[Tuple[str, int]]:
+        g = self._guard()
+        if g is None:
+            return []
+        out = []
+        for sub in ast.walk(expr):
+            sa = _self_attr(sub)
+            if sa is not None and sa in g.attrs \
+                    and sa not in g.lockfree:
+                out.append((sa, sub.lineno))
+        return out
+
+    def _writes_guarded(self, tree) -> bool:
+        g = self._guard()
+        if g is None:
+            return False
+        for sub in ast.walk(tree):
+            if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for t in targets:
+                    base = t.value if isinstance(t, ast.Subscript) \
+                        else t
+                    sa = _self_attr(base)
+                    if sa is not None and sa in g.attrs:
+                        return True
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in _MUTATORS and \
+                    _self_attr(sub.func.value) in (g.attrs or {}):
+                return True
+        return False
+
+    def _t002(self, node: ast.If, held: Tuple[str, ...]) -> None:
+        own = self._own_lock()
+        if own is None or own in held:
+            return
+        reads = self._reads_guarded(node.test)
+        if not reads:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                acquires = any(
+                    self._lock_of_with_item(i.context_expr) == own
+                    for i in sub.items)
+                if acquires and self._writes_guarded(sub):
+                    attr, ln = reads[0]
+                    self.out.append((
+                        node.lineno, "T002",
+                        f"check-then-act on {self.cls}.{attr} in "
+                        f"{self.qual}: the branch condition reads it "
+                        f"outside the lock (line {ln}) and the body "
+                        f"re-acquires `with self."
+                        f"{self._guard().lock}` to mutate guarded "
+                        f"state (line {sub.lineno}) — the state can "
+                        f"change between check and act; hold the "
+                        f"lock around both"))
+                    return
+
+    def _walk_body(self, stmts: Sequence[ast.stmt],
+                   held: Tuple[str, ...]) -> None:
+        for node in stmts:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in node.items:
+                    # the item expr evaluates with the PREVIOUS
+                    # items' locks already held (multi-item `with
+                    # LOCK, override_scope(..)` is the sanctioned
+                    # serving idiom)
+                    self._scan(item.context_expr, inner)
+                    lock = self._lock_of_with_item(item.context_expr)
+                    if lock is not None:
+                        inner = self._acquire(
+                            lock, inner, node.lineno,
+                            f"nested `with` in {self.qual}")
+                    if item.optional_vars is not None:
+                        self._scan_target(item.optional_vars, inner)
+                self._walk_body(node.body, inner)
+            elif isinstance(node, ast.If):
+                self._t002(node, held)
+                self._scan(node.test, held)
+                self._walk_body(node.body, held)
+                self._walk_body(node.orelse, held)
+            elif isinstance(node, ast.While):
+                self._scan(node.test, held)
+                self._walk_body(node.body, held)
+                self._walk_body(node.orelse, held)
+            elif isinstance(node, ast.For):
+                self._scan(node.iter, held)
+                self._scan_target(node.target, held)
+                self._walk_body(node.body, held)
+                self._walk_body(node.orelse, held)
+            elif isinstance(node, ast.Try):
+                self._walk_body(node.body, held)
+                for h in node.handlers:
+                    self._walk_body(h.body, held)
+                self._walk_body(node.orelse, held)
+                self._walk_body(node.finalbody, held)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                # a nested def is deferred work: it does NOT inherit
+                # the lexical lock (closures fired later run bare)
+                outer = self.qual
+                self.qual = f"{outer}.{node.name}"
+                self._walk_body(node.body, ())
+                self.qual = outer
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._scan_target(t, held)
+                self._scan(node.value, held)
+            elif isinstance(node, ast.AugAssign):
+                self._scan_target(node.target, held)
+                self._scan(node.value, held)
+            elif isinstance(node, ast.AnnAssign):
+                if node.value is not None:
+                    self._scan_target(node.target, held)
+                    self._scan(node.value, held)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    self._scan_target(t, held)
+            else:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, ast.expr):
+                        self._scan(child, held)
+
+    # ------------------------------------------------------- module
+    def check_function(self, node, cls: Optional[str]) -> None:
+        self.cls = cls if cls in self.guards else None
+        self.qual = f"{cls}.{node.name}" if cls else node.name
+        held: Tuple[str, ...] = ()
+        if self.cls is not None:
+            g = self.guards[self.cls]
+            if node.name in ("__init__", "__new__") \
+                    or node.name in g.under_lock:
+                # construction happens-before publication; declared
+                # helpers run with the lock already held
+                held = (self._own_lock(),)
+        self._walk_body(node.body, held)
+
+    def check_module(self, tree: ast.Module) -> int:
+        classes_seen = 0
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                if node.name in self.guards:
+                    classes_seen += 1
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.check_function(sub, node.name)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self.check_function(node, None)
+        return classes_seen
+
+
+# ------------------------------------------------------------ driving
+
+def check_source(src: str, rel: str,
+                 guards: Optional[Mapping[str, Guard]] = None,
+                 graph: Optional[LockGraph] = None,
+                 acquirers: Optional[
+                     Mapping[str, Dict[str, Set[str]]]] = None,
+                 tree: Optional[ast.Module] = None
+                 ) -> List[Violation]:
+    """Verify one module's source; ``rel`` is its repo-relative posix
+    path. With no shared ``graph``, lock-order cycles among this
+    module's own classes are reported inline (the fixture-test path);
+    package sweeps pass a shared graph, acquirer map, and pre-parsed
+    ``tree`` and detect cycles once."""
+    guards = GUARDS if guards is None else guards
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as exc:
+            return [(exc.lineno or 0, "T000",
+                     f"syntax error: {exc.msg}")]
+    local_classes = {n.name: n for n in tree.body
+                     if isinstance(n, ast.ClassDef)}
+    if acquirers is None:
+        acquirers = _acquirers_of(local_classes, guards)
+    own_graph = graph is None
+    graph = graph if graph is not None else LockGraph()
+    chk = _Checker(rel, guards, acquirers, graph,
+                   spawn_names=_spawn_aliases(tree))
+    chk.check_module(tree)
+    out = chk.out
+    if own_graph:
+        reent = {f"{c}.{g.lock}" for c, g in guards.items()
+                 if g.reentrant}
+        for cyc in graph.cycles(reentrant=reent):
+            out.append((0, "T003", _cycle_message(cyc)))
+    sup = _suppressions(src)
+    return [(ln, code, msg) for ln, code, msg in out
+            if sup.get(ln) is None or sup[ln] not in ("", code)]
+
+
+def _scan_paths(root: pathlib.Path) -> List[Tuple[pathlib.Path, str]]:
+    """(path, repo-relative posix) pairs of the scanned surface under
+    ``root`` (the repo checkout or the package directory)."""
+    base = root
+    if base.name == "dplasma_tpu":
+        base = base.parent
+    out = []
+    for d in SCAN_DIRS:
+        p = base / d
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                out.append((f, f.relative_to(base).as_posix()))
+    for fname in SCAN_FILES:
+        p = base / fname
+        if p.is_file():
+            out.append((p, fname))
+    return out
+
+
+def check_package(root=None,
+                  guards: Optional[Mapping[str, Guard]] = None
+                  ) -> ThreadCheckResult:
+    """Sweep the serving/telemetry concurrency surface: per-file
+    T001/T002/T004/T005 plus ONE package-wide lock-order graph
+    (acquirers resolved across files), cycles reported as T003."""
+    guards = GUARDS if guards is None else guards
+    root = pathlib.Path(root) if root is not None else \
+        pathlib.Path(__file__).resolve().parents[1]
+    paths = _scan_paths(root)
+    res = ThreadCheckResult()
+    # pass 1: the cross-file acquirer map (a method of a registered
+    # class acquiring its lock must be visible to CALLERS in other
+    # modules — service.py calls into cache.py/metrics.py)
+    all_classes: Dict[str, ast.ClassDef] = {}
+    trees: List[Tuple[str, str, ast.Module]] = []
+    for path, rel in paths:
+        src = path.read_text()
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as exc:
+            res.add("T000", f"syntax error: {exc.msg}",
+                    f"{rel}:{exc.lineno or 0}")
+            continue
+        trees.append((rel, src, tree))
+        for n in tree.body:
+            if isinstance(n, ast.ClassDef):
+                all_classes[n.name] = n
+    acquirers = _acquirers_of(all_classes, guards)
+    # pass 2: per-file checks into one shared lock graph
+    graph = LockGraph()
+    for src_rel, src, tree in trees:
+        for ln, code, msg in check_source(src, src_rel, guards=guards,
+                                          graph=graph,
+                                          acquirers=acquirers,
+                                          tree=tree):
+            res.add(code, msg, f"{src_rel}:{ln}")
+    for s, d, why in EXTRA_EDGES:
+        graph.add(s, d, "threadcheck.EXTRA_EDGES", why)
+    reent = {f"{c}.{g.lock}" for c, g in guards.items()
+             if g.reentrant}
+    for cyc in graph.cycles(reentrant=reent):
+        res.add("T003", _cycle_message(cyc))
+    res.files = len(trees)
+    res.classes = sum(1 for c in all_classes if c in guards)
+    res.locks = graph.locks()
+    res.edges = len(graph.edges)
+    return res
+
+
+def verify_package(root=None) -> ThreadCheckResult:
+    """:func:`check_package` that raises :class:`ThreadCheckError` on
+    any finding (the driver/test-facing strict entry)."""
+    res = check_package(root)
+    if not res.ok:
+        raise ThreadCheckError(res)
+    return res
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    res = check_package(args[0] if args else None)
+    sys.stdout.write(res.format() + "\n")
+    for d in res.diagnostics:
+        sys.stderr.write(f"{d.site or '<package>'}: {d.kind} "
+                         f"{d.message}\n")
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,28 +20,43 @@ def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
 
 
 class Counter:
-    """Monotone counter. ``inc`` by non-negative amounts only."""
+    """Monotone counter. ``inc`` by non-negative amounts only.
+
+    Locked: the serving layer incs from caller AND timer dispatch
+    threads, and ``value += amount`` is a read-modify-write — two
+    unlocked threads interleaving it lose increments (the racefuzz
+    ``counters`` probe pins the conservation invariant). Reading
+    ``value`` stays lock-free: a single float load is GIL-atomic
+    (threadcheck GUARDS mode ``"w"``).
+    """
 
     def __init__(self):
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
             raise ValueError("counters only go up")
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
 
 class Gauge:
-    """Point-in-time value; ``set`` wins, ``add`` adjusts."""
+    """Point-in-time value; ``set`` wins, ``add`` adjusts. Locked for
+    the same reason as :class:`Counter` (``add`` is a
+    read-modify-write); single reads of ``value`` stay lock-free."""
 
     def __init__(self):
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, value: float) -> None:
-        self.value = float(value)
+        with self._lock:
+            self.value = float(value)
 
     def add(self, amount: float) -> None:
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
 
 class Histogram:
@@ -268,7 +283,8 @@ class MetricsRegistry:
 
     def get(self, name: str, **labels) -> Optional[object]:
         """Lookup without creating; None when absent."""
-        return self._metrics.get((name, _label_key(labels)))
+        with self._lock:
+            return self._metrics.get((name, _label_key(labels)))
 
     def snapshot(self) -> List[dict]:
         """All instruments as JSON-able dicts, deterministically

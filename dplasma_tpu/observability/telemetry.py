@@ -246,6 +246,11 @@ class MetricsExporter:
         self.flushes = 0
         self._prev_counts: Dict[tuple, float] = {}
         self._prev_t: Optional[float] = None
+        # flush() runs on the daemon flusher AND on whatever thread
+        # calls start()/stop()/flush() directly (servebench, the lint
+        # gate): the rate memo is a check-then-act and the tmp-file
+        # write+rename is not idempotent, so flushes serialize
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -268,37 +273,45 @@ class MetricsExporter:
 
     def flush(self) -> None:
         """One atomic snapshot write (failures land on stderr — the
-        exporter must never take down the process it observes)."""
-        self._update_rates()
-        try:
-            tmp = self.path + ".tmp"
-            with open(tmp, "w") as f:
-                f.write(prometheus_text(self.registry))
-            os.replace(tmp, self.path)
-            self.flushes += 1
-        except OSError as exc:
-            sys.stderr.write(f"#! telemetry exporter: cannot write "
-                             f"{self.path}: {exc}\n")
+        exporter must never take down the process it observes).
+        Serialized: the daemon flusher and a direct caller racing
+        here would interleave the rate memo's check-then-act and
+        collide on the tmp file."""
+        with self._lock:
+            self._update_rates()
+            try:
+                tmp = self.path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(prometheus_text(self.registry))
+                os.replace(tmp, self.path)
+                self.flushes += 1
+            except OSError as exc:
+                sys.stderr.write(f"#! telemetry exporter: cannot "
+                                 f"write {self.path}: {exc}\n")
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             self.flush()
 
     def start(self) -> "MetricsExporter":
-        if self._thread is None:
-            self.flush()        # the file exists from second zero
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()  # restartable after stop()
             self._thread = threading.Thread(
                 target=self._loop, name="dplasma-telemetry-exporter",
                 daemon=True)
             self._thread.start()
+        self.flush()            # the file exists from second zero
         return self
 
     def stop(self) -> None:
         """Stop the flusher and write one final snapshot."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        with self._lock:        # never join under _lock: flush() takes it
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
         self.flush()
 
     def summary(self) -> dict:

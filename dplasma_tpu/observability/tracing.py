@@ -12,7 +12,12 @@ process keeps running. :class:`Tracer` is that layer:
   under the lock), span ids are allocated per-thread, and commits ride
   the GIL-atomic append of a bounded deque (MCA
   ``telemetry.max_spans``). The lock only guards thread-state
-  creation, the summary/clear paths, and explicit ``add()``;
+  creation, the summary/clear paths, and explicit ``add()``. The
+  split is declared, not folklore: ``_spans`` is registered
+  lock-free-by-design and ``_states`` lock-guarded in
+  :data:`dplasma_tpu.analysis.threadcheck.GUARDS`, and the racefuzz
+  ``tracer_ledger`` probe replays the mix (balanced ledger, drained
+  lanes) under seeded schedules;
 * **span trees** — ``with tracer.span("dispatch", ...)`` parents any
   span opened inside it on the same thread (ids are process-unique:
   the thread lane is folded into the id's high bits);

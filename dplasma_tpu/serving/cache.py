@@ -226,8 +226,6 @@ class ExecutableCache:
             *args) -> Entry:
         """The cached executable for ``key`` (LRU-refreshed), or
         compile ``build()`` against ``args`` and admit it."""
-        import jax
-        from dplasma_tpu.resilience import inject
         with self._lock:
             entry = self._d.get(key)
             if entry is not None:
@@ -236,18 +234,7 @@ class ExecutableCache:
                 self.metrics.counter("serving_cache_hits_total").inc()
                 return entry
             self.metrics.counter("serving_cache_misses_total").inc()
-            faults0 = len(inject.faults())
-            t0 = time.perf_counter()
-            lowered = jax.jit(build()).lower(*args)
-            compiled = lowered.compile()
-            dt = time.perf_counter() - t0
-            tainted = len(inject.faults()) > faults0
-            self.metrics.counter(
-                "serving_cache_compile_seconds").inc(dt)
-            entry = Entry(fn=compiled, key=key, compile_s=dt,
-                          tainted=tainted,
-                          hlocheck=self._audit(lowered, compiled,
-                                               key))
+            entry = self._compile(key, build, args)
             self._d[key] = entry
             while len(self._d) > self.capacity:
                 old_key, old = self._d.popitem(last=False)
@@ -260,6 +247,28 @@ class ExecutableCache:
             self.metrics.gauge("serving_cache_entries").set(
                 len(self._d))
             return entry
+
+    def _compile(self, key: CacheKey, build: Callable[[], Callable],
+                 args: Tuple) -> Entry:
+        """Compile one admission (called with ``_lock`` held — the
+        coarse serialize-compiles-under-the-cache-lock contract from
+        the class docstring). Split out so the racefuzz ``cache_lru``
+        probe can fuzz the LRU lock discipline with a stub Entry
+        instead of paying XLA per schedule op."""
+        import jax
+
+        from dplasma_tpu.resilience import inject
+        faults0 = len(inject.faults())
+        t0 = time.perf_counter()
+        lowered = jax.jit(build()).lower(*args)
+        compiled = lowered.compile()
+        dt = time.perf_counter() - t0
+        tainted = len(inject.faults()) > faults0
+        self.metrics.counter(
+            "serving_cache_compile_seconds").inc(dt)
+        return Entry(fn=compiled, key=key, compile_s=dt,
+                     tainted=tainted,
+                     hlocheck=self._audit(lowered, compiled, key))
 
     def _audit(self, lowered, compiled, key: CacheKey
                ) -> Optional[dict]:

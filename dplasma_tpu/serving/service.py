@@ -251,19 +251,24 @@ class SolverService:
         n, nrhs = a.shape[0], bb.shape[1]
         extra = tuple(sorted(kwargs.items()))
         memo = (op, n, nrhs, a.dtype.str, extra)
-        key = self._keys.get(memo)
-        if key is None:
-            key = cache_mod.make_key(op, n, a.dtype, 1, nrhs,
-                                     extra=extra)
-            self._keys[memo] = key
-        group = key._replace(batch=0)    # batch bucket set at dispatch
-        fut = SolveFuture(self, group)
-        req = _Request(op=op, a=a, b=bb, vec=vec, n=n, nrhs=nrhs,
-                       future=fut, t_submit=time.perf_counter(),
-                       kwargs=dict(kwargs),
-                       t_submit_ns=time.time_ns())
         dispatch_now = None
+        # one critical section per submit: the key memo (the
+        # _tuning_for discipline — two threads racing the same new
+        # shape must memoize exactly one key), the queue mutation,
+        # and the gauge publish are all cheap host work, cheap
+        # enough to hold the lock across
         with self._lock:
+            key = self._keys.get(memo)
+            if key is None:
+                key = cache_mod.make_key(op, n, a.dtype, 1, nrhs,
+                                         extra=extra)
+                self._keys[memo] = key
+            group = key._replace(batch=0)  # batch bucket set at dispatch
+            fut = SolveFuture(self, group)
+            req = _Request(op=op, a=a, b=bb, vec=vec, n=n, nrhs=nrhs,
+                           future=fut, t_submit=time.perf_counter(),
+                           kwargs=dict(kwargs),
+                           t_submit_ns=time.time_ns())
             self._requests += 1
             self._next_rid += 1
             req.rid = fut.request_id = self._next_rid

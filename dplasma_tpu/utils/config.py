@@ -168,6 +168,16 @@ def mca_get_float(name: str, default: float) -> float:
 # value. The stack below makes LIFO restoration structural — each
 # frame records the prior state of exactly the keys it touched, and
 # popping out of order is an error, not a silent corruption.
+#
+# Thread contract: the stack itself is lock-free — it is trace-time
+# host code, single-threaded in every driver path. The ONE caller
+# that reaches it from concurrent threads is the serving layer's
+# dispatch (caller + timer), which must serialize the whole push..pop
+# under its _TUNE_LOCK (the r11-i race class: two interleaved scopes
+# pop each other into RuntimeErrors). analysis.threadcheck enforces
+# that call-site contract statically (CALL_UNDER) and
+# analysis.racefuzz replays it (the override_stack probe's LIFO
+# integrity invariant).
 
 _UNSET = object()          # "key had no override before this frame"
 _OVERRIDE_STACK: list = []  # [_OverrideFrame, ...] — top is last

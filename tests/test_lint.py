@@ -4,18 +4,20 @@
   ``except Exception: pass`` are rejected across ``dplasma_tpu/``;
 * tools/lint_all.py — the aggregate runner (lint_excepts + the
   analysis.jaxlint trace-safety rules + the perfdiff smoke + the
-  analysis.palcheck pallas-contract gate + a dagcheck smoke pass over
-  tiny DAGs of all four ops + the analysis.spmdcheck collective-
-  schedule smoke over the cyclic kernels + the analysis.hlocheck
-  compiled-artifact smoke over the cyclic kernels' post-GSPMD HLO
-  and one serving executable + the ring-smoke pass over the explicit
-  ICI-ring kernels' RingOp schedules and the ring.enable=off
-  bit-identity + the dplasma_tpu.tuning sweep → DB →
-  driver --autotune consultation smoke + the telemetry smoke: a
-  traced serving burst must leave a balanced span ledger, a
-  Prometheus-parseable exporter snapshot, and a flight-recorder ring
-  that round-trips through the v13 run-report) must exit 0 on the
-  repo.
+  analysis.threadcheck lock-discipline gate over the serving/
+  telemetry concurrency surface with its racefuzz fixed-seed
+  schedule smoke + the analysis.palcheck pallas-contract gate + a
+  dagcheck smoke pass over tiny DAGs of all four ops + the
+  analysis.spmdcheck collective-schedule smoke over the cyclic
+  kernels + the analysis.hlocheck compiled-artifact smoke over the
+  cyclic kernels' post-GSPMD HLO and one serving executable + the
+  ring-smoke pass over the explicit ICI-ring kernels' RingOp
+  schedules and the ring.enable=off bit-identity + the
+  dplasma_tpu.tuning sweep → DB → driver --autotune consultation
+  smoke + the telemetry smoke: a traced serving burst must leave a
+  balanced span ledger, a Prometheus-parseable exporter snapshot,
+  and a flight-recorder ring that round-trips through the v13
+  run-report) must exit 0 on the repo.
 """
 import pathlib
 import sys
@@ -88,7 +90,7 @@ def test_lint_all_aggregate_is_clean(capsys):
     out = capsys.readouterr()
     assert rc == 0, out.err
     for gate in ("lint_excepts", "jaxlint", "perfdiff-smoke",
-                 "palcheck", "dagcheck-smoke", "spmdcheck-smoke",
-                 "serving-smoke", "hlocheck-smoke", "ring-smoke",
-                 "tune-smoke", "telemetry-smoke"):
+                 "threadcheck", "palcheck", "dagcheck-smoke",
+                 "spmdcheck-smoke", "serving-smoke", "hlocheck-smoke",
+                 "ring-smoke", "tune-smoke", "telemetry-smoke"):
         assert f"# {gate}: OK" in out.out

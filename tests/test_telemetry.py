@@ -348,6 +348,51 @@ def test_metrics_exporter_background_thread(tmp_path):
     assert ex.flushes == flushes
 
 
+def test_metrics_exporter_restart_after_stop(tmp_path):
+    """start() after stop() must spawn a LIVE periodic flusher — a
+    stale _stop event would make the restarted loop exit instantly
+    and the export file silently freeze."""
+    import threading
+    import time
+    reg = MetricsRegistry()
+    reg.gauge("g").set(1.0)
+    ex = tel.MetricsExporter(reg, str(tmp_path / "r.prom"),
+                             interval_s=0.02)
+    ex.start()
+    time.sleep(0.06)
+    ex.stop()
+    flushes = ex.flushes
+    ex.start()
+    time.sleep(0.12)
+    assert any(t.name == "dplasma-telemetry-exporter"
+               for t in threading.enumerate())
+    assert ex.flushes > flushes + 1     # periodic flushes resumed
+    ex.stop()
+
+
+def test_metrics_exporter_concurrent_start_single_flusher(tmp_path):
+    """racing start()s memoize exactly one daemon (the _thread guard):
+    a second flusher would rewrite the export file forever after
+    stop() joins the first."""
+    import threading
+    import time
+    reg = MetricsRegistry()
+    ex = tel.MetricsExporter(reg, str(tmp_path / "c.prom"),
+                             interval_s=0.02)
+    ths = [threading.Thread(target=ex.start) for _ in range(8)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    live = [t for t in threading.enumerate()
+            if t.name == "dplasma-telemetry-exporter"]
+    assert len(live) == 1
+    ex.stop()
+    time.sleep(0.08)
+    assert not any(t.name == "dplasma-telemetry-exporter"
+                   for t in threading.enumerate())
+
+
 # ----------------------------------------------------- flight recorder
 
 def test_flight_recorder_ring_and_dump(tmp_path):

@@ -1,0 +1,567 @@
+"""Thread-discipline verification (analysis.threadcheck +
+analysis.racefuzz): the package verifies clean, every T001-T005 rule
+fires on a minimal fixture with its named diagnostic, suppression
+comments are honored, the lock-order-cycle diagnostic names the FULL
+cycle, racefuzz schedules are seed-deterministic, and every
+historical race class (r8-vii cache LRU, r14-i histogram spill,
+r11-i override-stack interleave, r14-vii stale gauge publish, plus
+the counter-conservation fix this PR landed) is reproduced by a
+seeded schedule that fails when its fix is reverted."""
+import contextlib
+import pathlib
+import sys
+import textwrap
+import time
+
+import pytest
+
+from dplasma_tpu.analysis import racefuzz, threadcheck
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def _codes(src, rel="dplasma_tpu/serving/x.py", guards=None):
+    return [c for _, c, _ in threadcheck.check_source(
+        textwrap.dedent(src), rel, guards=guards)]
+
+
+def _msgs(src, rel="dplasma_tpu/serving/x.py", guards=None):
+    return threadcheck.check_source(textwrap.dedent(src), rel,
+                                    guards=guards)
+
+
+# ------------------------------------------------- the golden sweep
+
+def test_package_verifies_clean():
+    """The serving/telemetry surface carries zero unsuppressed
+    violations — the tree's lock discipline IS the declared
+    discipline."""
+    res = threadcheck.check_package()
+    assert res.ok, res.format("package")
+    # the sweep actually covered the surface (not a vacuous pass)
+    assert res.files >= 10
+    assert res.classes >= 8
+    assert res.edges >= 4
+    assert "SolverService._lock" in res.locks
+    assert "_TUNE_LOCK" in res.locks
+
+
+def test_result_summary_is_jsonable():
+    import json
+    res = threadcheck.check_package()
+    doc = res.summary()
+    assert json.loads(json.dumps(doc)) == doc
+    assert doc["ok"] is True and doc["counts"] == {}
+
+
+# ------------------------------------------------------ rule fixtures
+
+def test_t001_guarded_read_outside_lock():
+    src = """\
+        class SolverService:
+            def peek(self):
+                return len(self._pending)
+    """
+    found = _msgs(src, "dplasma_tpu/serving/service.py")
+    assert [c for _, c, _ in found] == ["T001"]
+    assert "SolverService._pending" in found[0][2]
+    assert "with self._lock" in found[0][2]
+    # the same body under the lock is clean
+    assert _codes("""\
+        class SolverService:
+            def peek(self):
+                with self._lock:
+                    return len(self._pending)
+    """, "dplasma_tpu/serving/service.py") == []
+
+
+def test_t001_write_and_mutator():
+    # direct write
+    assert _codes("""\
+        class SolverService:
+            def bump(self):
+                self._requests += 1
+    """, "dplasma_tpu/serving/service.py") == ["T001"]
+    # mutating method call on a guarded container
+    assert _codes("""\
+        class SolverService:
+            def push(self, lat):
+                self._latencies.append(lat)
+    """, "dplasma_tpu/serving/service.py") == ["T001"]
+    # subscript store
+    assert _codes("""\
+        class SolverService:
+            def memo(self, k, v):
+                self._keys[k] = v
+    """, "dplasma_tpu/serving/service.py") == ["T001"]
+
+
+def test_t001_write_only_mode():
+    """Counter.value is mode "w": a single read is GIL-atomic and
+    lock-free; the read-modify-write is not."""
+    assert _codes("""\
+        class Counter:
+            def read(self):
+                return self.value
+    """, "dplasma_tpu/observability/metrics.py") == []
+    assert _codes("""\
+        class Counter:
+            def inc(self, amount=1.0):
+                self.value += amount
+    """, "dplasma_tpu/observability/metrics.py") == ["T001"]
+
+
+def test_t001_init_and_under_lock_helpers_exempt():
+    # construction happens-before publication
+    assert _codes("""\
+        class SolverService:
+            def __init__(self):
+                self._pending = {}
+                self._requests = 0
+    """, "dplasma_tpu/serving/service.py") == []
+    # declared under-lock helper bodies assume the lock
+    assert _codes("""\
+        class SolverService:
+            def _cancel_timer(self, group):
+                self._timers.pop(group, None)
+    """, "dplasma_tpu/serving/service.py") == []
+
+
+def test_t001_nested_def_does_not_inherit_lock():
+    """A closure defined under the lock runs later, bare."""
+    found = _msgs("""\
+        class SolverService:
+            def arm(self):
+                with self._lock:
+                    def later():
+                        self._pending.clear()
+                    return later
+    """, "dplasma_tpu/serving/service.py")
+    assert [c for _, c, _ in found] == ["T001"]
+
+
+def test_t001_override_scope_needs_tune_lock():
+    src = """\
+        from dplasma_tpu.utils import config as _cfg
+        def dispatch():
+            with _cfg.override_scope({"nb": 8}):
+                pass
+    """
+    found = _msgs(src, "dplasma_tpu/serving/service.py")
+    assert [c for _, c, _ in found] == ["T001"]
+    assert "_TUNE_LOCK" in found[0][2] and "LIFO" in found[0][2]
+    # the sanctioned multi-item idiom: lock first, scope second
+    assert _codes("""\
+        from dplasma_tpu.utils import config as _cfg
+        def dispatch():
+            with _TUNE_LOCK, _cfg.override_scope({"nb": 8}):
+                pass
+    """, "dplasma_tpu/serving/service.py") == []
+    # outside serving/ the contract does not apply (trace-time code)
+    assert _codes(src, "dplasma_tpu/tuning/search.py") == []
+
+
+def test_t002_check_then_act():
+    found = _msgs("""\
+        class Histogram:
+            def observe(self, v):
+                if self._exact is not None:
+                    with self._lock:
+                        self._exact.append(v)
+    """, "dplasma_tpu/observability/metrics.py")
+    codes = [c for _, c, _ in found]
+    assert "T002" in codes
+    msg = next(m for _, c, m in found if c == "T002")
+    assert "check-then-act" in msg and "Histogram._exact" in msg
+    # holding the lock around check AND act is the fix
+    assert _codes("""\
+        class Histogram:
+            def observe(self, v):
+                with self._lock:
+                    if self._exact is not None:
+                        self._exact.append(v)
+    """, "dplasma_tpu/observability/metrics.py") == []
+
+
+def test_t003_cycle_names_full_cycle():
+    guards = {
+        "A": threadcheck.Guard(lock="_lock", receivers={"b": "B"}),
+        "B": threadcheck.Guard(lock="_lock", receivers={"a": "A"}),
+    }
+    found = _msgs("""\
+        class A:
+            def m(self):
+                with self._lock:
+                    self.b.m()
+        class B:
+            def m(self):
+                with self._lock:
+                    self.a.m()
+    """, guards=guards)
+    assert [c for _, c, _ in found] == ["T003"]
+    msg = found[0][2]
+    # the FULL cycle, every edge sited (the dagcheck convention)
+    assert "A._lock -> B._lock -> A._lock" in msg
+    assert "dplasma_tpu/serving/x.py:4" in msg
+    assert "dplasma_tpu/serving/x.py:8" in msg
+
+
+def test_t003_self_deadlock_on_plain_lock():
+    guards = {"A": threadcheck.Guard(lock="_lock", reentrant=False)}
+    found = _msgs("""\
+        class A:
+            def outer(self):
+                with self._lock:
+                    self.inner()
+            def inner(self):
+                with self._lock:
+                    pass
+    """, guards=guards)
+    assert [c for _, c, _ in found] == ["T003"]
+    assert "self-deadlock" in found[0][2]
+    # the same nesting on a declared RLock is legal
+    guards_r = {"A": threadcheck.Guard(lock="_lock", reentrant=True)}
+    assert _codes("""\
+        class A:
+            def outer(self):
+                with self._lock:
+                    self.inner()
+            def inner(self):
+                with self._lock:
+                    pass
+    """, guards=guards_r) == []
+
+
+def test_t003_module_lock_edge_through_callee():
+    """A helper that takes _TUNE_LOCK called under a held class lock
+    must land its inversion edge — the r11-i-family AB/BA deadlock
+    shape is caught even when the module lock hides in a callee."""
+    guards = {"A": threadcheck.Guard(lock="_lock")}
+    found = _msgs("""\
+        class A:
+            def outer(self):
+                with self._lock:
+                    self._helper()
+            def _helper(self):
+                with _TUNE_LOCK:
+                    pass
+            def inverse(self):
+                with _TUNE_LOCK:
+                    self.locked()
+            def locked(self):
+                with self._lock:
+                    pass
+    """, guards=guards)
+    assert [c for _, c, _ in found] == ["T003"]
+    msg = found[0][2]
+    assert "A._lock" in msg and "_TUNE_LOCK" in msg
+    assert "cycle" in msg
+
+
+def test_t004_unregistered_thread_spawn():
+    src = """\
+        import threading
+        class Scheduler:
+            def arm(self):
+                t = threading.Timer(0.01, self.arm)
+                t.start()
+    """
+    found = _msgs(src, "dplasma_tpu/serving/extra.py")
+    assert [c for _, c, _ in found] == ["T004"]
+    assert "THREAD_SITES" in found[0][2]
+    # the registered batch-window timer site stays legal
+    assert _codes("""\
+        import threading
+        class SolverService:
+            def submit(self):
+                t = threading.Timer(0.01, self.submit)
+                t.start()
+    """, "dplasma_tpu/serving/service.py") == []
+    # import style does not dodge the rule: bare and aliased
+    # spellings resolve to the canonical threading name
+    assert _codes("""\
+        from threading import Timer
+        def arm(cb):
+            return Timer(0.01, cb)
+    """, "dplasma_tpu/serving/extra.py") == ["T004"]
+    assert _codes("""\
+        import threading as th
+        def arm(cb):
+            return th.Thread(target=cb)
+    """, "dplasma_tpu/serving/extra.py") == ["T004"]
+
+
+def test_t005_publish_outside_lock():
+    found = _msgs("""\
+        class SolverService:
+            def leak(self, depth):
+                self.metrics.gauge("serving_queue_depth").set(depth)
+    """, "dplasma_tpu/serving/service.py")
+    assert [c for _, c, _ in found] == ["T005"]
+    assert "serving_queue_depth" in found[0][2]
+    assert "SolverService._lock" in found[0][2]
+    assert _codes("""\
+        class SolverService:
+            def ok(self, depth):
+                with self._lock:
+                    self.metrics.gauge("serving_queue_depth").set(
+                        depth)
+    """, "dplasma_tpu/serving/service.py") == []
+    # unregistered gauges publish freely
+    assert _codes("""\
+        class SolverService:
+            def free(self, v):
+                self.metrics.gauge("some_other_gauge").set(v)
+    """, "dplasma_tpu/serving/service.py") == []
+
+
+def test_suppression_comment():
+    base = """\
+        class Counter:
+            def inc(self, amount=1.0):
+                self.value += amount{tail}
+    """
+    rel = "dplasma_tpu/observability/metrics.py"
+    assert _codes(base.format(tail=""), rel) == ["T001"]
+    assert _codes(base.format(
+        tail="   # threadcheck: ok"), rel) == []
+    assert _codes(base.format(
+        tail="   # threadcheck: ok=T001"), rel) == []
+    # a foreign code does not suppress
+    assert _codes(base.format(
+        tail="   # threadcheck: ok=T002"), rel) == ["T001"]
+
+
+def test_cli_exit_codes(capsys):
+    assert threadcheck.main([str(REPO)]) == 0
+    out = capsys.readouterr()
+    assert "threadcheck[package]" in out.out and "OK" in out.out
+
+
+def test_verify_package_raises_on_violation(tmp_path):
+    """verify_package raises the dagcheck-style typed error on a tree
+    with a violation (a mutated copy of the real surface layout)."""
+    pkg = tmp_path / "dplasma_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(textwrap.dedent("""\
+        class SolverService:
+            def bump(self):
+                self._requests += 1
+    """))
+    res = threadcheck.check_package(tmp_path)
+    assert not res.ok and res.counts == {"T001": 1}
+    with pytest.raises(threadcheck.ThreadCheckError) as ei:
+        threadcheck.verify_package(tmp_path)
+    assert "T001" in str(ei.value)
+
+
+# --------------------------------------------------------- racefuzz
+
+def test_racefuzz_seed_determinism():
+    """Same seed -> same schedule -> same verdict (the replayability
+    contract); a different seed draws a different schedule."""
+    a = racefuzz.run_probe("cache_lru", seed=7, nthreads=3, nops=40)
+    b = racefuzz.run_probe("cache_lru", seed=7, nthreads=3, nops=40)
+    assert a.schedule == b.schedule
+    assert a.ok == b.ok is True
+    c = racefuzz.run_probe("cache_lru", seed=8, nthreads=3, nops=40)
+    assert c.schedule != a.schedule
+
+
+def test_racefuzz_smoke_clean_on_fixed_seeds():
+    res = racefuzz.fuzz(seeds=(0, 1), nthreads=3, nops=50)
+    assert res["invariant_failures"] == 0, res["probes"]
+    assert res["schedules_run"] == 2 * len(racefuzz.PROBES)
+
+
+def test_racefuzz_unknown_probe():
+    with pytest.raises(KeyError):
+        racefuzz.run_probe("no_such_probe", seed=0)
+
+
+def test_racefuzz_summary_doc_feeds_perfdiff():
+    """The {"racefuzz": ...} doc gates through perfdiff: a shrinking
+    schedule surface and growing invariant failures are regressions;
+    a self-compare is clean (satellite: a silently-shrinking fuzz
+    surface gates like a perf regression)."""
+    import perfdiff
+    res = racefuzz.fuzz(seeds=(0,), probes=("counters",), nthreads=2,
+                        nops=20)
+    base = racefuzz.summary_doc(res)
+    m = perfdiff.extract_metrics(base)
+    assert m["racefuzz.schedules_run"]["better"] == "higher"
+    assert m["racefuzz.invariant_failures"]["better"] == "lower"
+    assert perfdiff.compare(base, base)["ok"]
+    shrunk = {"racefuzz": dict(base["racefuzz"],
+                               schedules_run=0.5 *
+                               base["racefuzz"]["schedules_run"])}
+    res2 = perfdiff.compare(base, shrunk)
+    assert not res2["ok"]
+    assert res2["worst"]["metric"] == "racefuzz.schedules_run"
+    broken = {"racefuzz": dict(base["racefuzz"],
+                               invariant_failures=3)}
+    res3 = perfdiff.compare(base, broken)
+    assert not res3["ok"]
+    assert res3["worst"]["metric"] == "racefuzz.invariant_failures"
+
+
+def test_racefuzz_cli_report_round_trips(tmp_path, capsys):
+    import json
+    rp = tmp_path / "racefuzz.json"
+    rc = racefuzz.main(["--seeds", "0", "--probe", "flight_ring",
+                        "--nthreads", "2", "--nops", "20",
+                        "--report", str(rp)])
+    assert rc == 0
+    doc = json.loads(rp.read_text())
+    assert doc["racefuzz"]["schedules_run"] == 1
+    assert doc["racefuzz"]["invariant_failures"] == 0
+    assert "flight_ring" in capsys.readouterr().out
+
+
+# ------------------------ historical race classes, fixes reverted
+
+def _unsafe_cache():
+    """r8-vii reverted: the LRU hit path's check -> move_to_end runs
+    unlocked (with the historical window held open) while eviction
+    and invalidation mutate the OrderedDict."""
+    base = racefuzz.make_stub_cache(2)
+    cls = type(base)
+
+    class _Unsafe(cls):
+        def get(self, key, build, *args):
+            entry = self._d.get(key)
+            if entry is not None:
+                time.sleep(1e-4)            # the check-act window
+                self._d.move_to_end(key)    # races eviction: KeyError
+                self.metrics.counter(
+                    "serving_cache_hits_total").inc()
+                return entry
+            self.metrics.counter("serving_cache_misses_total").inc()
+            entry = self._compile(key, build, args)
+            self._d[key] = entry
+            while len(self._d) > self.capacity:
+                time.sleep(1e-4)
+                self._d.popitem(last=False)
+                self.metrics.counter(
+                    "serving_cache_evictions_total").inc()
+            return entry
+
+        def invalidate(self, key):
+            gone = self._d.pop(key, None) is not None
+            if gone:
+                self.metrics.counter(
+                    "serving_cache_invalidations_total").inc()
+            return gone
+
+    return _Unsafe(2)
+
+
+def test_regression_r8vii_cache_lru_race():
+    r = racefuzz.run_probe("cache_lru", seed=0, nops=200,
+                           factory=_unsafe_cache)
+    assert not r.ok, "reverting the cache lock must break the probe"
+    assert any("KeyError" in f or "conservation" in f
+               for f in r.failures), r.failures
+
+
+def _unsafe_histogram():
+    """r14-i reverted: the exact->bucket spill check-then-act runs
+    unlocked; a racing observe appends into a list another thread is
+    nulling (and the accumulators tear)."""
+    from dplasma_tpu.observability.metrics import Histogram
+
+    class _Unsafe(Histogram):
+        def observe(self, v):
+            v = float(v)
+            idx = self._bucket_of(v)
+            self._count += 1
+            self._sum += v
+            self._buckets[idx] = self._buckets.get(idx, 0) + 1
+            if self._exact is not None:
+                time.sleep(1e-5)            # the historical window
+                self._exact.append(v)
+                if len(self._exact) > self._cap:
+                    self._exact = None
+
+    return _Unsafe(exact_cap=8)
+
+
+def test_regression_r14i_histogram_spill_race():
+    r = racefuzz.run_probe("histogram_spill", seed=0, nops=250,
+                           factory=_unsafe_histogram)
+    assert not r.ok, "reverting the histogram lock must break the " \
+                     "spill invariant"
+
+
+def _unsafe_counter():
+    """The Counter fix this PR landed, reverted: value += amount as
+    an unlocked read-modify-write (window held open)."""
+    from dplasma_tpu.observability.metrics import Counter
+
+    class _Unsafe(Counter):
+        def inc(self, amount=1.0):
+            v = self.value
+            time.sleep(1e-5)
+            self.value = v + amount
+
+    return _Unsafe()
+
+
+def test_regression_counter_lost_increments():
+    r = racefuzz.run_probe("counters", seed=0, nops=200,
+                           factory=_unsafe_counter)
+    assert not r.ok
+    assert any("lost increments" in f for f in r.failures), r.failures
+
+
+def test_regression_r11i_override_stack_interleave():
+    """r11-i reverted: no serialization of the scoped MCA override
+    pushes -> interleaved pops break the LIFO stack."""
+    r = racefuzz.run_probe("override_stack", seed=0, nops=120,
+                           factory=contextlib.nullcontext)
+    assert not r.ok
+    assert any("LIFO" in f or "leaked" in f or "restored" in f
+               for f in r.failures), r.failures
+    # the harness scrubbed its own wreckage: the process-global
+    # override state is clean for whoever runs next
+    from dplasma_tpu.utils import config as _cfg
+    assert _cfg.override_depth() == 0
+    assert "racefuzz.knob" not in _cfg._MCA_OVERRIDES
+
+
+def _broken_publisher(gauge):
+    """r14-vii reverted: the gauge publishes AFTER the lock releases
+    (with the historical window), so it lags the state it mirrors."""
+
+    class _Broken(racefuzz.GaugePublisher):
+        def adjust(self, d):
+            with self.lock:
+                self.depth += d
+                snap = self.depth
+            time.sleep(1e-5)                # the historical window
+            self.gauge.set(snap)
+            with self.lock:
+                if self.gauge.value != self.depth:
+                    self.anomalies += 1
+
+    return _Broken(gauge)
+
+
+def test_regression_r14vii_stale_gauge_publish():
+    r = racefuzz.run_probe("gauge_publish", seed=0, nops=250,
+                           factory=_broken_publisher)
+    assert not r.ok
+    assert any("stale publish" in f or "disagrees" in f
+               for f in r.failures), r.failures
+
+
+# --------------------------------------------------- the wide sweep
+
+@pytest.mark.slow
+def test_racefuzz_wide_sweep():
+    """The exhaustive schedule sweep (tier-1 keeps the fixed-seed
+    smoke; this widens seeds, threads, and ops)."""
+    res = racefuzz.fuzz(seeds=range(12), nthreads=6, nops=300)
+    assert res["invariant_failures"] == 0, res["probes"]
+    assert res["schedules_run"] == 12 * len(racefuzz.PROBES)

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Eleven passes, in increasing cost order:
+Twelve passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
@@ -12,44 +12,56 @@ Eleven passes, in increasing cost order:
    exit 0 and a synthetically regressed report must exit nonzero with
    the offending metric named (the CI regression gate must itself be
    gated);
-4. ``dplasma_tpu.analysis.palcheck`` — every ``pl.pallas_call``
+4. ``dplasma_tpu.analysis.threadcheck`` — the lock-discipline
+   verifier over the serving/telemetry concurrency surface (T001
+   guarded access outside the owning lock per the GUARDS registry,
+   T002 check-then-act, T003 lock-order cycles with the full cycle
+   named, T004 unregistered thread spawns, T005 publish-outside-lock
+   gauge contracts) must verify the package clean, and the
+   ``analysis.racefuzz`` schedule-fuzz smoke (fixed seeds,
+   caller/timer/exporter thread mix against the cache/histogram/
+   counter/override-stack/tracer/flight-ring/gauge invariant probes)
+   must run its full surface with zero invariant failures — the
+   ``schedules_run``/``invariant_failures`` counters are printed so
+   perfdiff can gate a silently shrinking fuzz surface;
+5. ``dplasma_tpu.analysis.palcheck`` — every ``pl.pallas_call``
    contract in the package: BlockSpec divisibility and tiling, index
    maps covering the grid, the VMEM budget, the precision contract;
-5. a ``dplasma_tpu.analysis.dagcheck`` smoke pass — the analytic tile
+6. a ``dplasma_tpu.analysis.dagcheck`` smoke pass — the analytic tile
    DAGs of all four ops (potrf/lu/qr/gemm) at 3x3 tiles on 1x1 and
    2x2 grids, plus the IR solvers' factor+solve+refine DAGs
    (posv_ir/gesv_ir, ops.refine.dag), must verify clean, with the
    comm-model reconciliation exact for the owner-computes classes;
-6. a ``dplasma_tpu.analysis.spmdcheck`` smoke pass — the cyclic
+7. a ``dplasma_tpu.analysis.spmdcheck`` smoke pass — the cyclic
    shard_map kernels (potrf/getrf/geqrf/gemm) traced on tiny shapes
    over 1x1/2x2/1x4 grids must verify clean with the collective
    counts EXACTLY reconciling the analytic comm model, and the
    canonical ring schedule must drain deadlock-free in the abstract
    simulator;
-7. a ``dplasma_tpu.serving`` smoke pass — tiny batched posv/gesv
+8. a ``dplasma_tpu.serving`` smoke pass — tiny batched posv/gesv
    round-trips within the backward-error gate, cache-key determinism,
    and padded-vs-exact solution equivalence on CPU;
-8. a ``dplasma_tpu.analysis.hlocheck`` smoke pass — the COMPILED
+9. a ``dplasma_tpu.analysis.hlocheck`` smoke pass — the COMPILED
    post-GSPMD HLO of the cyclic potrf/getrf/geqrf/gemm kernels on
    the 2x2 CPU mesh must audit clean with the per-kind collective
    counts EXACTLY matching the jaxpr-level schedule (a
    GSPMD-inserted hidden collective fails here before it ever ships
    to hardware), and one serving batched executable must audit clean
    (donation/precision/anti-patterns);
-9. a ``ring-smoke`` pass — every shipped explicit-ICI-ring kernel's
+10. a ``ring-smoke`` pass — every shipped explicit-ICI-ring kernel's
    abstract RingOp schedule (kernels.pallas_ring: panel-broadcast
    ring from every owner column, chunked and unchunked, plus the LU
    winner-row exchange) must drain in ``simulate_ring`` with zero
    deadlock/unpaired-semaphore findings, and ``ring.enable=off`` /
    ``auto`` must be bit-identical to the masked-psum cyclic kernels
    on the 2x2 CPU mesh (CPU always falls back);
-10. a ``dplasma_tpu.tuning`` smoke pass — a tiny 2-config dpotrf
+11. a ``dplasma_tpu.tuning`` smoke pass — a tiny 2-config dpotrf
    sweep on the 1x1 grid must persist a winner to a fresh tuning DB,
    the DB must read back clean (``TuningDB.check``), and a
    subsequent driver ``--autotune`` run must provably consult it
    (v11 ``"tuning"`` report section: source ``db``, the winner's
    tile size applied, scoped overrides restored at close);
-11. a ``telemetry-smoke`` pass — a tiny serving burst with tracing on:
+12. a ``telemetry-smoke`` pass — a tiny serving burst with tracing on:
    the span ledger must balance (every open has a close) and carry
    the per-request span taxonomy, the streaming exporter's file must
    parse as Prometheus text (``telemetry.parse_prometheus_text``)
@@ -143,6 +155,42 @@ def run_perfdiff_smoke() -> int:
             sys.stderr.write("perfdiff-smoke: regressed metric not "
                              "named in the diagnostic\n")
             bad += 1
+    return bad
+
+
+def run_threadcheck() -> int:
+    """The concurrency gate: the lock-discipline verifier must find
+    zero unsuppressed violations on the serving/telemetry surface,
+    and the racefuzz schedule smoke (fixed seeds, full probe surface)
+    must replay with zero invariant failures. The
+    ``schedules_run``/``invariant_failures`` counters are printed so
+    a report carrying them gates through perfdiff — a silently
+    shrinking fuzz surface is a regression exactly like a slower
+    median."""
+    from dplasma_tpu.analysis import racefuzz, threadcheck
+
+    bad = 0
+    res = threadcheck.check_package()
+    if not res.ok:
+        sys.stderr.write(res.format("package") + "\n")
+        bad += len(res.diagnostics)
+    seeds = (0, 1)
+    fz = racefuzz.fuzz(seeds=seeds, nthreads=3, nops=60)
+    print(f"# threadcheck: racefuzz schedules_run="
+          f"{fz['schedules_run']} invariant_failures="
+          f"{fz['invariant_failures']}")
+    for name, rs in sorted(fz["probes"].items()):
+        for r in rs:
+            for f in r["failures"]:
+                sys.stderr.write(f"threadcheck: racefuzz[{name} "
+                                 f"seed={r['seed']}]: {f}\n")
+    bad += fz["invariant_failures"]
+    expect = len(seeds) * len(racefuzz.PROBES)
+    if fz["schedules_run"] < expect:
+        sys.stderr.write(f"threadcheck: fuzz surface shrank: "
+                         f"{fz['schedules_run']} schedule(s) run, "
+                         f"expected {expect}\n")
+        bad += 1
     return bad
 
 
@@ -708,6 +756,7 @@ def main(argv=None) -> int:
     for name, fn in (("lint_excepts", lambda: run_excepts(pkg)),
                      ("jaxlint", lambda: run_jaxlint(pkg)),
                      ("perfdiff-smoke", run_perfdiff_smoke),
+                     ("threadcheck", run_threadcheck),
                      ("palcheck", run_palcheck),
                      ("dagcheck-smoke", run_dagcheck_smoke),
                      ("spmdcheck-smoke", run_spmdcheck_smoke),

@@ -34,7 +34,14 @@ Comparable metrics extracted from each document:
   noise-dominated near zero, so its DEFAULT threshold is wide
   (100% relative, ``DEFAULT_METRIC_THRESHOLDS``) and only
   order-of-magnitude growth trips the gate; the absolute < 5%
-  budget is asserted by servebench itself and the test suite.
+  budget is asserted by servebench itself and the test suite;
+* the concurrency gate's fuzz surface
+  (``racefuzz.schedules_run``, HIGHER is better — a silently
+  shrinking schedule-fuzz sweep is a coverage regression — and
+  ``racefuzz.invariant_failures``, lower is better) from a
+  ``{"racefuzz": ...}`` section (``python -m
+  dplasma_tpu.analysis.racefuzz --report`` writes one; the
+  ``tools/lint_all.py`` threadcheck gate prints the same counters).
 
 Exit codes: 0 = no regression, 1 = regression past threshold,
 2 = unusable input (unreadable doc, or a candidate with no
@@ -188,6 +195,22 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
         if lbl and isinstance(v, (int, float)) and v > 0:
             out[f"{lbl}.hlocheck.hbm_peak_bytes"] = {
                 "value": float(v), "better": "lower"}
+    rf = doc.get("racefuzz")
+    if isinstance(rf, dict):
+        # the threadcheck gate's schedule-fuzz surface: fewer
+        # schedules run is a COVERAGE regression (higher-better),
+        # invariant failures grow from a 0 baseline (lower-better —
+        # the zero-baseline ratio path below handles the gate)
+        # zero schedules is the WORST case (total coverage collapse),
+        # not a missing measurement — it must stay comparable
+        v = rf.get("schedules_run")
+        if isinstance(v, (int, float)) and v >= 0:
+            out["racefuzz.schedules_run"] = {"value": float(v),
+                                             "better": "higher"}
+        v = rf.get("invariant_failures")
+        if isinstance(v, (int, float)) and v >= 0:
+            out["racefuzz.invariant_failures"] = {"value": float(v),
+                                                  "better": "lower"}
     for e in (doc.get("entries") or []) + (doc.get("ladder") or []):
         if isinstance(e, dict) and isinstance(e.get("metric"), str) \
                 and isinstance(e.get("value"), (int, float)):
