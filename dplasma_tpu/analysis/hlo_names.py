@@ -1,0 +1,71 @@
+"""Shared HLO op-name tables: the ONE place opcode spellings live.
+
+Three consumers read compiled-HLO / timeline op names against the same
+vocabulary: :mod:`dplasma_tpu.analysis.hlocheck` (static
+compiled-artifact reconciliation), :mod:`dplasma_tpu.observability.
+devprof` (measured-timeline category binning + measured-ICI
+reconciliation), and the tests that pin both. Keeping the tables here
+means a new collective spelling (say an ``all-gather-start`` async
+form) lands in every reader at once instead of drifting per module.
+"""
+from __future__ import annotations
+
+#: HLO opcode -> normalized collective kind (async -start forms count
+#: once; their -done halves are bookkeeping, not wire traffic)
+HLO_COLLECTIVES = {
+    "all-reduce": "all-reduce", "all-reduce-start": "all-reduce",
+    "all-gather": "all-gather", "all-gather-start": "all-gather",
+    "reduce-scatter": "reduce-scatter",
+    "collective-permute": "collective-permute",
+    "collective-permute-start": "collective-permute",
+    "all-to-all": "all-to-all",
+    "collective-broadcast": "collective-broadcast",
+}
+
+#: jaxpr collective kind (spmdcheck) -> the HLO opcode it lowers to
+#: (psum/pmax/pmin all become all-reduce with different reducers).
+#: The explicit ICI-ring kernels (kernels.pallas_ring, counted by
+#: spmdcheck as ring_bcast/ring_shift) lower to Mosaic custom-calls
+#: carrying the ``dplasma_ring_`` marker — reconciled as "ring-dma"
+#: (the async-remote-copy leg of the collective reconciliation).
+JAXPR_TO_HLO = {
+    "psum": "all-reduce", "pmax": "all-reduce", "pmin": "all-reduce",
+    "all_gather": "all-gather", "reduce_scatter": "reduce-scatter",
+    "ppermute": "collective-permute", "all_to_all": "all-to-all",
+    "ring_bcast": "ring-dma", "ring_shift": "ring-dma",
+}
+
+#: marker identifying a ring kernel's custom-call in compiled HLO text
+RING_MARKER = "dplasma_ring_"
+
+#: custom-call targets that are host round-trips in disguise
+CALLBACK_MARKERS = ("callback", "infeed", "outfeed")
+
+#: HLO opcodes that are pure data movement the compiler inserted (the
+#: host/copy category of a measured timeline, and hlocheck's
+#: copy-volume sweep)
+COPY_OPCODES = ("copy", "copy-start", "copy-done", "transpose")
+
+
+def timeline_category(name: str) -> str:
+    """Bin one timeline/HLO op name into the devprof category model:
+    ``compute`` / ``collective`` / ``ici`` / ``host``.
+
+    The leading opcode token (HLO names look like ``all-reduce.3`` or
+    ``fusion.17``; profiler rows may carry a module prefix the caller
+    strips) decides: a :data:`HLO_COLLECTIVES` opcode is
+    ``collective``; a :data:`RING_MARKER` custom-call (the explicit
+    ICI-ring async-remote-copy leg) is ``ici``; copy/transpose and
+    host-callback markers are ``host``; everything else — fusions,
+    dots, the math — is ``compute``."""
+    low = str(name).lower()
+    if RING_MARKER in low:
+        return "ici"
+    opcode = low.split(" ", 1)[0].split(".", 1)[0].lstrip("%")
+    if opcode in HLO_COLLECTIVES:
+        return "collective"
+    if opcode in COPY_OPCODES:
+        return "host"
+    if any(m in low for m in CALLBACK_MARKERS):
+        return "host"
+    return "compute"

@@ -70,33 +70,16 @@ _cfg.mca_register(
     "on the entry and in serving_hlocheck_* metrics, never fatal); "
     "off = skip.")
 
-#: HLO opcode -> normalized collective kind (async -start forms count
-#: once; their -done halves are bookkeeping, not wire traffic)
-_HLO_COLLECTIVES = {
-    "all-reduce": "all-reduce", "all-reduce-start": "all-reduce",
-    "all-gather": "all-gather", "all-gather-start": "all-gather",
-    "reduce-scatter": "reduce-scatter",
-    "collective-permute": "collective-permute",
-    "collective-permute-start": "collective-permute",
-    "all-to-all": "all-to-all",
-    "collective-broadcast": "collective-broadcast",
-}
-
-#: jaxpr collective kind (spmdcheck) -> the HLO opcode it lowers to
-#: (psum/pmax/pmin all become all-reduce with different reducers).
-#: The explicit ICI-ring kernels (kernels.pallas_ring, counted by
-#: spmdcheck as ring_bcast/ring_shift) lower to Mosaic custom-calls
-#: carrying the ``dplasma_ring_`` marker — reconciled as "ring-dma"
-#: (the async-remote-copy leg of the collective reconciliation).
-_JAXPR_TO_HLO = {
-    "psum": "all-reduce", "pmax": "all-reduce", "pmin": "all-reduce",
-    "all_gather": "all-gather", "reduce_scatter": "reduce-scatter",
-    "ppermute": "collective-permute", "all_to_all": "all-to-all",
-    "ring_bcast": "ring-dma", "ring_shift": "ring-dma",
-}
-
-#: marker identifying a ring kernel's custom-call in compiled HLO text
-_RING_MARKER = "dplasma_ring_"
+# The opcode vocabulary is shared with the measured-timeline side
+# (observability.devprof bins profiler rows against the same names) —
+# one table, every reader: dplasma_tpu.analysis.hlo_names. The
+# module-private aliases keep this module's established spellings.
+from dplasma_tpu.analysis.hlo_names import (  # noqa: E402
+    CALLBACK_MARKERS as _SHARED_CALLBACK_MARKERS,
+    HLO_COLLECTIVES as _HLO_COLLECTIVES,
+    JAXPR_TO_HLO as _JAXPR_TO_HLO,
+    RING_MARKER as _RING_MARKER,
+)
 
 #: repo-relative module suffixes whose converts are the AUTHORIZED
 #: precision ladder: the dd/limb emulation (f64 <-> f32 limb splits
@@ -108,7 +91,7 @@ PRECISION_SITES = [
 ]
 
 #: custom-call targets that are host round-trips in disguise
-_CALLBACK_MARKERS = ("callback", "infeed", "outfeed")
+_CALLBACK_MARKERS = _SHARED_CALLBACK_MARKERS
 
 #: float/complex dtype -> mantissa-carrying width in bits (complex
 #: compares by component width: c128 -> c64 loses half the mantissa

@@ -173,6 +173,12 @@ GUARDS: Dict[str, Guard] = {
                "_thread": "rw"},
         under_lock=frozenset({"_update_rates"}),
         receivers={"registry": "MetricsRegistry"}),
+    # observability/devprof.py — capture backends append timeline ops
+    # from whatever thread produced them (profiler callback thread vs
+    # the driver loop) while ingestion snapshots; the list append/
+    # snapshot pair serializes under the collector lock.
+    "DevprofCollector": Guard(
+        lock="_lock", attrs={"_ops": "rw"}),
     # observability/tracing.py — the hot path is lock-free BY DESIGN:
     # each thread owns its lane dict, finished spans commit via the
     # GIL-atomic append of a bounded deque. Only lane creation and the

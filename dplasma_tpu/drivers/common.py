@@ -101,8 +101,9 @@ class IParam:
     # live telemetry (--telemetry[=prom-file]): streaming metrics
     # exporter + flight recorder, v13 "telemetry" report section
     telemetry: Optional[str] = None
-    # performance attribution (--phase-profile/--peaks-file)
+    # performance attribution (--phase-profile/--devprof/--peaks-file)
     phase_profile: bool = False      # per-phase attributed pass (v5)
+    devprof: bool = False            # per-device timeline attribution (v14)
     peaks_file: Optional[str] = None  # roofline peaks source
     # resilience (--abft/--inject/--max-retries/--run-timeout)
     abft: bool = False               # checksum-carried op variants
@@ -217,6 +218,20 @@ Optional arguments:
                      prints at -v>=2 and lands in the run-report
                      (schema v5 "phases"/"roofline"). The timed loop
                      itself stays fence-free
+ --devprof         : per-device timeline attribution around the timed
+                     loop: a hardware profile (jax.profiler events
+                     when the runtime writes any; otherwise a
+                     synthetic timeline reconstructed from the
+                     measured run + the spmdcheck schedule + the
+                     spmd_comm_model pricing — MCA devprof.backend)
+                     binned into compute/collective/ici/host, measured
+                     collective seconds + achieved ICI bytes/s
+                     reconciled per (kind, axis) against the comm
+                     model (MCA devprof.ici_floor), per-rank skew with
+                     the slowest rank and its dominating category
+                     named, and the critical path; lands in the
+                     run-report (schema v14 "devprof" section) and in
+                     devprof_* metrics
  --peaks-file=FILE : hardware peaks for the roofline ledger (a bench
                      JSON doc/report with a "peaks" section, or a raw
                      {mxu_gflops, hbm_gbps, ici_gbps, latency_us}
@@ -284,6 +299,7 @@ _LONG = {
     "spmdcheck": ("spmdcheck", None),
     "hlocheck": ("hlocheck", None),
     "phase-profile": ("phase_profile", None),
+    "devprof": ("devprof", None),
     "peaks-file": ("peaks_file", str),
     "max-retries": ("max_retries", _int),
     "run-timeout": ("run_timeout", float),
@@ -1117,10 +1133,18 @@ class Driver:
             # timed loop only (not compile/warmup)
             trace_cm = _jaxtrace_guard(ip.jaxtrace) if ip.jaxtrace \
                 else contextlib.nullcontext()
+            # --devprof: hardware-profile capture around the same
+            # window; a remediation re-run recreates the capture so
+            # the surviving attempt owns the ingested timeline
+            dp_cap = None
+            if getattr(ip, "devprof", False):
+                from dplasma_tpu.observability import devprof as _dp
+                dp_cap = _dp.DevprofCapture()
             wd = guard.Watchdog(getattr(ip, "run_timeout", 0.0), name) \
                 if resil else None
             times = []
-            with trace_cm, (wd or contextlib.nullcontext()):
+            with trace_cm, (dp_cap or contextlib.nullcontext()), \
+                    (wd or contextlib.nullcontext()):
                 for i in range(max(ip.nruns, 1)):
                     t0 = time.perf_counter()
                     with self.prof.span(f"run[{i}]:{name}", flops=flops,
@@ -1206,6 +1230,62 @@ class Driver:
             rl_entry = self.report.add_roofline(_rl.op_roofline(
                 name, OP_CLASS.get(_algo_of(self.name)), ip.M, ip.N,
                 ip.K, itemsize, flops, comm, best, peaks, src))
+        # --devprof: ingest the captured hardware timeline (or
+        # synthesize one from this run + the spmdcheck schedule + the
+        # comm-model pricing) and attribute it — schema v14 "devprof"
+        dp_entry = None
+        if getattr(ip, "devprof", False):
+            from dplasma_tpu.observability import devprof as _dp
+            op_cls, op_kt = _model_op_kt(_algo_of(self.name), ip)
+            dp_ring = False
+            if op_cls is not None and ip.P * ip.Q > 1:
+                # the SAME ring gate hlocheck's model leg consults,
+                # so the priced schedule matches what the kernels ran
+                from dplasma_tpu.descriptors import Dist
+                from dplasma_tpu.parallel import cyclic as _cyc
+                dp_desc = _cyc.CyclicDesc(
+                    ip.M, ip.N, max(ip.MB, 1), max(ip.NB, 1),
+                    Dist(P=ip.P, Q=ip.Q, kp=ip.kp, kq=ip.kq))
+                dp_ring = _cyc._cyclic_ring(
+                    dp_desc, PRECISIONS[ip.prec], self.mesh,
+                    need_row=(op_cls == "getrf"))
+            dpeaks, _src = self._peaks()
+            try:
+                dp_entry = _dp.attribute(
+                    name, op_cls, best, (ip.P, ip.Q), ip.M, ip.N,
+                    max(ip.NB, 1),
+                    itemsize=np.dtype(PRECISIONS[ip.prec]).itemsize,
+                    kt=op_kt or None, ring=dp_ring,
+                    lookahead=self.pipeline["sweep.lookahead"],
+                    peaks=dpeaks,
+                    timeline=(dp_cap.events or None)
+                    if dp_cap is not None else None,
+                    backend=dp_cap.used if dp_cap is not None
+                    else "synthetic")
+            except Exception as exc:  # noqa: BLE001 — attribution is
+                # observability, not correctness: a failed ingest must
+                # not kill the run it describes. The failure is loud —
+                # flight-recorder event + stderr — never silent.
+                if tel is not None:
+                    tel.flight.record("devprof_error", op=name,
+                                      error=repr(exc))
+                sys.stderr.write(
+                    f"#! devprof attribution failed for {name}: "
+                    f"{exc!r}\n")
+            if dp_entry is not None:
+                if dp_cap is not None and dp_cap.note:
+                    dp_entry["note"] = dp_cap.note
+                self.report.add_devprof(dp_entry)
+                if tel is not None:
+                    for d in dp_entry["diagnostics"]:
+                        tel.flight.record("devprof_diag", op=name,
+                                          diag=d["kind"],
+                                          target=d["op"])
+                    if not dp_entry["ok"]:
+                        tel.flight.record(
+                            "devprof_mismatch", op=name,
+                            relation=dp_entry["reconciliation"]
+                                             ["relation"])
         stats = entry["timings"]
         reg = self.report.metrics
         lbl = dict(op=name, prec=ip.prec)
@@ -1230,6 +1310,17 @@ class Driver:
             for s in phase_info["spans"]:
                 reg.gauge("phase_seconds", phase=s["phase"],
                           **lbl).set(s["measured_s"])
+        if dp_entry is not None:
+            dp_fracs = [c["achieved_frac"]
+                        for c in dp_entry["collectives"]
+                        if c["achieved_frac"] is not None]
+            if dp_fracs:
+                reg.gauge("devprof_ici_achieved_frac", **lbl).set(
+                    min(dp_fracs))
+            reg.gauge("devprof_skew", **lbl).set(
+                dp_entry["skew"]["value"])
+            for c, v in dp_entry["categories"].items():
+                reg.gauge("devprof_seconds", category=c, **lbl).set(v)
         self.prof.save_dinfo(f"GFLOPS:{name}", gflops)
         if ip.rank == 0:
             if ip.loud >= 2:
@@ -1251,6 +1342,23 @@ class Driver:
                              rl_entry["expected_s"], best,
                              _pct(rl_entry["achieved_frac"]),
                              rl_entry["peaks_source"]))
+                if dp_entry is not None:
+                    dps = dp_entry["skew"]
+                    print("#+ devprof[%s]: backend=%s coverage %s "
+                          "relation=%s skew %.3f (slowest rank %d: "
+                          "%s) critical-path %s"
+                          % (name, dp_entry["backend"],
+                             _pct(dp_entry["coverage"]),
+                             dp_entry["reconciliation"]["relation"],
+                             dps["value"], dps["slowest_rank"],
+                             dps["dominating_category"],
+                             _pct(dp_entry["critical_path"]["frac"])))
+                    for c in dp_entry["collectives"]:
+                        print("#+   %-16s n=%3d measured %10.5f s "
+                              "achieved %7s of ICI peak"
+                              % (c["cls"], c["count"],
+                                 c["measured_s"],
+                                 _pct(c["achieved_frac"])))
                 if phase_info is not None:
                     print("#+ phases[%s]: attributed run %.5f s, "
                           "spans %.5f s (coverage %s)"
@@ -1265,6 +1373,15 @@ class Driver:
                                  s["measured_s"], s["expected_s"],
                                  _pct(s["achieved_frac"]),
                                  s["bound"]))
+            if dp_entry is not None and not dp_entry["ok"] \
+                    and ip.loud >= 1:
+                # a reconciliation failure is worth a line even at
+                # the default loudness: a priced collective the
+                # ingested timeline lost is a measurement bug
+                for d in dp_entry["diagnostics"]:
+                    if d["kind"] in ("missing-collective",
+                                     "count-mismatch"):
+                        print(f"#! devprof[{name}]: {d['message']}")
             print("[****] TIME(s) %12.5f : %s\tPxQxg= %3d %-3d %d NB= %4d "
                   "N= %7d : %14f gflops - ENQ&PROG&DEST %12.5f : %14f gflops"
                   " - ENQ %12.5f - DEST %12.5f"

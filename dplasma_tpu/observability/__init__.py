@@ -39,9 +39,19 @@ skeleton in :mod:`dplasma_tpu.utils.profiling`:
   ``telemetry.export_path``/``telemetry.interval_s``) and the
   bounded flight recorder of structured events that rides the
   run-report (schema v13 ``"telemetry"``) and dumps to disk on a
-  serving incident.
+  serving incident;
+* :mod:`.devprof` — the measured half of the roofline story:
+  per-device timeline ingestion (``jax.profiler`` events when the
+  runtime writes any; a synthetic backend reconstructed from the
+  measured run + the spmdcheck schedule + ``spmd_comm_model``
+  pricing on the CPU mesh), compute/collective/ici/host category
+  binning against the shared hlocheck op-name vocabulary,
+  measured-ICI reconciliation with an achieved-fraction floor,
+  per-rank skew/straggler attribution, and critical-path extraction
+  (schema v14 ``"devprof"``; ``--devprof`` on every driver).
 """
-from dplasma_tpu.observability import phases, roofline, telemetry
+from dplasma_tpu.observability import (devprof, phases, roofline,
+                                       telemetry)
 from dplasma_tpu.observability.chrome import (merge_to_chrome,
                                               profile_to_chrome)
 from dplasma_tpu.observability.comm import comm_volume_model
@@ -57,7 +67,7 @@ from dplasma_tpu.observability.xla import capture_compiled
 __all__ = [
     "FlightRecorder", "MetricsExporter", "MetricsRegistry",
     "RunReport", "REPORT_SCHEMA", "Telemetry", "Tracer",
-    "capture_compiled", "comm_volume_model", "dag_stats",
+    "capture_compiled", "comm_volume_model", "dag_stats", "devprof",
     "merge_to_chrome", "phases", "profile_to_chrome", "roofline",
     "telemetry",
 ]

@@ -111,8 +111,10 @@ def _span_event(span: dict, pid: int, t0: int) -> dict:
 def merge_to_chrome(profiles: Iterable[Tuple[Iterable[tuple], Dict[str, str]]] = (),
                     span_docs: Iterable[dict] = (),
                     phase_tables: Iterable[Tuple[str, List[dict]]] = (),
+                    flight_docs: Iterable[dict] = (),
+                    devprof_tables: Iterable[Tuple[str, dict]] = (),
                     name: str = "merged") -> dict:
-    """Fuse traces from three sources into one multi-lane timeline.
+    """Fuse traces from five sources into one multi-lane timeline.
 
     * ``profiles`` — ``(events, info)`` pairs, one per rank (decoded
       DTPUPROF1 5-tuples); each keeps its ``(pid, tid)`` =
@@ -128,6 +130,18 @@ def merge_to_chrome(profiles: Iterable[Tuple[Iterable[tuple], Dict[str, str]]] =
       from the merged timeline's origin — an honest aggregate lane
       (disjoint self-times sum to the attributed run), clearly
       labelled ``(synthetic layout)``.
+    * ``flight_docs`` — flight-recorder dumps
+      (:meth:`~dplasma_tpu.observability.telemetry.FlightRecorder.
+      dump`): each event becomes a Perfetto INSTANT event
+      (``ph: "i"``, process scope) at its real ``t_ns`` on its own
+      pid lane — op starts/finishes, remediation rungs and devprof
+      diagnostics land as pins on the shared time axis.
+    * ``devprof_tables`` — ``(label, entry)`` with run-report
+      ``"devprof"`` entries (schema v14): the attributed category
+      seconds (compute/collective/ici/host) as one synthetic
+      end-to-end lane, the per-collective measured seconds as a
+      second tid — the measured-attribution picture next to the
+      harness spans.
 
     Every real timestamp is rebased to the earliest event across all
     sources; the merged ``traceEvents`` stream is sorted
@@ -136,12 +150,18 @@ def merge_to_chrome(profiles: Iterable[Tuple[Iterable[tuple], Dict[str, str]]] =
     profs = [(list(evs), dict(info)) for evs, info in profiles]
     sdocs = [dict(d) for d in span_docs]
     tables = [(str(lbl), list(rows)) for lbl, rows in phase_tables]
-    # global origin over every REAL timestamp (profile ns + span ns)
+    fdocs = [dict(d) for d in flight_docs]
+    dtables = [(str(lbl), dict(e)) for lbl, e in devprof_tables]
+    # global origin over every REAL timestamp (profile ns + span ns
+    # + flight event ns)
     t0s = []
     for evs, _info in profs:
         t0s.extend(e[1] for e in evs)
     for d in sdocs:
         t0s.extend(s["t0_ns"] for s in d.get("spans") or [])
+    for d in fdocs:
+        t0s.extend(e["t_ns"] for e in d.get("events") or []
+                   if isinstance(e.get("t_ns"), (int, float)))
     t0 = min(t0s, default=0)
 
     meta: List[dict] = []
@@ -207,6 +227,60 @@ def merge_to_chrome(profiles: Iterable[Tuple[Iterable[tuple], Dict[str, str]]] =
                            "total_s": row.get("total_s")}}
             trace.append(ev)
             cursor += max(dur_us, 0.0)
+    for i, d in enumerate(fdocs):
+        pid = claim_pid(base + i + 3000)
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"flight recorder [{i}] "
+                                      f"({d.get('recorded', 0)} "
+                                      f"events, {d.get('dropped', 0)} "
+                                      f"dropped)"}})
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": "flight events"}})
+        for e in d.get("events") or []:
+            if not isinstance(e, dict) \
+                    or not isinstance(e.get("t_ns"), (int, float)):
+                continue
+            args = {k: v for k, v in e.items()
+                    if k not in ("t_ns", "kind")}
+            ev = {"name": str(e.get("kind", "?")), "cat": "flight",
+                  "ph": "i", "s": "p",
+                  "ts": (e["t_ns"] - t0) / 1e3, "pid": pid, "tid": 0}
+            if args:
+                ev["args"] = args
+            trace.append(ev)
+    for i, (label, entry) in enumerate(dtables):
+        pid = claim_pid(base + i + 4000)
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"devprof: {label} "
+                                      f"(synthetic layout)"}})
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": "category seconds"}})
+        cursor = 0.0
+        for cat, sec in (entry.get("categories") or {}).items():
+            dur_us = max(float(sec or 0.0), 0.0) * 1e6
+            trace.append({"name": str(cat), "cat": "devprof",
+                          "ph": "X", "ts": cursor, "dur": dur_us,
+                          "pid": pid, "tid": 0,
+                          "args": {"seconds": sec,
+                                   "backend": entry.get("backend")}})
+            cursor += dur_us
+        colls = entry.get("collectives") or []
+        if colls:
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": 1,
+                         "args": {"name": "collectives (measured)"}})
+            cursor = 0.0
+            for c in colls:
+                dur_us = max(float(c.get("measured_s") or 0.0),
+                             0.0) * 1e6
+                trace.append({
+                    "name": str(c.get("cls", "?")), "cat": "devprof",
+                    "ph": "X", "ts": cursor, "dur": dur_us,
+                    "pid": pid, "tid": 1,
+                    "args": {"count": c.get("count"),
+                             "measured_s": c.get("measured_s"),
+                             "achieved_frac": c.get("achieved_frac")}})
+                cursor += dur_us
     trace.sort(key=lambda e: e["ts"])
     return {"traceEvents": meta + trace, "displayTimeUnit": "ms",
             "otherData": other}

@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 13``).
+"""The versioned JSON run-report (``"schema": 14``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -107,6 +107,27 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                                  # tracing span ledger, streaming
                                  # exporter provenance, and the
                                  # flight recorder's event ring)
+     "devprof": [{"label", "op", "backend",  # jax|synthetic
+                  "nranks", "run_s",
+                  "categories": {"compute", "collective", "ici",
+                                 "host"},    # mean seconds per rank
+                  "coverage",    # category sum / run_s
+                  "timeline_ops",
+                  "collectives": [{"cls",    # kind@axis (spmdcheck)
+                                   "hlo", "count", "measured_s",
+                                   "model_bytes",
+                                   "achieved_bytes_per_s",
+                                   "achieved_frac"}],
+                  "reconciliation": {"relation",  # ==|mismatch|
+                                     # unmodelled|no-collectives
+                                     "expected", "ingested"},
+                  "skew": {"value", "slowest_rank",
+                           "dominating_category", "per_rank_s",
+                           "ranks", "max_step_spread_s"},
+                  "critical_path": {"length_s", "frac", "spans",
+                                    "truncated"},
+                  "diagnostics": [{"kind", "op", "message"}],
+                  "ok"}],                                  # (v14)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
@@ -146,10 +167,17 @@ observability.telemetry/tracing: the always-on serving span ledger,
 the streaming Prometheus exporter's provenance, and the flight
 recorder's bounded event ring, dumped whole so an incident report
 carries its own evidence; servebench's ``"serving"`` entries gain
-``trace_overhead_frac``, which perfdiff gates lower-better). All
-additive — v1 readers of the other keys are unaffected; this reader
-accepts <= 13 (:func:`load_report` tolerates every v1-v13 vintage,
-filling the always-present keys).
+``trace_overhead_frac``, which perfdiff gates lower-better); 14 adds
+``"devprof"`` (the measured per-device timeline attribution —
+observability.devprof: category seconds binned from the same HLO
+op-name vocabulary hlocheck parses, per-collective measured seconds
++ achieved bytes/s reconciled against the spmd_comm_model pricing
+and the roofline ``ici`` peak, per-rank skew/straggler attribution,
+and the merged-timeline critical path; perfdiff gates
+``devprof.ici_achieved_frac`` higher-better and ``devprof.skew``
+lower-better). All additive — v1 readers of the other keys are
+unaffected; this reader accepts <= 14 (:func:`load_report` tolerates
+every v1-v14 vintage, filling the always-present keys).
 """
 from __future__ import annotations
 
@@ -161,7 +189,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 13
+REPORT_SCHEMA = 14
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -204,6 +232,7 @@ class RunReport:
         self.tuning: List[dict] = []    # --autotune consultations (v11)
         self.scaling: List[dict] = []   # per-chip-count curves (v12)
         self.telemetry: Optional[dict] = None  # live instruments (v13)
+        self.devprof: List[dict] = []   # measured-timeline attribution (v14)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
         self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
@@ -291,6 +320,14 @@ class RunReport:
         self.telemetry = summary
         return summary
 
+    def add_devprof(self, entry: dict) -> dict:
+        """Record one op's measured-timeline attribution (schema v14;
+        see observability.devprof.ingest/attribute — category
+        seconds, measured-ICI reconciliation, skew/straggler
+        attribution, critical path)."""
+        self.devprof.append(entry)
+        return entry
+
     def add_roofline(self, entry: dict) -> dict:
         """Record one per-op roofline ledger entry (schema v5; see
         observability.roofline.op_roofline)."""
@@ -334,6 +371,8 @@ class RunReport:
             doc["scaling"] = self.scaling
         if self.telemetry is not None:
             doc["telemetry"] = self.telemetry
+        if self.devprof:
+            doc["devprof"] = self.devprof
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
         if self.roofline:
@@ -368,7 +407,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v12) loads: the schema history is purely
+    Every older vintage (v1-v13) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

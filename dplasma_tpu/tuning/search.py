@@ -432,6 +432,7 @@ def sweep(ops: List[str], sizes: List[int], dtype="float32",
           peaks: Optional[dict] = None,
           gate_threshold: float = 0.10, force: bool = False,
           measure_fn: Optional[Callable] = None,
+          devprof: bool = False,
           log: Optional[Callable[[str], None]] = None) -> dict:
     """Sweep the key space ``ops x sizes`` on one (dtype, grid):
     enumerate, prune against the incumbent's measured time, measure
@@ -526,14 +527,47 @@ def sweep(ops: List[str], sizes: List[int], dtype="float32",
             # the key still has headroom worth a wider sweep)
             exp = expected_config_seconds(op, n, dtype,
                                           winner["config"], peaks)
-            db.put(op, n, dtype, grid, winner["knobs"],
-                   winner["median_s"], gflops=winner["gflops"],
-                   achieved_frac=(exp / winner["median_s"]
-                                  if winner["median_s"] > 0
-                                  else None),
-                   peaks=peaks, trials=len(trials),
-                   nruns=nruns
-                   or max(_cfg.mca_get_int("tune.nruns", 3), 1))
+            entry = db.put(op, n, dtype, grid, winner["knobs"],
+                           winner["median_s"],
+                           gflops=winner["gflops"],
+                           achieved_frac=(exp / winner["median_s"]
+                                          if winner["median_s"] > 0
+                                          else None),
+                           peaks=peaks, trials=len(trials),
+                           nruns=nruns
+                           or max(_cfg.mca_get_int("tune.nruns", 3),
+                                  1))
+            if devprof:
+                # measured-ICI evidence rides the stored winner: the
+                # attribution of the winning median (devprof's
+                # synthetic backend on the CPU mesh — the same
+                # schedule + pricing a --devprof driver run ingests),
+                # so a later consult can tell wire-bound keys from
+                # compute-bound ones without re-measuring
+                from dplasma_tpu.observability import devprof as _dp
+                wnb = int(winner["config"].get("nb") or default_nb(n))
+                att = _dp.attribute(
+                    key, op, winner["median_s"], grid, n, n, wnb,
+                    itemsize=int(np.dtype(dtype).itemsize),
+                    peaks=peaks)
+                ici_s = (att["categories"]["collective"]
+                         + att["categories"]["ici"])
+                fracs = [c["achieved_frac"]
+                         for c in att["collectives"]
+                         if c["achieved_frac"] is not None]
+                entry["devprof"] = {
+                    "backend": att["backend"], "ici_s": ici_s,
+                    "ici_frac_of_run": (
+                        ici_s / winner["median_s"]
+                        if winner["median_s"] > 0 else 0.0),
+                    "ici_achieved_frac": (min(fracs) if fracs
+                                          else None),
+                    "relation": att["reconciliation"]["relation"],
+                    "skew": att["skew"]["value"]}
+                log(f"# tune[{key}]: devprof ici={ici_s:.3g}s "
+                    f"({100 * entry['devprof']['ici_frac_of_run']:.1f}"
+                    f"% of run, relation="
+                    f"{att['reconciliation']['relation']})")
             if path:
                 db.save(path)
     if path:

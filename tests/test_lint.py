@@ -16,8 +16,12 @@
   dplasma_tpu.tuning sweep → DB → driver --autotune consultation
   smoke + the telemetry smoke: a traced serving burst must leave a
   balanced span ledger, a Prometheus-parseable exporter snapshot,
-  and a flight-recorder ring that round-trips through the v13
-  run-report) must exit 0 on the repo.
+  and a flight-recorder ring that round-trips through the run-report
+  + the devprof smoke: synthetic-timeline attribution on a 2x2 grid
+  must reconcile ``==`` against the spmdcheck schedule for every
+  modelled op, name an injected straggler rank, flag a dropped
+  collective class with a named diagnostic, and round-trip the v14
+  ``"devprof"`` report section) must exit 0 on the repo.
 """
 import pathlib
 import sys
@@ -92,5 +96,6 @@ def test_lint_all_aggregate_is_clean(capsys):
     for gate in ("lint_excepts", "jaxlint", "perfdiff-smoke",
                  "threadcheck", "palcheck", "dagcheck-smoke",
                  "spmdcheck-smoke", "serving-smoke", "hlocheck-smoke",
-                 "ring-smoke", "tune-smoke", "telemetry-smoke"):
+                 "ring-smoke", "tune-smoke", "telemetry-smoke",
+                 "devprof-smoke"):
         assert f"# {gate}: OK" in out.out

@@ -2,9 +2,11 @@
 
 The MULTICHIP artifact's upgrade from smoke bit to measurement: the
 scaling sweep runs the realized block-cyclic kernels at every chip
-count, lands a schema-v12 ``"scaling"`` section + higher-better
-ledger entries, and self-gates through perfdiff (informational on the
-CPU host-platform mesh, binding on accelerators — the plumbing is
+count, lands a ``"scaling"`` section (added in schema v12) +
+higher-better ledger entries with CPU-mesh runs explicitly labelled
+``"placeholder"``, optionally attributes every point with devprof,
+and self-gates through perfdiff (informational on the CPU
+host-platform mesh, binding on accelerators — the plumbing is
 identical).
 """
 import json
@@ -72,7 +74,7 @@ def test_main_end_to_end_report_ledger_and_gate(tmp_path, capsys,
                          "--report", rj, "--history", hist])
     assert rc == 0
     doc = json.load(open(rj))
-    assert doc["schema"] == 13
+    assert doc["schema"] == 14
     (sec,) = doc["scaling"]
     assert [p["chips"] for p in sec["points"]] == [1, 2]
     assert doc["ops"] and doc["entries"]
@@ -91,6 +93,46 @@ def test_main_end_to_end_report_ledger_and_gate(tmp_path, capsys,
     out = capsys.readouterr().out
     assert rc2 == 0
     assert "REGRESSION" in out and "informational" in out
+
+
+def test_cpu_mesh_runs_are_labelled_placeholder(devices8):
+    """A host-platform (CPU) mesh can exercise the plumbing but not
+    the hardware claim: every scaling section and ledger entry must
+    carry ``"placeholder": true`` so downstream dashboards never
+    mistake the numbers for accelerator measurements."""
+    scaling = multichip.run_scaling(["potrf"], 32, 8, [1, 2],
+                                    nruns=1, log=lambda s: None)
+    (sec,) = scaling
+    assert sec["placeholder"] is True
+    doc = multichip.ledger_doc(scaling, 32)
+    assert doc["placeholder"] is True
+    assert all(row.get("placeholder") is True for row in doc["ladder"])
+    # a non-placeholder section stays unlabelled end to end
+    clean = json.loads(json.dumps(scaling))
+    for s in clean:
+        s.pop("placeholder", None)
+    doc2 = multichip.ledger_doc(clean, 32)
+    assert "placeholder" not in doc2
+    assert all("placeholder" not in row for row in doc2["ladder"])
+
+
+def test_run_scaling_devprof_attribution(devices8):
+    """--devprof attributes every scaling point: the 1-chip point is
+    honestly unmodelled, the multi-chip point reconciles against the
+    spmdcheck schedule."""
+    scaling = multichip.run_scaling(["potrf"], 32, 8, [1, 4],
+                                    nruns=1, log=lambda s: None,
+                                    devprof=True)
+    (sec,) = scaling
+    by_chips = {p["chips"]: p["devprof"] for p in sec["points"]}
+    assert by_chips[1]["reconciliation"]["relation"] == \
+        "no-collectives"
+    e4 = by_chips[4]
+    assert e4["reconciliation"]["relation"] == "=="
+    assert e4["ok"] and e4["nranks"] == 4
+    assert e4["label"] == "multichip_dpotrf_n32_c4"
+    assert sum(e4["categories"].values()) == pytest.approx(
+        e4["run_s"], rel=0.10)
 
 
 def test_main_rejects_unknown_op(capsys):

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 13
+    assert doc["schema"] == REPORT_SCHEMA == 14
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -206,6 +206,33 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
                           "request": 1, "op": "posv",
                           "outcome": "remediated",
                           "winner": "posv", "attempts": 2}]}}},
+        14: {"schema": 14, "name": "v14", "ops": [], "metrics": [],
+             "devprof": [{
+                 "label": "testing_dpotrf", "op": "potrf",
+                 "backend": "synthetic", "nranks": 4,
+                 "run_s": 0.01,
+                 "categories": {"compute": 0.0085,
+                                "collective": 0.0012,
+                                "ici": 0.0003, "host": 0.0},
+                 "coverage": 1.0, "timeline_ops": 52,
+                 "collectives": [
+                     {"cls": "psum@q", "hlo": "all-reduce",
+                      "count": 4, "measured_s": 0.0009,
+                      "model_bytes": 32768.0,
+                      "achieved_bytes_per_s": 9.1e6,
+                      "achieved_frac": 0.91}],
+                 "reconciliation": {"relation": "==",
+                                    "expected": {"psum@q": 4},
+                                    "ingested": {"psum@q": 4}},
+                 "skew": {"value": 0.02, "slowest_rank": 2,
+                          "dominating_category": "collective",
+                          "per_rank_s": [0.0098, 0.0099, 0.01,
+                                         0.0097],
+                          "ranks": [0, 1, 2, 3],
+                          "max_step_spread_s": 0.0002},
+                 "critical_path": [{"name": "fusion.0", "rank": 2,
+                                    "seconds": 0.004}],
+                 "diagnostics": [], "ok": True}]},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -461,7 +488,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 13
+    assert doc["schema"] == 14
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

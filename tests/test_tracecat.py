@@ -1,7 +1,8 @@
 """tools/tracecat.py: DTPUPROF1 -> Perfetto (Chrome trace-event)
 conversion — multi-rank/track lane round-trips, the --info and --lax
 CLI modes, torn-tail behavior, and the merge mode that fuses per-rank
-traces + phase ledgers + serving spans into one multi-lane timeline."""
+traces + phase ledgers + serving spans + flight-recorder instants +
+devprof attribution lanes into one multi-lane timeline."""
 import json
 
 import pytest
@@ -175,7 +176,7 @@ def test_merge_accepts_report_phases_section(tmp_path):
     """--phases also reads a run-report: each op's phases.spans rows
     become one labelled synthetic lane."""
     _write_profile(tmp_path / "r0.prof", rank=0, tracks=(0,))
-    report = {"schema": 13, "name": "x", "metrics": [],
+    report = {"schema": 14, "name": "x", "metrics": [],
               "ops": [{"label": "testing_dpotrf",
                        "phases": {"spans": [
                            {"phase": "panel", "count": 2,
@@ -221,9 +222,79 @@ def test_merge_lax_honors_torn_tail(tmp_path):
                           "-o", str(tmp_path / "m.json")]) == 0
 
 
+def test_merge_flight_instant_lane(tmp_path):
+    """--flight turns a flight-recorder dump into an instant-event
+    lane: every ring event becomes a ph="i" marker on the shared
+    timebase, drop counts visible in the process name."""
+    from dplasma_tpu.observability import FlightRecorder
+    _write_profile(tmp_path / "r0.prof", rank=0, tracks=(0,))
+    fr = FlightRecorder(capacity=8)
+    fr.record("op_start", op="testing_dpotrf", n=64)
+    fr.record("devprof_diag", op="testing_dpotrf",
+              diag="missing-collective", target="psum@p")
+    fr.dump(str(tmp_path / "flight.json"))
+    out = tmp_path / "m.json"
+    rc = tracecat.main(["--merge", str(tmp_path / "r0.prof"),
+                        "--flight", str(tmp_path / "flight.json"),
+                        "-o", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    inst = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert [(e["name"], e["s"]) for e in inst] == \
+        [("op_start", "p"), ("devprof_diag", "p")]
+    assert {e["cat"] for e in inst} == {"flight"}
+    assert all(e["ts"] >= 0 for e in inst)
+    assert inst[0]["args"]["op"] == "testing_dpotrf"
+    assert inst[1]["args"]["diag"] == "missing-collective"
+    # the flight lane has its own pid, off the rank grid
+    assert {e["pid"] for e in inst}.isdisjoint({0})
+    procs = [e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert any("flight recorder" in p and "2 events" in p
+               for p in procs)
+    # a run-report carrying the telemetry.flight_recorder section is
+    # accepted too; a JSON without either shape is refused
+    (tmp_path / "bad.json").write_text('{"x": 1}')
+    with pytest.raises(ValueError):
+        tracecat._load_flight_doc(str(tmp_path / "bad.json"))
+
+
+def test_merge_devprof_attribution_lanes(tmp_path):
+    """--devprof lays a run-report's devprof entries out as synthetic
+    category + collective lanes."""
+    from dplasma_tpu.observability import RunReport, devprof as dp
+    _write_profile(tmp_path / "r0.prof", rank=0, tracks=(0,))
+    rep = RunReport("testing_dpotrf")
+    rep.add_devprof(dp.attribute("testing_dpotrf", "potrf", 0.01,
+                                 (2, 2), 64, 64, 16))
+    rep.write(str(tmp_path / "rep.json"))
+    doc = tracecat.merge([str(tmp_path / "r0.prof")],
+                         devprof=[str(tmp_path / "rep.json")])
+    lanes = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and e["cat"] == "devprof"]
+    assert lanes
+    cats = [e for e in lanes if e["tid"] == 0]
+    colls = [e for e in lanes if e["tid"] == 1]
+    assert {e["name"] for e in cats} <= set(dp.CATEGORIES)
+    assert {e["name"] for e in colls} == \
+        {"all_gather@p", "psum@p", "psum@q"}
+    assert all(e["args"]["count"] > 0 for e in colls)
+    procs = [e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert any(p.startswith("devprof:") and "testing_dpotrf" in p
+               for p in procs)
+    # a report with no devprof section is refused
+    RunReport("empty").write(str(tmp_path / "empty.json"))
+    with pytest.raises(ValueError):
+        tracecat._load_devprof_tables(str(tmp_path / "empty.json"))
+
+
 def test_cli_rejects_merge_flags_without_merge(tmp_path, capsys):
     _write_profile(tmp_path / "a.prof", rank=0)
     _write_profile(tmp_path / "b.prof", rank=1)
     assert tracecat.main([str(tmp_path / "a.prof"),
                           str(tmp_path / "b.prof")]) == 2
     assert "--merge" in capsys.readouterr().err
+    _write_profile(tmp_path / "c.prof", rank=0)
+    assert tracecat.main([str(tmp_path / "c.prof"), "--flight",
+                          str(tmp_path / "a.prof")]) == 2

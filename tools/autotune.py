@@ -91,7 +91,8 @@ def cmd_sweep(ns) -> int:
         agg_depths=ns.agg_depths, panel_kernels=ns.panel_kernels,
         ring_modes=ns.ring, nruns=ns.nruns, margin=ns.margin,
         prune=not ns.no_prune, history=ns.history, peaks=peaks,
-        gate_threshold=ns.gate_threshold, force=ns.force)
+        gate_threshold=ns.gate_threshold, force=ns.force,
+        devprof=ns.devprof)
     stored = sum(1 for k in report["keys"]
                  if k.get("decision") == "stored")
     kept = sum(1 for k in report["keys"]
@@ -229,6 +230,12 @@ def main(argv=None) -> int:
                          "(bench doc/report or raw peaks dict)")
     sp.add_argument("--gate-threshold", type=float, default=0.10,
                     help="perfdiff re-tune gate threshold")
+    sp.add_argument("--devprof", action="store_true",
+                    help="attach measured-ICI evidence to every "
+                         "stored winner (observability.devprof "
+                         "attribution of the winning median: ici "
+                         "seconds + fraction of run, achieved-ICI "
+                         "fraction, reconciliation relation, skew)")
     sp.add_argument("--force", action="store_true",
                     help="store the new winner even when the re-tune "
                          "gate flags a regression")

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Twelve passes, in increasing cost order:
+Thirteen passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
@@ -68,7 +68,16 @@ Twelve passes, in increasing cost order:
    with the serving families present, and the flight-recorder dump
    must round-trip through the schema-v13 run-report
    (``report.load_report``) with its submit/dispatch event sequence
-   intact.
+   intact;
+13. a ``devprof-smoke`` pass — the measured-attribution engine
+   (``observability.devprof``) on the 2x2 grid: every spmdcheck-
+   priced collective class of potrf/getrf/geqrf must appear in the
+   ingested timeline with the reconciliation relation ``==`` and the
+   category seconds summing to the run exactly, an injected
+   straggler must be attributed to the right rank and category, a
+   timeline mutation dropping one priced class must produce a
+   ``missing-collective`` diagnostic NAMING that class, and the
+   entry must round-trip through the schema-v14 run-report.
 
 Usage: ``python tools/lint_all.py`` — prints ``file:line: message``
 per violation / one line per failed smoke case, exits nonzero on any.
@@ -750,6 +759,100 @@ def run_telemetry_smoke() -> int:
     return bad
 
 
+def run_devprof_smoke() -> int:
+    """The measured-attribution gate, CPU-fast and jax-free: devprof's
+    synthetic 2x2 timelines for the priced op classes must reconcile
+    ``==`` against the spmdcheck schedule with category seconds
+    summing to the run, a straggler injection must be attributed to
+    the injected rank + category, a dropped priced class must be a
+    NAMED missing-collective diagnostic, and the entry must
+    round-trip through the schema-v14 run-report."""
+    import tempfile
+
+    from dplasma_tpu.observability import devprof as dp
+    from dplasma_tpu.observability.report import (REPORT_SCHEMA,
+                                                  RunReport,
+                                                  load_report)
+
+    bad = 0
+    run_s, grid, n, nb = 0.01, (2, 2), 64, 16
+    entries = {}
+    for op in ("potrf", "getrf", "geqrf"):
+        e = dp.attribute(f"smoke_{op}", op, run_s, grid, n, n, nb)
+        entries[op] = e
+        if e["reconciliation"]["relation"] != "==" or not e["ok"]:
+            sys.stderr.write(
+                f"devprof-smoke: {op} does not reconcile "
+                f"(relation={e['reconciliation']['relation']}, "
+                f"diagnostics={e['diagnostics']})\n")
+            bad += 1
+        total = sum(e["categories"].values())
+        if abs(total - run_s) > 1e-6 * max(run_s, 1.0):
+            sys.stderr.write(f"devprof-smoke: {op} category seconds "
+                             f"{total} != run {run_s}\n")
+            bad += 1
+        missing = [c for c in (e["reconciliation"]["expected"] or {})
+                   if c not in {r["cls"] for r in e["collectives"]}]
+        if missing:
+            sys.stderr.write(f"devprof-smoke: {op} priced class(es) "
+                             f"{missing} absent from the ingested "
+                             f"timeline\n")
+            bad += 1
+    # straggler injection: rank 2's collectives x8 must be attributed
+    # to rank 2 with a collective-side dominating category
+    base = entries["potrf"]
+    tl = dp.synthesize_timeline(
+        run_s, 4, counts=base["reconciliation"]["expected"],
+        bytes_by_class={c["cls"]: c["model_bytes"]
+                        for c in base["collectives"]
+                        if c["model_bytes"] is not None})
+    skewed = dp.ingest(dp.stretch_rank(tl, 2, 8.0), run_s, 4,
+                       expected=base["reconciliation"]["expected"],
+                       op="potrf", label="smoke_straggler")
+    if skewed["skew"]["slowest_rank"] != 2 \
+            or skewed["skew"]["dominating_category"] not in (
+                "collective", "ici") \
+            or skewed["skew"]["value"] <= 0:
+        sys.stderr.write(
+            f"devprof-smoke: straggler attribution wrong "
+            f"(skew={skewed['skew']})\n")
+        bad += 1
+    # mutation: drop one priced class -> a NAMED diagnostic + not ok
+    drop = sorted(base["reconciliation"]["expected"])[0]
+    mutated = dp.ingest([s for s in tl if s.get("cls") != drop],
+                        run_s, 4,
+                        expected=base["reconciliation"]["expected"],
+                        op="potrf", label="smoke_mutation")
+    named = [d for d in mutated["diagnostics"]
+             if d["kind"] == "missing-collective" and d["op"] == drop]
+    if mutated["ok"] or mutated["reconciliation"]["relation"] == "==" \
+            or not named:
+        sys.stderr.write(
+            f"devprof-smoke: dropped class {drop} not diagnosed "
+            f"(diagnostics={mutated['diagnostics']})\n")
+        bad += 1
+    # run-report round-trip at the current schema
+    with tempfile.TemporaryDirectory() as td:
+        rep = RunReport("devprof-smoke")
+        rep.add_devprof(entries["potrf"])
+        rj = f"{td}/r.json"
+        rep.write(rj)
+        try:
+            doc = load_report(rj)
+        except (OSError, ValueError) as exc:
+            sys.stderr.write(f"devprof-smoke: report round-trip "
+                             f"failed: {exc}\n")
+            return bad + 1
+        got = doc.get("devprof") or []
+        if doc.get("schema") != REPORT_SCHEMA or len(got) != 1 \
+                or got[0] != entries["potrf"]:
+            sys.stderr.write(f"devprof-smoke: devprof section did "
+                             f"not round-trip (schema="
+                             f"{doc.get('schema')})\n")
+            bad += 1
+    return bad
+
+
 def main(argv=None) -> int:
     pkg = _ROOT / "dplasma_tpu"
     bad = 0
@@ -764,7 +867,8 @@ def main(argv=None) -> int:
                      ("hlocheck-smoke", run_hlocheck_smoke),
                      ("ring-smoke", run_ring_smoke),
                      ("tune-smoke", run_tune_smoke),
-                     ("telemetry-smoke", run_telemetry_smoke)):
+                     ("telemetry-smoke", run_telemetry_smoke),
+                     ("devprof-smoke", run_devprof_smoke)):
         n = fn()
         print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")
         bad += n

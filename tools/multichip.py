@@ -12,11 +12,22 @@ records per point::
     {"chips", "grid": [P, Q], "median_s", "gflops",
      "parallel_efficiency"}       # eff = T_1 / (chips * T_chips)
 
-into (a) the run-report's schema-v12 ``"scaling"`` section
-(``--report``), and (b) the ``bench_history.jsonl`` ledger
-(``--history``) as ``"better": "higher"`` entries — GFlop/s AND
-parallel efficiency per (op, chip count) — so ``tools/perfdiff.py``
-gates scaling regressions exactly like time regressions.
+into (a) the run-report's ``"scaling"`` section (``--report``;
+section added in schema v12, written at the current vintage), and
+(b) the ``bench_history.jsonl`` ledger (``--history``) as
+``"better": "higher"`` entries — GFlop/s AND parallel efficiency per
+(op, chip count) — so ``tools/perfdiff.py`` gates scaling
+regressions exactly like time regressions.
+
+On the CPU host-platform mesh every scaling section AND every ledger
+entry carries ``"placeholder": true``: virtual chips share one
+socket, so the curve measures XLA partitioning overhead, not ICI —
+the label keeps a later hardware baseline from silently comparing
+against a placeholder curve. ``--devprof`` additionally attributes
+every scaling point (the measured median through
+:func:`dplasma_tpu.observability.devprof.attribute`: category
+seconds, per-collective measured ICI, skew) and lands the entries in
+the report's schema-v14 ``"devprof"`` section.
 
 Self-gating: with ``--history``, the newest comparable prior ledger
 entry is diffed against this run BEFORE appending. On a real
@@ -99,10 +110,18 @@ def measure_point(op: str, n: int, nb: int, dtype, chips: int,
 
 
 def run_scaling(ops, n: int, nb: int, chips_list, nruns: int = 3,
-                log=print):
+                log=print, devprof: bool = False):
     """The full sweep: every op over every chip count. Returns the
-    schema-v12 ``"scaling"`` section (one entry per op)."""
+    ``"scaling"`` section (one entry per op). On the CPU
+    host-platform mesh every section is labelled
+    ``"placeholder": true`` — virtual chips measure partitioning
+    overhead, not hardware scaling. ``devprof=True`` attaches a
+    per-point measured attribution
+    (:func:`dplasma_tpu.observability.devprof.attribute`)."""
+    import jax
+
     from dplasma_tpu.utils import config as _cfg
+    placeholder = jax.default_backend() == "cpu"
     out = []
     for op in ops:
         prec = _OPS[op]
@@ -110,10 +129,16 @@ def run_scaling(ops, n: int, nb: int, chips_list, nruns: int = 3,
         for chips in chips_list:
             grid, med, gf = measure_point(op, n, nb, "float64",
                                           chips, nruns)
-            points.append({"chips": chips,
-                           "grid": [grid[0], grid[1]],
-                           "median_s": med, "gflops": round(gf, 3),
-                           "parallel_efficiency": None})
+            pt = {"chips": chips,
+                  "grid": [grid[0], grid[1]],
+                  "median_s": med, "gflops": round(gf, 3),
+                  "parallel_efficiency": None}
+            if devprof:
+                from dplasma_tpu.observability import devprof as _dp
+                pt["devprof"] = _dp.attribute(
+                    f"multichip_{prec}{op}_n{n}_c{chips}", op, med,
+                    grid, n, n, nb, itemsize=8)
+            points.append(pt)
         # efficiency in a second pass so it never depends on --chips
         # ordering; without a 1-chip baseline in the sweep the column
         # stays None (and its ledger entries are absent) — visible,
@@ -124,14 +149,26 @@ def run_scaling(ops, n: int, nb: int, chips_list, nruns: int = 3,
             if t1 is not None:
                 p["parallel_efficiency"] = round(
                     t1 / (p["chips"] * p["median_s"]), 4)
+            dp = p.get("devprof")
+            extra = ""
+            if dp is not None:
+                extra = (f" devprof={dp['reconciliation']['relation']}"
+                         f" ici={dp['categories']['collective'] + dp['categories']['ici']:.4g}s"
+                         f" skew={dp['skew']['value']:.3f}")
             log(f"# multichip[{prec}{op}]: n={n} chips={p['chips']} "
                 f"grid={p['grid'][0]}x{p['grid'][1]} "
                 f"median={p['median_s']:.4g}s "
                 f"{p['gflops']:.2f} GF/s "
-                f"eff={p['parallel_efficiency']}")
-        out.append({"op": op, "prec": prec, "n": n, "nb": nb,
-                    "ring": _cfg.mca_get("ring.enable") or "auto",
-                    "points": points})
+                f"eff={p['parallel_efficiency']}{extra}")
+        sec = {"op": op, "prec": prec, "n": n, "nb": nb,
+               "ring": _cfg.mca_get("ring.enable") or "auto",
+               "points": points}
+        if placeholder:
+            # virtual CPU "chips" share one socket: the curve shape
+            # is XLA partitioning overhead, not ICI — label it so a
+            # hardware baseline never compares against it unawares
+            sec["placeholder"] = True
+        out.append(sec)
     return out
 
 
@@ -141,22 +178,34 @@ def ledger_doc(scaling, n: int) -> dict:
     metric names perfdiff compares across runs."""
     from dplasma_tpu.tuning import db as tdb
     entries = []
+    any_placeholder = False
     for sec in scaling:
         name = f"{sec['prec']}{sec['op']}"
+        ph = bool(sec.get("placeholder"))
+        any_placeholder = any_placeholder or ph
         for pt in sec["points"]:
             base = f"multichip_{name}_n{n}_c{pt['chips']}"
-            entries.append({"metric": f"{base}_gflops",
-                            "value": pt["gflops"],
-                            "unit": "GFlop/s", "better": "higher",
-                            "chips": pt["chips"]})
+            row = {"metric": f"{base}_gflops",
+                   "value": pt["gflops"],
+                   "unit": "GFlop/s", "better": "higher",
+                   "chips": pt["chips"]}
+            if ph:
+                row["placeholder"] = True
+            entries.append(row)
             if pt["parallel_efficiency"] is not None:
-                entries.append({"metric": f"{base}_eff",
-                                "value": pt["parallel_efficiency"],
-                                "unit": "frac", "better": "higher",
-                                "chips": pt["chips"]})
-    return {"metric": "multichip_scaling", "value": len(entries),
-            "unit": "points", "ladder": entries,
-            "pipeline": tdb.resolved_knobs(grid=(1, 1))}
+                row = {"metric": f"{base}_eff",
+                       "value": pt["parallel_efficiency"],
+                       "unit": "frac", "better": "higher",
+                       "chips": pt["chips"]}
+                if ph:
+                    row["placeholder"] = True
+                entries.append(row)
+    doc = {"metric": "multichip_scaling", "value": len(entries),
+           "unit": "points", "ladder": entries,
+           "pipeline": tdb.resolved_knobs(grid=(1, 1))}
+    if any_placeholder:
+        doc["placeholder"] = True
+    return doc
 
 
 def main(argv=None) -> int:
@@ -173,7 +222,14 @@ def main(argv=None) -> int:
                     help="chip counts (default 1,2,4,8)")
     ap.add_argument("--nruns", type=int, default=3)
     ap.add_argument("--report", default=None,
-                    help="write the schema-v12 run-report here")
+                    help="write the run-report (scaling + devprof "
+                         "sections) here")
+    ap.add_argument("--devprof", action="store_true",
+                    help="attribute every scaling point (category "
+                         "seconds, measured per-collective ICI, "
+                         "skew) via observability.devprof; entries "
+                         "land in the report's schema-v14 "
+                         "\"devprof\" section")
     ap.add_argument("--history", default=None,
                     help="bench_history.jsonl ledger to gate against "
                          "and append to")
@@ -206,7 +262,8 @@ def main(argv=None) -> int:
         sys.stderr.write("multichip: no measurable chip counts\n")
         return 2
 
-    scaling = run_scaling(ns.ops, ns.n, ns.nb, chips, ns.nruns)
+    scaling = run_scaling(ns.ops, ns.n, ns.nb, chips, ns.nruns,
+                          devprof=ns.devprof)
     doc = ledger_doc(scaling, ns.n)
 
     rc = 0
@@ -243,6 +300,8 @@ def main(argv=None) -> int:
                            prec=sec["prec"],
                            runs_s=[pt["median_s"]],
                            gflops=pt["gflops"])
+                if pt.get("devprof") is not None:
+                    rep.add_devprof(pt["devprof"])
         rep.entries.extend(doc["ladder"])
         rep.write(ns.report)
         print(f"# multichip: run-report written to {ns.report}")

@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v13), the bench
+(``--report`` from any driver, any schema vintage v1-v14), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -41,13 +41,27 @@ Comparable metrics extracted from each document:
   ``racefuzz.invariant_failures``, lower is better) from a
   ``{"racefuzz": ...}`` section (``python -m
   dplasma_tpu.analysis.racefuzz --report`` writes one; the
-  ``tools/lint_all.py`` threadcheck gate prints the same counters).
+  ``tools/lint_all.py`` threadcheck gate prints the same counters);
+* measured-ICI attribution (``<label>.devprof.ici_achieved_frac``,
+  HIGHER is better — the worst per-collective achieved fraction of
+  the ICI peak — and ``<label>.devprof.skew``, lower is better, the
+  cross-rank busy-seconds spread) from a run-report's ``devprof``
+  section (schema v14, ``--devprof`` on any driver). Skew is a
+  near-zero noise-dominated fraction like trace overhead, so its
+  default threshold is the wide 100% relative bound.
 
 Exit codes: 0 = no regression, 1 = regression past threshold,
 2 = unusable input (unreadable doc, or a candidate with no
 extractable metrics at all). Candidate metrics ABSENT from the
 baseline are informational — noted, never gated (the first entry of a
 new metric family, e.g. serving.*, seeds the next comparison).
+
+``--json[=PATH]`` additionally writes the machine-readable verdict
+(every compared row with its ratio and threshold, the regression
+list, the worst offender, and an ``exit_code`` field that MIRRORS the
+process exit code — including the 2 of an unusable input) to PATH, or
+to stdout for ``-``/no value, so CI can consume the verdict without
+re-parsing human lines.
 
 Standalone by design: stdlib-only (no jax import), so the gate runs
 anywhere — including the repo lint aggregate (``tools/lint_all.py``)
@@ -63,9 +77,10 @@ from typing import Dict, Optional
 DEFAULT_THRESHOLD = 0.10   # 10% relative regression
 
 #: per-metric-suffix default thresholds (caller --metric-threshold
-#: still wins): trace overhead is a near-zero, noise-dominated
-#: fraction — a 10% RELATIVE bound would flag 0.020 -> 0.023
-DEFAULT_METRIC_THRESHOLDS = {"trace_overhead_frac": 1.0}
+#: still wins): trace overhead and cross-rank skew are near-zero,
+#: noise-dominated fractions — a 10% RELATIVE bound would flag
+#: 0.020 -> 0.023
+DEFAULT_METRIC_THRESHOLDS = {"trace_overhead_frac": 1.0, "skew": 1.0}
 
 
 # ------------------------------------------------------------- loading
@@ -195,6 +210,29 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
         if lbl and isinstance(v, (int, float)) and v > 0:
             out[f"{lbl}.hlocheck.hbm_peak_bytes"] = {
                 "value": float(v), "better": "lower"}
+    for e in doc.get("devprof") or []:
+        # measured-ICI attribution (schema v14): the WORST per-class
+        # achieved fraction of the ICI peak (higher-better — one
+        # collective class falling off the wire drags the metric even
+        # when the others hold), and the cross-rank busy-seconds skew
+        # (lower-better — a growing straggler gap is a regression)
+        if not isinstance(e, dict):
+            continue
+        lbl = e.get("label") or e.get("op")
+        if not lbl:
+            continue
+        fracs = [c.get("achieved_frac")
+                 for c in e.get("collectives") or []
+                 if isinstance(c, dict) and isinstance(
+                     c.get("achieved_frac"), (int, float))]
+        if fracs:
+            out[f"{lbl}.devprof.ici_achieved_frac"] = {
+                "value": float(min(fracs)), "better": "higher"}
+        skew = (e.get("skew") or {}).get("value") \
+            if isinstance(e.get("skew"), dict) else None
+        if isinstance(skew, (int, float)) and skew >= 0:
+            out[f"{lbl}.devprof.skew"] = {"value": float(skew),
+                                          "better": "lower"}
     rf = doc.get("racefuzz")
     if isinstance(rf, dict):
         # the threadcheck gate's schedule-fuzz surface: fewer
@@ -328,6 +366,30 @@ def format_result(res: dict, verbose: bool = False) -> list:
     return lines
 
 
+def verdict_doc(res: dict, exit_code: int, threshold: float,
+                baseline: str, candidate: str) -> dict:
+    """The machine-readable ``--json`` verdict: every compared row,
+    the regression list, the worst offender, and an ``exit_code``
+    that mirrors the process exit code."""
+    return {"perfdiff": 1, "ok": res["ok"], "exit_code": exit_code,
+            "threshold": threshold,
+            "baseline": baseline, "candidate": candidate,
+            "compared": res["compared"], "rows": res["rows"],
+            "regressions": [r["metric"] for r in res["regressions"]],
+            "worst": res["worst"],
+            "missing_metrics": res.get("missing") or [],
+            "new_metrics": res.get("new") or []}
+
+
+def _emit_json(dst: str, doc: dict) -> None:
+    text = json.dumps(doc, indent=2, sort_keys=True)
+    if dst == "-":
+        print(text)
+    else:
+        with open(dst, "w") as f:
+            f.write(text + "\n")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="perfdiff", description=__doc__.splitlines()[0])
@@ -343,6 +405,12 @@ def main(argv=None) -> int:
                     metavar="NAME=FRAC",
                     help="per-metric threshold override (full name or "
                          "bare suffix, e.g. median_s=0.25); repeatable")
+    ap.add_argument("--json", nargs="?", const="-", default=None,
+                    metavar="PATH", dest="json_out",
+                    help="write the machine-readable verdict JSON to "
+                         "PATH (use --json=PATH; bare --json or '-' "
+                         "writes to stdout); its exit_code field "
+                         "mirrors the process exit code")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print every compared metric, not just "
                          "regressions")
@@ -363,6 +431,16 @@ def main(argv=None) -> int:
         old_doc, new_doc = load_doc(ns.old), load_doc(ns.new)
     except (OSError, ValueError) as exc:
         sys.stderr.write(f"perfdiff: {exc}\n")
+        if ns.json_out:
+            # the machine consumer still gets a verdict on an
+            # unusable input — exit_code 2, no rows
+            _emit_json(ns.json_out, {
+                "perfdiff": 1, "ok": False, "exit_code": 2,
+                "threshold": ns.threshold, "baseline": ns.old,
+                "candidate": ns.new, "compared": 0, "rows": [],
+                "regressions": [], "worst": None,
+                "missing_metrics": [], "new_metrics": [],
+                "error": str(exc)})
         return 2
     res = compare(old_doc, new_doc, ns.threshold, per)
     for line in format_result(res, verbose=ns.verbose):
@@ -371,8 +449,13 @@ def main(argv=None) -> int:
         # nothing in common: candidate-only metrics are informational
         # (a new metric family's first entry must not break the gate);
         # a candidate with NO extractable metrics at all is unusable
-        return 0 if res.get("new") else 2
-    return 0 if res["ok"] else 1
+        code = 0 if res.get("new") else 2
+    else:
+        code = 0 if res["ok"] else 1
+    if ns.json_out:
+        _emit_json(ns.json_out, verdict_doc(
+            res, code, ns.threshold, ns.old, ns.new))
+    return code
 
 
 if __name__ == "__main__":

@@ -24,8 +24,14 @@ phase ledger, on one rebased, time-monotone axis::
 ``tools/servebench.py --spans``); ``--phases`` takes either a
 run-report with per-op ``"phases"`` sections or a raw
 ``PhaseLedger.summary()`` row list (durations only — its lane is a
-synthetic end-to-end layout, labelled as such). Both flags repeat.
-``--lax`` applies to every ``.prof`` input.
+synthetic end-to-end layout, labelled as such); ``--flight`` takes a
+flight-recorder dump (MCA ``telemetry.flight_path`` / a run-report's
+``"telemetry"]["flight"]`` doc written to a file) and renders each
+event as a Perfetto INSTANT pin at its real timestamp; ``--devprof``
+takes a run-report with ``"devprof"`` sections (schema v14, any
+driver's ``--devprof``) and lays the attributed category seconds and
+measured per-collective seconds out as synthetic lanes. All four
+flags repeat. ``--lax`` applies to every ``.prof`` input.
 """
 from __future__ import annotations
 
@@ -83,11 +89,55 @@ def _load_span_doc(path: str) -> dict:
     return doc
 
 
-def merge(trace_paths, serving=(), phases=(), strict: bool = True,
-          name: str = "merged") -> dict:
-    """Fuse rank traces + serving spans + phase ledgers into one
-    Chrome trace-event document (observability.chrome.merge_to_chrome
-    does the lane/timebase work)."""
+def _load_flight_doc(path: str) -> dict:
+    """One ``--flight`` input: a flight-recorder dump (the
+    ``dplasma_flight_recorder`` doc :meth:`FlightRecorder.dump`
+    writes on an incident), or a run-report whose ``"telemetry"``
+    section embeds the same ring as ``flight_recorder``."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "dplasma_flight_recorder" not in doc:
+        # accept a whole run-report: pull its embedded event ring
+        tl = doc.get("telemetry")
+        fl = tl.get("flight_recorder") if isinstance(tl, dict) else None
+        if isinstance(fl, dict) and isinstance(fl.get("events"), list):
+            return {"dplasma_flight_recorder": 1, **fl}
+    if not isinstance(doc, dict) \
+            or "dplasma_flight_recorder" not in doc:
+        raise ValueError(f"{path}: not a flight-recorder dump (want "
+                         f"a dplasma_flight_recorder doc or a "
+                         f"run-report with a telemetry."
+                         f"flight_recorder section)")
+    return doc
+
+
+def _load_devprof_tables(path: str) -> list:
+    """``--devprof`` rows from one run-report: each ``"devprof"``
+    entry (schema v14) becomes one labelled synthetic lane."""
+    with open(path) as f:
+        doc = json.load(f)
+    base = os.path.basename(path)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a run-report")
+    tables = []
+    for e in doc.get("devprof") or []:
+        if isinstance(e, dict) and isinstance(e.get("categories"),
+                                              dict):
+            tables.append((f"{base}:{e.get('label') or e.get('op') or '?'}",
+                           e))
+    if not tables:
+        raise ValueError(f"{path}: no devprof entries found (want a "
+                         f"run-report written with --devprof, "
+                         f"schema v14)")
+    return tables
+
+
+def merge(trace_paths, serving=(), phases=(), flight=(), devprof=(),
+          strict: bool = True, name: str = "merged") -> dict:
+    """Fuse rank traces + serving spans + phase ledgers + flight
+    events + devprof attributions into one Chrome trace-event
+    document (observability.chrome.merge_to_chrome does the
+    lane/timebase work)."""
     from dplasma_tpu.observability.chrome import merge_to_chrome
     from dplasma_tpu.utils.profiling import decode_wire_events
 
@@ -102,7 +152,13 @@ def merge(trace_paths, serving=(), phases=(), strict: bool = True,
     tables = []
     for p in phases:
         tables.extend(_load_phase_tables(p))
-    return merge_to_chrome(profiles, span_docs, tables, name=name)
+    flight_docs = [_load_flight_doc(p) for p in flight]
+    dtables = []
+    for p in devprof:
+        dtables.extend(_load_devprof_tables(p))
+    return merge_to_chrome(profiles, span_docs, tables,
+                           flight_docs=flight_docs,
+                           devprof_tables=dtables, name=name)
 
 
 def main(argv=None) -> int:
@@ -132,15 +188,30 @@ def main(argv=None) -> int:
                          "or raw summary rows) to merge as a "
                          "synthetic lane; repeatable, requires "
                          "--merge")
+    ap.add_argument("--flight", action="append", default=[],
+                    metavar="FLIGHT_JSON",
+                    help="flight-recorder dump (or run-report with a "
+                         "telemetry.flight section) to merge as an "
+                         "instant-event pin lane; repeatable, "
+                         "requires --merge")
+    ap.add_argument("--devprof", action="append", default=[],
+                    metavar="REPORT_JSON",
+                    help="run-report with \"devprof\" sections "
+                         "(schema v14) to merge as attributed "
+                         "category/collective lanes; repeatable, "
+                         "requires --merge")
     ns = ap.parse_args(argv)
-    if not ns.merge and (len(ns.trace) > 1 or ns.serving or ns.phases):
+    if not ns.merge and (len(ns.trace) > 1 or ns.serving or ns.phases
+                         or ns.flight or ns.devprof):
         sys.stderr.write("tracecat: multiple traces / --serving / "
-                         "--phases require --merge\n")
+                         "--phases / --flight / --devprof require "
+                         "--merge\n")
         return 2
     try:
         if ns.merge:
             doc = merge(ns.trace, serving=ns.serving,
-                        phases=ns.phases, strict=not ns.lax)
+                        phases=ns.phases, flight=ns.flight,
+                        devprof=ns.devprof, strict=not ns.lax)
         else:
             doc = convert(ns.trace[0], strict=not ns.lax)
     except (OSError, ValueError, EOFError) as exc:
