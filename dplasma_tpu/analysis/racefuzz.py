@@ -33,6 +33,19 @@ verified by eye. One probe per historical race class:
   gauge must publish in one critical section; invariant: the gauge
   agrees with the state at quiescence and no stale publish was
   observed mid-run.
+* ``orphaned_future`` — threads race ``SolveFuture`` resolution,
+  failure, and ``result(timeout=)`` waits against a service whose
+  dispatch is dead; invariant: a blocked caller NEVER hangs (every
+  orphaned wait raises :class:`ServingTimeout` naming its request
+  id) and ``serving_resolved_total`` counts each future exactly once
+  no matter how many resolve/fail calls race it.
+* ``admission`` — concurrent admission decisions, SLO observations,
+  breaker transitions, and retry-budget takes against one
+  :class:`AdmissionController`; invariants: **decision
+  conservation** (admitted + shed == decides, degraded <= admitted),
+  the breaker-state gauges agree with the recomputed table at
+  quiescence, and the retry ledger equals the granted takes without
+  ever exceeding the budget.
 
 **Determinism contract**: the *schedule* — which ops each thread runs,
 in which per-thread order — is a pure function of ``(probe, seed)``
@@ -470,6 +483,173 @@ def _probe_gauge_publish(seed: int, nthreads: int, nops: int,
     return failures, {"threads": plans}
 
 
+def _probe_orphaned_future(seed: int, nthreads: int, nops: int,
+                           factory: Optional[Callable] = None
+                           ) -> Tuple[List[str], dict]:
+    import types
+    from dplasma_tpu.observability.metrics import MetricsRegistry
+    from dplasma_tpu.serving import service as svc_mod
+    from dplasma_tpu.serving.admission import ServingTimeout
+    # a service whose dispatch is DEAD: _drive is a no-op, so a future
+    # nobody resolves stays pending forever — result(timeout=) is the
+    # only thing standing between the caller and a hang
+    make = factory or (lambda: types.SimpleNamespace(
+        metrics=MetricsRegistry(), _drive=lambda group: None))
+    stub = make()
+    rng = _rng("orphaned_future", seed)
+    n = max(nops // 4, 8)
+    futs = []
+    for i in range(n):
+        f = svc_mod.SolveFuture(stub, group=None)
+        f.request_id = i + 1
+        futs.append(f)
+    plans = [[(rng.randrange(n),
+               rng.choice(("resolve", "fail", "wait")))
+              for _ in range(nops)] for _ in range(nthreads)]
+    wrong_ids: List[str] = []
+
+    def worker(plan):
+        def go():
+            for idx, act in plan:
+                f = futs[idx]
+                if act == "resolve":
+                    f._resolve(idx, {"request_id": f.request_id})
+                elif act == "fail":
+                    f._fail(RuntimeError("racefuzz"))
+                else:
+                    try:
+                        f.result(timeout=0.001)
+                    except ServingTimeout as exc:
+                        if exc.request_id != f.request_id:
+                            wrong_ids.append(
+                                f"ServingTimeout names request "
+                                f"{exc.request_id}, expected "
+                                f"{f.request_id}")
+                    except RuntimeError:
+                        pass        # the injected _fail payload
+        return go
+
+    errors = _run_threads([worker(p) for p in plans],
+                          SWITCH_INTERVAL)
+    failures = list(errors) + wrong_ids
+    touched = {idx for p in plans for idx, act in p
+               if act in ("resolve", "fail")}
+    m = stub.metrics.get("serving_resolved_total")
+    resolved = int(m.value) if m is not None else 0
+    if resolved != len(touched):
+        failures.append(f"resolution conservation broken: "
+                        f"serving_resolved_total {resolved} != "
+                        f"{len(touched)} futures touched (a racing "
+                        f"resolve/fail double-counted or lost one)")
+    for i in range(n):
+        if i in touched:
+            continue
+        try:
+            futs[i].result(timeout=0.002)
+            failures.append(f"orphaned future {i + 1} returned "
+                            f"without ever being resolved")
+        except ServingTimeout:
+            pass        # the contract: structured, prompt, attributable
+    return failures, {"threads": plans}
+
+
+def _probe_admission(seed: int, nthreads: int, nops: int,
+                     factory: Optional[Callable] = None
+                     ) -> Tuple[List[str], dict]:
+    from dplasma_tpu.observability.metrics import MetricsRegistry
+    from dplasma_tpu.observability.telemetry import FlightRecorder
+    from dplasma_tpu.serving import admission as adm
+    make = factory or (lambda: adm.AdmissionController(
+        metrics=MetricsRegistry(),
+        flight=FlightRecorder(capacity=64),
+        max_queue=8, max_inflight=4, slo_p99_ms=50.0,
+        breaker_failures=2, breaker_cooldown_s=0.0,
+        retry_budget=25))
+    ctrl = make()
+    rng = _rng("admission", seed)
+    ops_pool = ("posv", "gesv")
+    rungs = ("retry", "algo_fallback")
+    plans = []
+    for _ in range(nthreads):
+        plan = []
+        for _ in range(nops):
+            r = rng.random()
+            if r < 0.4:
+                plan.append(("decide", rng.choice(ops_pool),
+                             rng.randrange(12), rng.randrange(6)))
+            elif r < 0.6:
+                plan.append(("observe",
+                             round(rng.uniform(0.0, 0.2), 4)))
+            elif r < 0.75:
+                plan.append(("ballow", rng.choice(ops_pool),
+                             rng.choice(rungs)))
+            elif r < 0.9:
+                plan.append(("brec", rng.choice(ops_pool),
+                             rng.choice(rungs), rng.random() < 0.5))
+            else:
+                plan.append(("retry",))
+        plans.append(plan)
+    granted = [0] * nthreads   # per-thread slot: no shared counter
+
+    def worker(tid, plan):
+        def go():
+            for op in plan:
+                if op[0] == "decide":
+                    ctrl.decide(op[1], op[2], op[3])
+                elif op[0] == "observe":
+                    ctrl.observe(op[1])
+                elif op[0] == "ballow":
+                    ctrl.breaker_allow(op[1], op[2])
+                elif op[0] == "brec":
+                    ctrl.breaker_record(op[1], op[2], op[3])
+                elif ctrl.take_retry():
+                    granted[tid] += 1
+        return go
+
+    errors = _run_threads(
+        [worker(i, p) for i, p in enumerate(plans)], SWITCH_INTERVAL)
+    failures = list(errors)
+
+    def _c(name):
+        m = ctrl.metrics.get(name)
+        return int(m.value) if m is not None else 0
+
+    decides = sum(1 for p in plans for op in p if op[0] == "decide")
+    admitted, shed = _c("serving_admitted_total"), \
+        _c("serving_shed_total")
+    if admitted + shed != decides:
+        failures.append(f"decision conservation broken: "
+                        f"admitted({admitted}) + shed({shed}) != "
+                        f"{decides} decides")
+    if _c("serving_degraded_total") > admitted:
+        failures.append(f"degraded({_c('serving_degraded_total')}) "
+                        f"exceeds admitted({admitted}) — a degrade "
+                        f"that was not also admitted")
+    with ctrl._lock:
+        nopen = sum(1 for b in ctrl._breakers.values()
+                    if b["state"] == adm.OPEN)
+        nhalf = sum(1 for b in ctrl._breakers.values()
+                    if b["state"] == adm.HALF_OPEN)
+    for gname, expect in (("serving_breaker_open", nopen),
+                          ("serving_breaker_half_open", nhalf)):
+        g = ctrl.metrics.get(gname)
+        val = int(g.value) if g is not None else 0
+        if val != expect:
+            failures.append(f"gauge {gname} = {val} disagrees with "
+                            f"the recomputed breaker table "
+                            f"({expect}) at quiescence — stale "
+                            f"publish stuck")
+    takes = sum(granted)
+    used = ctrl.summary()["retry_budget"]["used"]
+    if used != takes:
+        failures.append(f"retry ledger {used} != {takes} granted "
+                        f"takes (lost/double-counted budget units)")
+    if ctrl.retry_budget > 0 and used > ctrl.retry_budget:
+        failures.append(f"retry budget overrun: used {used} > "
+                        f"budget {ctrl.retry_budget}")
+    return failures, {"threads": plans}
+
+
 #: probe name -> implementation; the keys ARE the fuzz surface the
 #: lint gate sizes (perfdiff gates schedules_run against shrinking)
 PROBES: Dict[str, Callable] = {
@@ -480,6 +660,8 @@ PROBES: Dict[str, Callable] = {
     "tracer_ledger": _probe_tracer_ledger,
     "flight_ring": _probe_flight_ring,
     "gauge_publish": _probe_gauge_publish,
+    "orphaned_future": _probe_orphaned_future,
+    "admission": _probe_admission,
 }
 
 

@@ -140,8 +140,22 @@ GUARDS: Dict[str, Guard] = {
         receivers={"cache": "ExecutableCache",
                    "metrics": "MetricsRegistry",
                    "telemetry.flight": "FlightRecorder",
-                   "telemetry.tracer": "Tracer"},
+                   "telemetry.tracer": "Tracer",
+                   "admission": "AdmissionController"},
         reentrant=True),
+    # serving/admission.py — decide() runs inside the submit critical
+    # section (caller threads) while observe()/breaker_record() fire
+    # from dispatch/timer threads; one plain Lock guards the EWMA
+    # tracker, the breaker table, and the retry ledger. The EWMA is
+    # mode "w": decide's single float read is GIL-atomic by the same
+    # discipline as Counter.value.
+    "AdmissionController": Guard(
+        lock="_lock",
+        attrs={"_ewma_p99_ms": "w", "_observed": "rw",
+               "_breakers": "rw", "_retries_used": "rw"},
+        under_lock=frozenset({"_publish_breaker_gauges", "_breaker"}),
+        receivers={"metrics": "MetricsRegistry",
+                   "flight": "FlightRecorder"}),
     # observability/metrics.py — serving observes from caller AND
     # timer threads while the exporter reads percentiles; the spill
     # transition (r14-i) is a check-then-act that crashes unlocked.
@@ -214,6 +228,10 @@ PUBLISH_UNDER: Dict[str, str] = {
     "serving_queue_depth": "SolverService._lock",
     "serving_inflight_batches": "SolverService._lock",
     "serving_cache_entries": "ExecutableCache._lock",
+    # breaker-state gauges publish inside the same critical section
+    # that mutated the breaker table (admission._publish_breaker_gauges)
+    "serving_breaker_open": "AdmissionController._lock",
+    "serving_breaker_half_open": "AdmissionController._lock",
 }
 
 #: callee name -> (package prefix, lock id): calls that mutate
@@ -233,6 +251,16 @@ EXTRA_EDGES: Sequence[Tuple[str, str, str]] = (
     ("MetricsRegistry._lock", "Histogram._lock",
      "MetricsRegistry.snapshot() reads each histogram's stats() "
      "under the registry lock"),
+    ("SolverService._lock", "AdmissionController._lock",
+     "submit() consults the admission controller inside the "
+     "scheduler critical section (decide is lock-free today; the "
+     "ordering is declared so it may take the lock tomorrow)"),
+    ("AdmissionController._lock", "Histogram._lock",
+     "observe() re-reads the serving_latency_s percentile under the "
+     "controller lock when folding the EWMA"),
+    ("AdmissionController._lock", "FlightRecorder._lock",
+     "breaker transitions record flight events under the controller "
+     "lock (_flight inside breaker_allow/breaker_record)"),
 )
 
 #: method names whose call mutates the receiver container

@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 14``).
+"""The versioned JSON run-report (``"schema": 15``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -128,6 +128,21 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                                     "truncated"},
                   "diagnostics": [{"kind", "op", "message"}],
                   "ok"}],                                  # (v14)
+     "admission": {"enabled", "max_queue", "max_inflight",
+                   "slo_p99_ms", "ewma_p99_ms",
+                   "admitted", "shed", "degraded",
+                   "deadline_expired", "breaker_opens",
+                   "breakers": {"op:rung": {"state", "failures",
+                                            "opens", "probes"}},
+                   "retry_budget": {"limit", "used"},
+                   "audit": {"submitted", "admitted", "shed",
+                             "resolved", "lost", "flight_shed_seen",
+                             "flight_dropped",
+                             "balanced"} | absent},        # (v15,
+                                 # serving.admission: the overload
+                                 # posture's end-of-run record; the
+                                 # audit subkey is servebench --soak's
+                                 # conservation proof)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
@@ -175,9 +190,19 @@ op-name vocabulary hlocheck parses, per-collective measured seconds
 and the roofline ``ici`` peak, per-rank skew/straggler attribution,
 and the merged-timeline critical path; perfdiff gates
 ``devprof.ici_achieved_frac`` higher-better and ``devprof.skew``
-lower-better). All additive — v1 readers of the other keys are
-unaffected; this reader accepts <= 14 (:func:`load_report` tolerates
-every v1-v14 vintage, filling the always-present keys).
+lower-better); 15 adds ``"admission"`` (the serving overload
+posture's end-of-run record — serving.admission: admission-control
+counters (admitted/shed/degraded/deadline-expired), the EWMA-p99 SLO
+tracker state, per-(op, rung) circuit-breaker states, the global
+retry budget, and — from ``tools/servebench.py --soak`` — the
+conservation audit proving submitted == resolved + shed with zero
+lost futures, reconciled against the flight-recorder ring; perfdiff
+gates ``serving.shed_frac`` and ``serving.deadline_miss_frac``
+lower-better, and servebench's ``"serving"`` entries gain
+``admission_overhead_frac``, gated like ``trace_overhead_frac``).
+All additive — v1 readers of the other keys are unaffected; this
+reader accepts <= 15 (:func:`load_report` tolerates every v1-v15
+vintage, filling the always-present keys).
 """
 from __future__ import annotations
 
@@ -189,7 +214,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 14
+REPORT_SCHEMA = 15
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -233,6 +258,7 @@ class RunReport:
         self.scaling: List[dict] = []   # per-chip-count curves (v12)
         self.telemetry: Optional[dict] = None  # live instruments (v13)
         self.devprof: List[dict] = []   # measured-timeline attribution (v14)
+        self.admission: Optional[dict] = None  # overload posture (v15)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
         self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
@@ -328,6 +354,14 @@ class RunReport:
         self.devprof.append(entry)
         return entry
 
+    def add_admission(self, summary: dict) -> dict:
+        """Record the serving overload posture's end-of-run summary
+        (schema v15; see serving.admission.AdmissionController.summary
+        — servebench --soak adds the ``"audit"`` conservation
+        subkey)."""
+        self.admission = summary
+        return summary
+
     def add_roofline(self, entry: dict) -> dict:
         """Record one per-op roofline ledger entry (schema v5; see
         observability.roofline.op_roofline)."""
@@ -373,6 +407,8 @@ class RunReport:
             doc["telemetry"] = self.telemetry
         if self.devprof:
             doc["devprof"] = self.devprof
+        if self.admission is not None:
+            doc["admission"] = self.admission
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
         if self.roofline:
@@ -407,7 +443,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v13) loads: the schema history is purely
+    Every older vintage (v1-v14) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

@@ -19,7 +19,8 @@ own evidence when something breaks. Three pieces:
   Prometheus-compatible collector, with zero cost on the request path.
 * :class:`FlightRecorder` — a bounded ring of structured events
   (submits, dispatches, gate failures, ladder rungs, injections, cache
-  evictions; MCA ``telemetry.flight_events`` bounds it) cheap enough
+  evictions, admission decisions, deadline expiries, breaker
+  transitions; MCA ``telemetry.flight_events`` bounds it) cheap enough
   to leave on; dumped into the run-report (schema v13 ``"telemetry"``
   section) and — when MCA ``telemetry.flight_path`` is set — to disk
   the moment a request fails its gate or walks the remediation
@@ -356,6 +357,17 @@ class FlightRecorder:
         with self._lock:
             self._d.clear()
             self._seq = 0
+
+    def counts(self) -> Dict[str, int]:
+        """Per-kind event counts of what the ring still HOLDS (dropped
+        events are not re-counted) — the soak audit reconciles these
+        against the admission counters, with ``summary()['dropped']``
+        bounding the discrepancy a shed storm may cause."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for ev in self._d:
+                out[ev["kind"]] = out.get(ev["kind"], 0) + 1
+            return out
 
     def summary(self) -> dict:
         """The flight-recorder half of the schema-v13 ``"telemetry"``

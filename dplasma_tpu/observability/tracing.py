@@ -182,6 +182,15 @@ class Tracer:
             return _NoopSpan(attrs)
         return _LiveSpan(self, name, request, attrs)
 
+    def instant(self, name: str, request: Optional[int] = None,
+                **attrs) -> None:
+        """Record a zero-width marker span at "now" — point decisions
+        (an admission shed, a deadline expiry) land on the request
+        timeline without an enclosing context manager. Rides
+        :meth:`add`, so the open/close ledger stays balanced."""
+        t = time.time_ns()
+        self.add(name, t, t, request=request, **attrs)
+
     def add(self, name: str, t0_ns: int, t1_ns: int,
             request: Optional[int] = None, track: Optional[int] = None,
             **attrs) -> None:

@@ -2,13 +2,26 @@
 
 A :class:`FaultPlan` (CLI ``--inject=KIND@STAGE[:RATE[:COUNT]]``, env
 ``DPLASMA_INJECT``) corrupts the output of chosen kernel *stages* with
-one of four fault models:
+one of six fault models:
 
 - ``bitflip`` — XOR one seeded bit of one seeded element (the classic
   soft-error model: a silent, finite, wrong value);
 - ``nan`` / ``inf`` — poison one seeded element (a NaN-producing
   kernel / overflowed accumulation);
-- ``zero`` — zero the whole tapped tile/panel (a torn write).
+- ``zero`` — zero the whole tapped tile/panel (a torn write);
+- ``delay`` — a *behavioral* fault: the tap sleeps MCA
+  ``chaos.delay_ms`` and returns the value untouched (a straggler
+  device / preempted host thread — exercises deadlines and SLO
+  shedding, not checksums);
+- ``reject`` — a behavioral fault: the tap raises
+  :class:`InjectedReject` (a compile/dispatch failure surfacing as an
+  exception — exercises the remediation ladder and circuit breakers).
+
+Value kinds are pure ``jnp`` transforms applied at trace time; the
+behavioral kinds act host-side in :func:`tap` itself and never touch
+the traced program. :func:`parse_schedule` strings plans into a
+scripted *chaos schedule* (comma-separated phases, ``off`` = quiet)
+that ``tools/servebench.py --soak`` arms window by window.
 
 Stages are the tile-kernel choke points in :mod:`kernels.blas`
 (``gemm``, ``trsm``, ``potrf``, ``getrf``) plus the wildcard ``any``.
@@ -31,9 +44,27 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import hashlib
+import time
 from typing import List, Optional
 
-KINDS = ("bitflip", "nan", "inf", "zero")
+from dplasma_tpu.utils import config as _cfg
+
+_cfg.mca_register(
+    "chaos.delay_ms", "50",
+    "Straggler stall injected by the 'delay' fault kind, in "
+    "milliseconds per faulting tap site.")
+
+KINDS = ("bitflip", "nan", "inf", "zero", "delay", "reject")
+
+#: kinds that act host-side in tap() (sleep / raise) instead of
+#: corrupting the traced value — they skip the inexact-dtype check
+#: and never reach corrupt()
+BEHAVIORAL_KINDS = ("delay", "reject")
+
+
+class InjectedReject(RuntimeError):
+    """Raised by the ``reject`` fault kind at a tapped site — the
+    deterministic stand-in for a compile/dispatch failure."""
 
 #: stage names with a tap in the kernel layer, plus the serving
 #: front-end's per-request response tap (``any`` matches all)
@@ -81,11 +112,50 @@ def parse_plan(spec: str, seed: int = 3872) -> FaultPlan:
     if not at or not rest:
         raise ValueError(
             f"bad inject spec {spec!r}: expected KIND@STAGE[:RATE[:COUNT]]")
+    if kind.lower() not in KINDS:
+        # validate at PARSE time with the full spec in the message: a
+        # typo'd DPLASMA_INJECT=bitlfip@gemm must die here, at the
+        # boundary, not deep inside FaultPlan construction
+        raise ValueError(
+            f"bad inject spec {spec!r}: unknown fault kind "
+            f"{kind.lower()!r} (valid kinds: {', '.join(KINDS)})")
     parts = rest.split(":")
     stage = parts[0]
     rate = float(parts[1]) if len(parts) > 1 and parts[1] else 1.0
     count = int(parts[2]) if len(parts) > 2 and parts[2] else 1
     return FaultPlan(kind.lower(), stage.lower(), rate, count, seed)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPhase:
+    """One window of a scripted chaos schedule: the original spec text
+    plus its parsed plan (``None`` for a quiet phase)."""
+
+    spec: str
+    plan: Optional[FaultPlan]
+
+
+def parse_schedule(text: str, seed: int = 3872) -> List[ChaosPhase]:
+    """Parse a comma-separated chaos schedule into phases.
+
+    ``nan@serving:1:2,off,delay@serving:0.5:0`` = three equal traffic
+    windows: poison two serving responses, run clean, then stall ~half
+    the serving taps. ``off``/``none``/``-`` (or an empty field) is a
+    quiet phase. Each armed phase gets a distinct seed (``seed + k``)
+    so identical specs in different windows corrupt different sites.
+    """
+    if not text.strip():
+        raise ValueError("empty chaos schedule")
+    phases: List[ChaosPhase] = []
+    for k, field in enumerate(text.split(",")):
+        spec = field.strip()
+        if not spec or spec.lower() in ("off", "none", "-"):
+            phases.append(ChaosPhase(spec or "off", None))
+        else:
+            phases.append(ChaosPhase(spec, parse_plan(spec, seed + k)))
+    if not phases:
+        raise ValueError("empty chaos schedule")
+    return phases
 
 
 class _Session:
@@ -245,6 +315,19 @@ def tap(stage: str, x):
     if _site_u01(plan.seed, stage, site) >= min(plan.rate, 1.0) \
             and plan.rate < 1.0:
         return x
+    if plan.kind in BEHAVIORAL_KINDS:
+        # host-side faults: no dtype requirement, nothing staged into
+        # the traced program — record first so the campaign budget is
+        # charged even when the tap raises
+        _S.faults.append({"stage": stage, "site": site,
+                          "kind": plan.kind})
+        if plan.kind == "delay":
+            time.sleep(
+                max(_cfg.mca_get_float("chaos.delay_ms", 50.0), 0.0)
+                / 1000.0)
+            return x
+        raise InjectedReject(
+            f"injected reject at {stage} site {site}")
     import jax.numpy as jnp
     if not hasattr(x, "dtype") or not jnp.issubdtype(
             jnp.dtype(x.dtype), jnp.inexact):

@@ -17,14 +17,30 @@ high-throughput, latency-measured service:
   ``submit() -> future`` handles, a batching scheduler
   (``serving.max_batch`` / ``serving.max_wait_ms``), result scatter,
   and a per-request resilience ladder (classify -> retry -> escalate)
-  that heals a failed request without poisoning its batch-mates.
+  that heals a failed request without poisoning its batch-mates;
+* :mod:`~dplasma_tpu.serving.admission` — the overload posture:
+  admission control (queue/inflight caps + an EWMA p99 SLO tracker
+  shedding with :class:`AdmissionError` or degrading IR requests to a
+  cheaper precision rung), per-request deadlines
+  (:class:`DeadlineExceeded`), per-(op, rung) circuit breakers, and a
+  process-global ladder retry budget — every decision a
+  flight-recorder event by request id.
 
 ``tools/servebench.py`` drives a synthetic open-loop workload through
 the service and records solves/sec + p50/p99 latency + cache hit-rate
-into the run-report schema v8 ``"serving"`` section, gated by
-``tools/perfdiff.py``.
+into the run-report ``"serving"`` section, gated by
+``tools/perfdiff.py``; ``--soak`` replays sustained mixed traffic
+under a scripted chaos schedule and closes with a conservation audit
+(submitted == resolved + shed, zero lost futures) emitted into the
+schema-v15 ``"admission"`` section.
 """
-from dplasma_tpu.serving import batched, cache, service
+from dplasma_tpu.serving import admission, batched, cache, service
+from dplasma_tpu.serving.admission import (AdmissionController,
+                                           AdmissionError,
+                                           DeadlineExceeded,
+                                           ServingTimeout)
 from dplasma_tpu.serving.service import SolveFuture, SolverService
 
-__all__ = ["batched", "cache", "service", "SolverService", "SolveFuture"]
+__all__ = ["admission", "batched", "cache", "service", "SolverService",
+           "SolveFuture", "AdmissionController", "AdmissionError",
+           "DeadlineExceeded", "ServingTimeout"]

@@ -112,12 +112,17 @@ class CacheKey(NamedTuple):
 
 def make_key(op: str, n: int, dtype, batch: int, nrhs: int,
              policy: Optional[str] = None,
-             extra: Tuple = ()) -> CacheKey:
+             extra: Tuple = (),
+             precision: Optional[str] = None) -> CacheKey:
     """Bucket a raw request shape into its executable key. Pure
     function of the arguments + the MCA tier (grid from the active
     mesh, pipeline shape from ``sweep.*``, ``ir.precision`` for IR
     ops) — determinism is load-bearing: the scheduler groups requests
-    by this key."""
+    by this key. ``precision`` overrides the ambient ``ir.precision``
+    for IR ops (the admission layer's degrade-under-pressure rung
+    keys its cheaper executable separately); the service pins the
+    key's precision back onto the compile, so key and executable
+    always agree."""
     from dplasma_tpu.ops._sweep import sweep_params
     from dplasma_tpu.parallel import mesh as pmesh
     m = pmesh.active()
@@ -129,7 +134,7 @@ def make_key(op: str, n: int, dtype, batch: int, nrhs: int,
     prec = ""
     if op.endswith("_ir"):
         from dplasma_tpu.ops.refine import ir_params
-        prec, _, _ = ir_params()
+        prec, _, _ = ir_params(precision=precision)
     return CacheKey(op=op, n=bucket_dim(n, policy),
                     dtype=jnp.dtype(dtype).name,
                     batch=bucket_batch(batch),

@@ -43,6 +43,22 @@ and walks the ladder. Live gauges (``serving_queue_depth``,
 ``serving_inflight_batches``, ``serving_cache_entries``) feed the
 streaming Prometheus exporter.
 
+Overload posture (:mod:`dplasma_tpu.serving.admission`): every submit
+passes an admission decision inside the same critical section —
+queue-depth / inflight caps shed with :class:`AdmissionError`, SLO
+pressure degrades IR requests to a cheaper ``ir.precision`` rung —
+and each decision lands in the flight ring as an
+``admit``/``shed``/``degrade`` event by request id. Requests carry an
+optional deadline (``submit(deadline_s=)`` / MCA
+``serving.default_deadline_s``) honored at dispatch and between
+ladder rungs (:class:`DeadlineExceeded`); the ladder itself consults
+a process-global retry budget and a per-(op, rung) circuit breaker,
+so a deterministically failing rung is skipped instead of re-failed
+per request. ``SolveFuture.result(timeout=)`` raises a structured
+:class:`ServingTimeout` naming the request id when the future is
+still unresolved at the timeout (e.g. its dispatch thread died) —
+a blocked caller never hangs forever.
+
 Conventions: ``A`` is the full matrix (posv reads the lower triangle
 of a full symmetric operand); ``b`` may be 1-D (a single right-hand
 side — the result is returned 1-D) or ``(n, nrhs)``. The IR ops
@@ -62,8 +78,12 @@ import numpy as np
 from dplasma_tpu.observability import telemetry as tel_mod
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 from dplasma_tpu.resilience import guard, inject
+from dplasma_tpu.serving import admission as adm_mod
 from dplasma_tpu.serving import batched
 from dplasma_tpu.serving import cache as cache_mod
+from dplasma_tpu.serving.admission import (AdmissionError,
+                                           DeadlineExceeded,
+                                           ServingTimeout)
 from dplasma_tpu.utils import config as _cfg
 
 _cfg.mca_register(
@@ -122,6 +142,7 @@ class _Request:
     kwargs: dict
     rid: int = 0           # the stamped request id
     t_submit_ns: int = 0   # wall-clock twin of t_submit (tracing)
+    deadline: float = 0.0  # absolute perf_counter expiry; 0 = none
 
 
 class SolveFuture:
@@ -146,19 +167,36 @@ class SolveFuture:
         return self._event.is_set()
 
     def _resolve(self, value, meta: dict) -> None:
+        first = not self._event.is_set()
         self._value = value
         self.meta.update(meta)
         self._event.set()
+        if first:
+            # the conservation ledger: every admitted request resolves
+            # exactly once (value or error) — the soak audit's
+            # submitted == resolved + shed side
+            self._service.metrics.counter(
+                "serving_resolved_total").inc()
 
     def _fail(self, exc: BaseException) -> None:
+        first = not self._event.is_set()
         self._error = exc
         self._event.set()
+        if first:
+            self._service.metrics.counter(
+                "serving_resolved_total").inc()
 
     def result(self, timeout: Optional[float] = None):
         if not self._event.is_set():
             self._service._drive(self._group)
         if not self._event.wait(timeout):
-            raise TimeoutError("solve still pending")
+            # structured and attributable: the caller learns WHICH
+            # request is stuck (a dead dispatch thread, a wedged
+            # compile) instead of hanging forever on the bare event
+            raise ServingTimeout(
+                f"request {self.request_id} still pending after "
+                f"{timeout:g}s (solve not dispatched or dispatch "
+                f"thread died)", request_id=self.request_id)
         if self._error is not None:
             raise self._error
         return self._value
@@ -205,6 +243,12 @@ class SolverService:
         self.telemetry = telemetry if telemetry is not None \
             else tel_mod.Telemetry()
         self.cache.recorder = self.telemetry.flight
+        # the overload posture: admission decisions, the SLO tracker,
+        # circuit breakers, and the global retry budget (MCA
+        # serving.* knobs; decisions/transitions land in the flight
+        # ring by request id)
+        self.admission = adm_mod.AdmissionController(
+            metrics=self.metrics, flight=self.telemetry.flight)
         self.verbose = int(verbose) if verbose is not None \
             else _cfg.mca_get_int("serving.verbose", 0)
         self.resilience: List[dict] = []   # ladder summaries
@@ -229,8 +273,17 @@ class SolverService:
         self._inflight = 0      # live in-flight batches (gauge)
 
     # ------------------------------------------------------ submission
-    def submit(self, op: str, A, b, **kwargs) -> SolveFuture:
-        """Queue one solve ``op(A) x = b``; returns a future."""
+    def submit(self, op: str, A, b,
+               deadline_s: Optional[float] = None,
+               **kwargs) -> SolveFuture:
+        """Queue one solve ``op(A) x = b``; returns a future. The
+        request first passes admission: a shed raises
+        :class:`AdmissionError` (the request id it carries matches
+        the flight-recorder ``shed`` event), a degrade re-keys an IR
+        request onto the next-cheaper ``ir.precision`` executable.
+        ``deadline_s`` (default MCA ``serving.default_deadline_s``)
+        bounds the request end to end: expired requests fail with
+        :class:`DeadlineExceeded` instead of paying for a solve."""
         if op not in ("posv", "gesv", "posv_ir", "gesv_ir"):
             raise ValueError(f"unservable op {op!r}")
         a = np.asarray(A)
@@ -251,47 +304,86 @@ class SolverService:
         n, nrhs = a.shape[0], bb.shape[1]
         extra = tuple(sorted(kwargs.items()))
         memo = (op, n, nrhs, a.dtype.str, extra)
+        deadline = adm_mod.resolve_deadline(deadline_s)
         dispatch_now = None
-        # one critical section per submit: the key memo (the
-        # _tuning_for discipline — two threads racing the same new
-        # shape must memoize exactly one key), the queue mutation,
-        # and the gauge publish are all cheap host work, cheap
-        # enough to hold the lock across
+        degrade_prec: Optional[str] = None
+        # one critical section per submit: the admission decision, the
+        # key memo (the _tuning_for discipline — two threads racing
+        # the same new shape must memoize exactly one key), the queue
+        # mutation, and the gauge publish are all cheap host work,
+        # cheap enough to hold the lock across
         with self._lock:
-            key = self._keys.get(memo)
-            if key is None:
-                key = cache_mod.make_key(op, n, a.dtype, 1, nrhs,
-                                         extra=extra)
-                self._keys[memo] = key
-            group = key._replace(batch=0)  # batch bucket set at dispatch
-            fut = SolveFuture(self, group)
-            req = _Request(op=op, a=a, b=bb, vec=vec, n=n, nrhs=nrhs,
-                           future=fut, t_submit=time.perf_counter(),
-                           kwargs=dict(kwargs),
-                           t_submit_ns=time.time_ns())
-            self._requests += 1
+            decision, reason = self.admission.decide(
+                op, self._queued, self._inflight)
             self._next_rid += 1
-            req.rid = fut.request_id = self._next_rid
-            self.metrics.counter("serving_requests_total", op=op).inc()
-            lst = self._pending.setdefault(group, [])
-            lst.append(req)
-            self._queued += 1
-            if len(lst) >= self.max_batch:
-                dispatch_now = self._pending.pop(group)
-                self._queued -= len(dispatch_now)
-                self._cancel_timer(group)
-            elif len(lst) == 1 and self.max_wait_ms > 0:
-                t = threading.Timer(self.max_wait_ms / 1000.0,
-                                    self._drive, args=(group,))
-                t.daemon = True
-                self._timers[group] = t
-                t.start()
-            # published under the lock, like _drive's update: a gauge
-            # set after release could land out of order against a
-            # racing submit and stick a stale depth in the exporter
-            self.metrics.gauge("serving_queue_depth").set(self._queued)
-        self.telemetry.flight.record("submit", request=req.rid, op=op,
+            rid = self._next_rid
+            if decision == adm_mod.SHED:
+                queued = self._queued
+            else:
+                if decision == adm_mod.DEGRADE:
+                    # the cheaper-precision executable is a DIFFERENT
+                    # program: its own memo slot and cache key (the
+                    # key's precision field pins the compile in _run)
+                    degrade_prec = adm_mod.degraded_precision()
+                    memo = memo + (("degrade", degrade_prec),)
+                key = self._keys.get(memo)
+                if key is None:
+                    key = cache_mod.make_key(op, n, a.dtype, 1, nrhs,
+                                             extra=extra,
+                                             precision=degrade_prec)
+                    self._keys[memo] = key
+                group = key._replace(batch=0)  # batch bucket set at
+                fut = SolveFuture(self, group)  # dispatch
+                req = _Request(op=op, a=a, b=bb, vec=vec, n=n,
+                               nrhs=nrhs, future=fut,
+                               t_submit=time.perf_counter(),
+                               kwargs=dict(kwargs),
+                               t_submit_ns=time.time_ns(),
+                               deadline=deadline)
+                self._requests += 1
+                req.rid = fut.request_id = rid
+                self.metrics.counter("serving_requests_total",
+                                     op=op).inc()
+                lst = self._pending.setdefault(group, [])
+                lst.append(req)
+                self._queued += 1
+                if len(lst) >= self.max_batch:
+                    dispatch_now = self._pending.pop(group)
+                    self._queued -= len(dispatch_now)
+                    self._cancel_timer(group)
+                elif len(lst) == 1 and self.max_wait_ms > 0:
+                    t = threading.Timer(self.max_wait_ms / 1000.0,
+                                        self._drive, args=(group,))
+                    t.daemon = True
+                    self._timers[group] = t
+                    t.start()
+                # published under the lock, like _drive's update: a
+                # gauge set after release could land out of order
+                # against a racing submit and stick a stale depth in
+                # the exporter
+                self.metrics.gauge("serving_queue_depth").set(
+                    self._queued)
+        if decision == adm_mod.SHED:
+            self.telemetry.flight.record("shed", request=rid, op=op,
+                                         reason=reason, queued=queued)
+            self.telemetry.tracer.instant("shed", request=rid, op=op)
+            if self.verbose >= 1:
+                print(f"#+ serving: req={rid} SHED ({reason})",
+                      flush=True)
+            raise AdmissionError(f"request {rid} shed: {reason}",
+                                 request_id=rid, reason=reason)
+        self.telemetry.flight.record("submit", request=rid, op=op,
                                      n=n, nrhs=nrhs)
+        if decision == adm_mod.DEGRADE:
+            self.telemetry.flight.record(
+                "degrade", request=rid, op=op,
+                precision=degrade_prec, reason=reason)
+            if self.verbose >= 1:
+                print(f"#+ serving: req={rid} DEGRADED to "
+                      f"ir.precision={degrade_prec} ({reason})",
+                      flush=True)
+        else:
+            self.telemetry.flight.record("admit", request=rid, op=op)
         if dispatch_now:
             self._dispatch(group, dispatch_now)
         return fut
@@ -424,12 +516,20 @@ class SolverService:
             tune = self._tuning_for(key)
             builder = self._builder(key, reqs[0].kwargs,
                                     nb=tune["nb"] if tune else None)
-            if tune and tune["applied"]:
+            overrides = dict(tune["applied"]) \
+                if tune and tune["applied"] else {}
+            if key.precision and key.op.endswith("_ir"):
+                # pin the compile to the key's precision: key and
+                # executable must agree even when the key carries a
+                # degraded (admission-layer) rung instead of the
+                # ambient ir.precision
+                overrides["ir.precision"] = key.precision
+            if overrides:
                 # the override scope is process-global and LIFO: hold
                 # _TUNE_LOCK for the whole push..pop so concurrent
                 # dispatch threads never interleave their frames
                 with _TUNE_LOCK, \
-                        _cfg.override_scope(tune["applied"],
+                        _cfg.override_scope(overrides,
                                             label="serving-tune"):
                     entry = self.cache.get(key, builder, Aj, bj)
             else:
@@ -442,11 +542,28 @@ class SolverService:
             self.cache.invalidate(key)
         return res
 
+    def _expire(self, r: _Request, where: str,
+                fail_future: bool = True) -> None:
+        """Account one expired deadline (counter + flight event +
+        timeline marker, all by request id); optionally fail the
+        future with the structured :class:`DeadlineExceeded`."""
+        self.metrics.counter("serving_deadline_expired_total").inc()
+        self.telemetry.flight.record("deadline_expired",
+                                     request=r.rid, op=r.op,
+                                     where=where)
+        self.telemetry.tracer.instant("deadline_expired",
+                                      request=r.rid, where=where)
+        if self.verbose >= 1:
+            print(f"#+ serving: req={r.rid} deadline expired at "
+                  f"{where}", flush=True)
+        if fail_future:
+            r.future._fail(DeadlineExceeded(
+                f"request {r.rid} deadline expired at {where}",
+                request_id=r.rid))
+
     def _dispatch(self, group, reqs: List[_Request]) -> None:
         import jax.numpy as jnp
-        key = group._replace(batch=cache_mod.bucket_batch(len(reqs)))
         tracer = self.telemetry.tracer
-        rids = [r.rid for r in reqs]
         # queue-wait spans close here, retroactively: the wait ended
         # the moment this dispatch picked the group up
         now_ns = time.time_ns()
@@ -455,6 +572,20 @@ class SolverService:
             # this add() runs per request on the always-on hot path
             tracer.add("queue_wait", r.t_submit_ns, now_ns,
                        request=r.rid)
+        # deadline gate: a request that expired waiting in the queue
+        # fails fast HERE, before anyone pays to solve it (and before
+        # the batch bucket is sized, so the survivors compile small)
+        now = time.perf_counter()
+        expired = [r for r in reqs if r.deadline and now > r.deadline]
+        if expired:
+            for r in expired:
+                self._expire(r, where="dispatch")
+            reqs = [r for r in reqs
+                    if not (r.deadline and now > r.deadline)]
+            if not reqs:
+                return
+        key = group._replace(batch=cache_mod.bucket_batch(len(reqs)))
+        rids = [r.rid for r in reqs]
         with self._lock:
             self._inflight += 1
             self.metrics.gauge("serving_inflight_batches").set(
@@ -531,11 +662,18 @@ class SolverService:
         with tracer.span("scatter_gate", request=r.rid,
                          op=r.op) as gattrs:
             x = X[i, :r.n, :r.nrhs]
+            rejected = False
             if inject.armed():
                 # per-request response tap (module docstring) — only
-                # pay the round-trip while a plan is live
+                # pay the round-trip while a plan is live. A 'reject'
+                # fault raises here: treated as a failed response (not
+                # a raw future failure) so it walks the ladder below
                 nfaults0 = len(inject.faults())
-                x = np.asarray(inject.tap("serving", jnp.asarray(x)))
+                try:
+                    x = np.asarray(
+                        inject.tap("serving", jnp.asarray(x)))
+                except inject.InjectedReject:
+                    rejected = True
                 if len(inject.faults()) > nfaults0:
                     self.telemetry.flight.record(
                         "inject", request=r.rid, op=r.op,
@@ -545,9 +683,16 @@ class SolverService:
                     "bucket": (key.n, key.nrhs, key.batch)}
             if info is not None:
                 meta["refine"] = self._refine_meta(info, i)
-            ok, health, verdict = self._verify(
-                r, x, meta.get("refine"),
-                bwd=None if inject.armed() else float(bwds[i]))
+            if rejected:
+                # no response to verify — synthesize a failing health
+                # record and go straight to remediation
+                health = {"nan": 0, "inf": 0, "leaves": 1, "ok": False}
+                ok, verdict = False, {"ok": False,
+                                      "error": "injected reject"}
+            else:
+                ok, health, verdict = self._verify(
+                    r, x, meta.get("refine"),
+                    bwd=None if inject.armed() else float(bwds[i]))
             meta.update(verdict)
             gattrs["ok"] = bool(ok)
         if not ok:
@@ -558,6 +703,11 @@ class SolverService:
                 print(f"#+ serving: req={r.rid} gate FAILED "
                       f"verdict={verdict} -> remediation ladder",
                       flush=True)
+            if r.deadline and time.perf_counter() > r.deadline:
+                # nobody is waiting anymore: fail fast instead of
+                # paying for a ladder walk
+                self._expire(r, where="ladder")
+                return
             x, meta = self._remediate(r, x, health, meta,
                                       batch_key=key)
         # latency is the user-visible submit->resolve span, INCLUDING
@@ -567,6 +717,10 @@ class SolverService:
         with self._lock:
             self._latencies.append(lat)
         self.metrics.histogram("serving_latency_s").observe(lat)
+        # feed the admission SLO tracker from the telemetry histogram
+        # (EWMA-smoothed p99 — the shed/degrade pressure signal)
+        self.admission.observe(
+            lat, self.metrics.histogram("serving_latency_s"))
         r.future._resolve(x[:, 0] if r.vec else x, meta)
 
     @staticmethod
@@ -671,10 +825,50 @@ class SolverService:
         self.metrics.counter("serving_faults_total", op=r.op).inc()
         tracer = self.telemetry.tracer
         while True:
+            if r.deadline and time.perf_counter() > r.deadline:
+                # the walk is bounded by the request deadline: account
+                # the expiry and surface DeadlineExceeded through the
+                # dispatch isolation (which fails THIS future only)
+                ladder.record("deadline", "deadline", ok=False,
+                              classification=cls,
+                              error="deadline expired mid-ladder")
+                with self._lock:
+                    self.resilience.append(
+                        ladder.summary(injection=None))
+                self._expire(r, where="ladder", fail_future=False)
+                raise DeadlineExceeded(
+                    f"request {r.rid} deadline expired mid-ladder",
+                    request_id=r.rid)
             nxt = ladder.next_action(cls)
             if nxt is None:
                 break
             action, label, fn = nxt
+            if not self.admission.breaker_allow(r.op, action,
+                                               request=r.rid):
+                # the (op, rung) breaker is open: a rung that failed
+                # serving.breaker_failures times in a row is skipped,
+                # not re-failed per request — a poisoned executable
+                # cannot consume the service
+                ladder.record(action, label, ok=False,
+                              classification=cls,
+                              error="breaker open")
+                if self.verbose >= 1:
+                    print(f"#+ serving: req={r.rid} ladder rung "
+                          f"{action}:{label} skipped (breaker open)",
+                          flush=True)
+                continue
+            if action == guard.ACTION_RETRY \
+                    and not self.admission.take_retry():
+                # process-global retry budget exhausted: fall through
+                # to the fallback rungs instead of multiplying load
+                ladder.record(action, label, ok=False,
+                              classification=cls,
+                              error="retry budget exhausted")
+                if self.verbose >= 1:
+                    print(f"#+ serving: req={r.rid} ladder rung "
+                          f"{action}:{label} skipped (retry budget "
+                          f"exhausted)", flush=True)
+                continue
             if action == guard.ACTION_KERNEL_FALLBACK:
                 guard.kernel_fallback()
                 # the demotion changes what a fresh trace compiles,
@@ -695,15 +889,25 @@ class SolverService:
                                      op=r.op).inc()
             # remediation runs clean, like the driver ladder's rungs
             # (a transient fault does not recur on recompute)
-            with tracer.span(f"ladder:{action}", request=r.rid,
-                             op=r.op, label=label) as lattrs:
-                with inject.suppressed():
-                    if fn is not None:
-                        x2, rmeta = fn(r)
-                    else:
-                        x2, rmeta = self._solo(r)
-                ok2, health2, verdict2 = self._verify(r, x2, rmeta)
-                lattrs["ok"] = bool(ok2)
+            try:
+                with tracer.span(f"ladder:{action}", request=r.rid,
+                                 op=r.op, label=label) as lattrs:
+                    with inject.suppressed():
+                        if fn is not None:
+                            x2, rmeta = fn(r)
+                        else:
+                            x2, rmeta = self._solo(r)
+                    ok2, health2, verdict2 = self._verify(r, x2, rmeta)
+                    lattrs["ok"] = bool(ok2)
+            except Exception:
+                # a RAISING rung is a failure the breaker must see
+                # (the exception still propagates to the dispatch
+                # isolation, failing this future only)
+                self.admission.breaker_record(r.op, action, False,
+                                              request=r.rid)
+                raise
+            self.admission.breaker_record(r.op, action, bool(ok2),
+                                          request=r.rid)
             self.telemetry.flight.record(
                 "ladder", request=r.rid, op=r.op, action=action,
                 label=label, ok=bool(ok2))
@@ -785,6 +989,7 @@ class SolverService:
                 sources[src] = sources.get(src, 0) + 1
             tuning = {"consulted": len(tunes), "sources": sources}
         return {"requests": requests, "batches": batches,
+                "admission": self.admission.summary(),
                 "tuning": tuning,
                 "mean_batch": (requests / batches) if batches else None,
                 "latency_s": {"p50": percentile(lats, 50),

@@ -21,7 +21,11 @@
   must reconcile ``==`` against the spmdcheck schedule for every
   modelled op, name an injected straggler rank, flag a dropped
   collective class with a named diagnostic, and round-trip the v14
-  ``"devprof"`` report section) must exit 0 on the repo.
+  ``"devprof"`` report section + the soak smoke: a serving burst's
+  conservation audit must balance with a forced shed and a forced
+  breaker-open each landing their named flight event, round-tripped
+  through the v15 ``"admission"`` report section) must exit 0 on
+  the repo.
 """
 import pathlib
 import sys
@@ -97,5 +101,5 @@ def test_lint_all_aggregate_is_clean(capsys):
                  "threadcheck", "palcheck", "dagcheck-smoke",
                  "spmdcheck-smoke", "serving-smoke", "hlocheck-smoke",
                  "ring-smoke", "tune-smoke", "telemetry-smoke",
-                 "devprof-smoke"):
+                 "devprof-smoke", "soak-smoke"):
         assert f"# {gate}: OK" in out.out

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 14
+    assert doc["schema"] == REPORT_SCHEMA == 15
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -233,6 +233,20 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
                  "critical_path": [{"name": "fusion.0", "rank": 2,
                                     "seconds": 0.004}],
                  "diagnostics": [], "ok": True}]},
+        15: {"schema": 15, "name": "v15", "ops": [], "metrics": [],
+             "admission": {
+                 "enabled": True, "max_queue": 256, "max_inflight": 0,
+                 "slo_p99_ms": 0.0, "ewma_p99_ms": 0.0,
+                 "admitted": 63, "shed": 1, "degraded": 0,
+                 "deadline_expired": 0, "breaker_opens": 1,
+                 "breakers": {"posv:retry": {
+                     "state": "open", "failures": 3, "opens": 1,
+                     "probes": 0}},
+                 "retry_budget": {"limit": 0, "used": 2},
+                 "audit": {"submitted": 64, "admitted": 63,
+                           "shed": 1, "resolved": 63, "lost": 0,
+                           "flight_shed_seen": 1, "flight_dropped": 0,
+                           "balanced": True}}},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -488,7 +502,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 14
+    assert doc["schema"] == 15
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

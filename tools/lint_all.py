@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Thirteen passes, in increasing cost order:
+Fourteen passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
@@ -66,7 +66,7 @@ Thirteen passes, in increasing cost order:
    the per-request span taxonomy, the streaming exporter's file must
    parse as Prometheus text (``telemetry.parse_prometheus_text``)
    with the serving families present, and the flight-recorder dump
-   must round-trip through the schema-v13 run-report
+   must round-trip through the current-schema run-report
    (``report.load_report``) with its submit/dispatch event sequence
    intact;
 13. a ``devprof-smoke`` pass — the measured-attribution engine
@@ -77,7 +77,16 @@ Thirteen passes, in increasing cost order:
    straggler must be attributed to the right rank and category, a
    timeline mutation dropping one priced class must produce a
    ``missing-collective`` diagnostic NAMING that class, and the
-   entry must round-trip through the schema-v14 run-report.
+   entry must round-trip through the current-schema run-report;
+14. a ``soak-smoke`` pass — the overload-hardening gate: a tiny
+   serving burst whose conservation audit must balance (submitted
+   == admitted + shed, resolved == admitted, zero lost futures), a
+   forced queue-cap shed must raise ``AdmissionError`` AND land a
+   ``shed`` flight event naming the request id, a forced
+   rung-failure storm must open the (op, rung) circuit breaker with
+   a ``breaker_open`` flight event, and the admission summary (with
+   the audit) must round-trip through the schema-v15 run-report's
+   ``"admission"`` section.
 
 Usage: ``python tools/lint_all.py`` — prints ``file:line: message``
 per violation / one line per failed smoke case, exits nonzero on any.
@@ -759,6 +768,176 @@ def run_telemetry_smoke() -> int:
     return bad
 
 
+def run_soak_smoke() -> int:
+    """The overload-hardening gate, CPU-fast: the conservation audit
+    over a tiny burst must balance (submitted == admitted + shed,
+    resolved == admitted, zero lost futures), a forced queue-cap shed
+    must raise ``AdmissionError`` and land a ``shed`` flight event
+    naming the request id, a forced rung-failure storm must open the
+    (op, rung) breaker with a ``breaker_open`` flight event, and the
+    admission summary + audit must round-trip through the schema-v15
+    run-report."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from dplasma_tpu.observability.report import (REPORT_SCHEMA,
+                                                  RunReport,
+                                                  load_report)
+    from dplasma_tpu.resilience import inject
+    from dplasma_tpu.serving import AdmissionError, SolverService
+
+    if not jax.config.jax_compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(_ROOT / ".jax_cache"))
+    bad = 0
+    rng = np.random.default_rng(3872)
+    n, nrhs = 6, 2
+
+    def operands():
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        a = g @ g.T + n * np.eye(n, dtype=np.float32)
+        b = rng.standard_normal((n, nrhs)).astype(np.float32)
+        return a, b
+
+    svc = SolverService(nb=4, max_batch=4, max_wait_ms=0)
+    ctrl = svc.admission
+
+    def counters():
+        return {k: svc.metrics.counter(k).value
+                for k in ("serving_admitted_total",
+                          "serving_shed_total",
+                          "serving_resolved_total")}
+
+    before = counters()
+    submitted = shed_seen = 0
+    # (a) clean burst: everything admits and resolves
+    futs = []
+    for _ in range(3):
+        a, b = operands()
+        submitted += 1
+        futs.append(svc.submit("posv", a, b))
+    svc.flush()
+    for f in futs:
+        f.result(120.0)
+    # (b) forced shed: queue cap 1, two submits without a flush — the
+    # second MUST shed with the structured error and a flight event
+    # naming its request id
+    ctrl.max_queue, saved_q = 1, ctrl.max_queue
+    try:
+        a, b = operands()
+        submitted += 1
+        f1 = svc.submit("posv", a, b)
+        a, b = operands()
+        submitted += 1
+        try:
+            svc.submit("posv", a, b)
+        except AdmissionError as exc:
+            shed_seen += 1
+            ev = [e for e in svc.telemetry.flight.events()
+                  if e["kind"] == "shed"
+                  and e.get("request") == exc.request_id]
+            if exc.request_id is None or not ev:
+                sys.stderr.write(
+                    f"soak-smoke: shed flight event does not name "
+                    f"the shed request (id={exc.request_id})\n")
+                bad += 1
+        else:
+            sys.stderr.write("soak-smoke: queue cap 1 did not shed "
+                             "the second queued submit\n")
+            bad += 1
+    finally:
+        ctrl.max_queue = saved_q
+    svc.flush()
+    f1.result(120.0)
+    # (c) forced breaker-open: every remediation rung raises, one
+    # rung failure trips the breaker (threshold 1) — the (op, rung)
+    # breaker must open with a flight event, and the failed future
+    # still RESOLVES (conservation holds under the storm)
+    ctrl.breaker_failures = 1
+
+    def _raise(_r):
+        raise RuntimeError("soak-smoke: poisoned rung")
+
+    svc._solo = _raise
+    svc._escalate = _raise
+    inject.arm(inject.parse_plan("nan@serving:1:1", 3872))
+    try:
+        a, b = operands()
+        submitted += 1
+        fb = svc.submit("posv", a, b)
+        svc.flush()
+        try:
+            fb.result(120.0)
+        except Exception:
+            pass
+        else:
+            sys.stderr.write("soak-smoke: poisoned-rung request did "
+                             "not fail\n")
+            bad += 1
+    finally:
+        inject.disarm()
+    states = [v["state"]
+              for k, v in ctrl.summary()["breakers"].items()
+              if k.startswith("posv:")]
+    if "open" not in states and "half_open" not in states:
+        sys.stderr.write(f"soak-smoke: breaker did not open after "
+                         f"the rung failure (states={states})\n")
+        bad += 1
+    if not any(e["kind"] == "breaker_open"
+               for e in svc.telemetry.flight.events()):
+        sys.stderr.write("soak-smoke: no breaker_open flight event "
+                         "recorded\n")
+        bad += 1
+    # (d) conservation audit over everything above
+    diff = {k: int(v - before[k]) for k, v in counters().items()}
+    admitted = diff["serving_admitted_total"]
+    shed = diff["serving_shed_total"]
+    resolved = diff["serving_resolved_total"]
+    audit = {"submitted": submitted, "admitted": admitted,
+             "shed": shed, "resolved": resolved,
+             "lost": admitted - resolved,
+             "flight_shed_seen": svc.telemetry.flight.counts()
+             .get("shed", 0),
+             "flight_dropped": svc.telemetry.flight.summary()
+             ["dropped"]}
+    audit["balanced"] = (submitted == admitted + shed
+                         and shed == shed_seen
+                         and audit["lost"] == 0
+                         and audit["flight_shed_seen"]
+                         + audit["flight_dropped"] >= shed)
+    if not audit["balanced"]:
+        sys.stderr.write(f"soak-smoke: conservation audit does not "
+                         f"balance: {audit}\n")
+        bad += 1
+    # (e) the admission summary + audit round-trips through the
+    # schema-v15 run-report
+    with tempfile.TemporaryDirectory() as td:
+        rep = RunReport("soak-smoke")
+        adm = ctrl.summary()
+        adm["audit"] = audit
+        rep.add_admission(adm)
+        rj = f"{td}/r.json"
+        rep.write(rj)
+        try:
+            doc = load_report(rj)
+        except (OSError, ValueError) as exc:
+            sys.stderr.write(f"soak-smoke: report round-trip "
+                             f"failed: {exc}\n")
+            return bad + 1
+        got = doc.get("admission")
+        if doc.get("schema") != REPORT_SCHEMA \
+                or not isinstance(got, dict) \
+                or got.get("audit", {}).get("balanced") is not True:
+            sys.stderr.write(f"soak-smoke: admission section did not "
+                             f"round-trip (schema="
+                             f"{doc.get('schema')}, got={got})\n")
+            bad += 1
+    svc.close()
+    return bad
+
+
 def run_devprof_smoke() -> int:
     """The measured-attribution gate, CPU-fast and jax-free: devprof's
     synthetic 2x2 timelines for the priced op classes must reconcile
@@ -868,7 +1047,8 @@ def main(argv=None) -> int:
                      ("ring-smoke", run_ring_smoke),
                      ("tune-smoke", run_tune_smoke),
                      ("telemetry-smoke", run_telemetry_smoke),
-                     ("devprof-smoke", run_devprof_smoke)):
+                     ("devprof-smoke", run_devprof_smoke),
+                     ("soak-smoke", run_soak_smoke)):
         n = fn()
         print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")
         bad += n

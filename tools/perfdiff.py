@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v14), the bench
+(``--report`` from any driver, any schema vintage v1-v15), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -35,6 +35,15 @@ Comparable metrics extracted from each document:
   (100% relative, ``DEFAULT_METRIC_THRESHOLDS``) and only
   order-of-magnitude growth trips the gate; the absolute < 5%
   budget is asserted by servebench itself and the test suite;
+* the admission layer's overload posture (schema v15): the
+  un-stressed admission check cost
+  (``serving.admission_overhead_frac``, lower is better, measured
+  by servebench's admission-on-vs-off passes — near-zero and
+  noise-dominated like trace overhead, same wide default
+  threshold), and from a soak run's conservation audit the
+  ``serving.shed_frac`` / ``serving.deadline_miss_frac`` fractions
+  (lower is better — a serving stack shedding or missing deadlines
+  more under the SAME replayed traffic is a capacity regression);
 * the concurrency gate's fuzz surface
   (``racefuzz.schedules_run``, HIGHER is better — a silently
   shrinking schedule-fuzz sweep is a coverage regression — and
@@ -80,7 +89,8 @@ DEFAULT_THRESHOLD = 0.10   # 10% relative regression
 #: still wins): trace overhead and cross-rank skew are near-zero,
 #: noise-dominated fractions — a 10% RELATIVE bound would flag
 #: 0.020 -> 0.023
-DEFAULT_METRIC_THRESHOLDS = {"trace_overhead_frac": 1.0, "skew": 1.0}
+DEFAULT_METRIC_THRESHOLDS = {"trace_overhead_frac": 1.0, "skew": 1.0,
+                             "admission_overhead_frac": 1.0}
 
 
 # ------------------------------------------------------------- loading
@@ -199,6 +209,32 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
         if isinstance(v, (int, float)) and v >= 0:
             out["serving.trace_overhead_frac"] = {
                 "value": float(v), "better": "lower"}
+        v = s.get("admission_overhead_frac")
+        if isinstance(v, (int, float)) and v >= 0:
+            out["serving.admission_overhead_frac"] = {
+                "value": float(v), "better": "lower"}
+    adm = doc.get("admission")
+    if isinstance(adm, dict):
+        # the overload posture (schema v15): shed and deadline-miss
+        # fractions, lower-better. A soak run's conservation audit is
+        # the gated window (the SAME replayed traffic either side of
+        # a change); without one, the controller's lifetime counters
+        # stand in
+        src = adm.get("audit") if isinstance(adm.get("audit"), dict) \
+            else adm
+        admitted = src.get("admitted")
+        shed = src.get("shed")
+        expired = src.get("deadline_expired")
+        if isinstance(admitted, (int, float)) \
+                and isinstance(shed, (int, float)) \
+                and admitted + shed > 0:
+            out["serving.shed_frac"] = {
+                "value": float(shed) / float(admitted + shed),
+                "better": "lower"}
+            if isinstance(expired, (int, float)) and expired >= 0:
+                out["serving.deadline_miss_frac"] = {
+                    "value": float(expired) / float(admitted + shed),
+                    "better": "lower"}
     for e in doc.get("hlocheck") or []:
         # compiled-artifact peak memory (schema v10): lower is
         # better — a grown peak is an HBM regression exactly like a

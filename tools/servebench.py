@@ -38,6 +38,33 @@ naming its request id) to ``--flight`` (default ``flight.json`` once
 ``--inject`` or ``--telemetry`` is on) — the incident carries its own
 evidence.
 
+Overload hardening (the admission layer) is measured two ways:
+
+* every clean rep also times an **admission-OFF** pass, and
+  ``admission_overhead_frac = (best_on - best_adm_off) /
+  best_adm_off`` lands as a lower-better ledger entry next to
+  ``trace_overhead_frac`` — the un-stressed admission check must
+  stay under the same ~5% budget;
+* ``--soak`` replays the workload in sustained waves for
+  ``--soak-seconds``, optionally under a scripted ``--chaos``
+  schedule (comma list of ``KIND@STAGE[:RATE[:COUNT]]`` phases,
+  ``off`` for a quiet phase — wave k runs phase ``k mod len``), and
+  closes with the **conservation audit**: submitted == admitted +
+  shed, resolved == admitted, zero lost or hung futures, every shed
+  reconciled against the flight ring (events still held + the
+  ring's drop count must cover the shed counter). The audit lands
+  in the report's schema-v15 ``"admission"`` section and
+  ``serving.shed_frac`` / ``serving.deadline_miss_frac`` gate as
+  lower-better ledger entries.
+
+``--replay trace.jsonl`` drives the workload from a recorded trace
+(one ``{"op","n","nrhs"}`` JSON object per line; operands are
+re-synthesized deterministically from ``--seed``);
+``--record-trace`` writes the current workload in that format.
+``--mca KEY=VAL`` (repeatable) pins MCA knobs — e.g.
+``--mca serving.max_queue=8 --mca serving.slo_p99_ms=5`` to force
+shed/degrade pressure in a soak.
+
 Usage::
 
     python tools/servebench.py                  # defaults, prints doc
@@ -45,6 +72,9 @@ Usage::
     python tools/servebench.py --inject=nan@serving:1:1 -v
     python tools/servebench.py --telemetry=serve.prom \\
         --spans=spans.json      # + streaming exporter + merge input
+    python tools/servebench.py --soak --soak-seconds 5 \\
+        --chaos "nan@serving:0.05,off,delay@serving:0.1" \\
+        --mca serving.max_queue=16 --report soak.json
 """
 from __future__ import annotations
 
@@ -62,6 +92,20 @@ if "jax" not in sys.modules:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def _operands(rng, op: str, n: int, nrhs: int):
+    """One well-conditioned (A, b) pair (SPD for posv, diagonally
+    dominated for gesv) — shared by the synthetic generator and the
+    trace replayer so a replay is bit-deterministic given the seed."""
+    import numpy as np
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    if op.startswith("posv"):
+        a = a @ a.T + n * np.eye(n, dtype=np.float32)
+    else:
+        a = a + n * np.eye(n, dtype=np.float32)
+    b = rng.standard_normal((n, nrhs)).astype(np.float32)
+    return a, b
+
+
 def make_workload(nreq: int, seed: int, ops, sizes, max_nrhs: int):
     """Deterministic synthetic request stream: (op, A, b) triples with
     mixed sizes and ragged nrhs (SPD operands for posv, diagonally
@@ -73,21 +117,151 @@ def make_workload(nreq: int, seed: int, ops, sizes, max_nrhs: int):
         op = ops[i % len(ops)]
         n = int(sizes[i % len(sizes)])
         nrhs = int(rng.integers(1, max_nrhs + 1))
-        a = rng.standard_normal((n, n)).astype(np.float32)
-        if op.startswith("posv"):
-            a = a @ a.T + n * np.eye(n, dtype=np.float32)
-        else:
-            a = a + n * np.eye(n, dtype=np.float32)
-        b = rng.standard_normal((n, nrhs)).astype(np.float32)
+        a, b = _operands(rng, op, n, nrhs)
         reqs.append((op, a, b))
     return reqs
 
 
+def load_trace(path: str, seed: int):
+    """Replay workload from a recorded trace: one JSON object per
+    line with ``op``/``n``/``nrhs``; operands are re-synthesized from
+    ``seed`` (the trace records SHAPES, not matrices — a production
+    trace stays small and carries no tenant data)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    reqs = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                op = str(rec["op"])
+                n, nrhs = int(rec["n"]), int(rec["nrhs"])
+            except (ValueError, KeyError, TypeError) as exc:
+                raise ValueError(
+                    f"bad trace line {lineno} in {path}: {exc}")
+            a, b = _operands(rng, op, n, nrhs)
+            reqs.append((op, a, b))
+    if not reqs:
+        raise ValueError(f"trace {path} holds no requests")
+    return reqs
+
+
+def record_trace(path: str, reqs) -> None:
+    """Write the workload's (op, n, nrhs) stream as a replayable
+    ``--replay`` trace (jsonl, one request per line)."""
+    with open(path, "w") as f:
+        for op, a, b in reqs:
+            f.write(json.dumps({"op": op, "n": int(a.shape[0]),
+                                "nrhs": int(b.shape[1])}) + "\n")
+
+
+_AUDIT_COUNTERS = ("serving_admitted_total", "serving_shed_total",
+                   "serving_degraded_total",
+                   "serving_deadline_expired_total",
+                   "serving_breaker_open_total",
+                   "serving_resolved_total")
+
+
+def run_soak(svc, reqs, phases, soak_s: float, verbose: int = 0):
+    """Sustained mixed traffic in waves under the scripted chaos
+    schedule, closed by the zero-lost-requests conservation audit.
+
+    Wave k replays the whole workload under ``phases[k mod len]``
+    (None = no schedule = every wave clean); shed submits are counted
+    at the bench level AND via the admission counters so the two
+    tallies cross-check. The audit balances counter DIFFS over the
+    soak window only — warmup and the clean measured reps stay out of
+    it."""
+    from dplasma_tpu.resilience import inject
+    from dplasma_tpu.serving import admission as adm_mod
+
+    def snap():
+        return {k: svc.metrics.counter(k).value
+                for k in _AUDIT_COUNTERS}
+
+    before = snap()
+    t0 = time.perf_counter()
+    waves = submitted = shed_seen = failed = hung = 0
+    while True:
+        phase = phases[waves % len(phases)] if phases else None
+        plan = phase.plan if phase is not None else None
+        if plan is not None:
+            inject.arm(plan)
+        try:
+            futs = []
+            for op, a, b in reqs:
+                submitted += 1
+                try:
+                    futs.append(svc.submit(op, a, b))
+                except adm_mod.AdmissionError:
+                    shed_seen += 1
+            svc.flush()
+            for f in futs:
+                try:
+                    f.result(120.0)
+                except adm_mod.ServingTimeout:
+                    hung += 1     # unresolved future = LOST request
+                except Exception:
+                    failed += 1   # resolved-with-error still balances
+        finally:
+            if plan is not None:
+                inject.disarm()
+        waves += 1
+        if time.perf_counter() - t0 >= soak_s:
+            break
+    diff = {k: int(v - before[k]) for k, v in snap().items()}
+    admitted = diff["serving_admitted_total"]
+    shed = diff["serving_shed_total"]
+    resolved = diff["serving_resolved_total"]
+    # flight-ring reconciliation: every shed must be evidenced by a
+    # ``shed`` event still in the ring OR covered by the ring's drop
+    # count (a shed storm may overflow the bounded ring — drops are
+    # visible, never silent)
+    flight_shed = svc.telemetry.flight.counts().get("shed", 0)
+    dropped = svc.telemetry.flight.summary()["dropped"]
+    audit = {"submitted": submitted, "admitted": admitted,
+             "shed": shed, "degraded": diff["serving_degraded_total"],
+             "deadline_expired": diff["serving_deadline_expired_total"],
+             "breaker_opens": diff["serving_breaker_open_total"],
+             "resolved": resolved, "failed": failed, "hung": hung,
+             "lost": admitted - resolved, "waves": waves,
+             "soak_s": round(time.perf_counter() - t0, 3),
+             "flight_shed_seen": flight_shed,
+             "flight_dropped": dropped}
+    audit["balanced"] = (submitted == admitted + shed
+                         and shed == shed_seen
+                         and audit["lost"] == 0 and hung == 0
+                         and flight_shed + dropped >= shed)
+    if verbose:
+        print(f"# soak: {waves} wave(s), {submitted} submitted = "
+              f"{admitted} admitted + {shed} shed; {resolved} "
+              f"resolved, {audit['lost']} lost, {hung} hung -> "
+              f"{'BALANCED' if audit['balanced'] else 'IMBALANCED'}",
+              flush=True)
+    return audit
+
+
 def run_service(svc, reqs):
     """One open-loop pass: submit everything, flush, gather. Returns
-    (wall_s, per-request latencies, futures)."""
+    (wall_s, per-request latencies, futures). Shed submits (an
+    operator pinning ``serving.max_queue`` low enough to bite the
+    clean passes too) are tolerated — the pass covers what was
+    admitted."""
+    from dplasma_tpu.serving import admission as adm_mod
     t0 = time.perf_counter()
-    futs = [svc.submit(op, a, b) for op, a, b in reqs]
+    futs = []
+    for op, a, b in reqs:
+        try:
+            futs.append(svc.submit(op, a, b))
+        except adm_mod.AdmissionError:
+            svc.flush()        # drain the full queue, then retry once
+            try:
+                futs.append(svc.submit(op, a, b))
+            except adm_mod.AdmissionError:
+                pass
     svc.flush()
     for f in futs:
         f.result(120.0)
@@ -169,8 +343,33 @@ def main(argv=None) -> int:
     ap.add_argument("--spans", default=None, metavar="FILE",
                     help="save the measured passes' tracing spans "
                          "(tools/tracecat.py --merge input)")
+    ap.add_argument("--soak", action="store_true",
+                    help="after the clean reps, replay the workload "
+                         "in sustained waves and close with the "
+                         "conservation audit (submitted == admitted "
+                         "+ shed, zero lost/hung futures)")
+    ap.add_argument("--soak-seconds", type=float, default=2.0,
+                    help="minimum soak duration (default 2.0; the "
+                         "wave in flight always completes)")
+    ap.add_argument("--chaos", default=None, metavar="SCHEDULE",
+                    help="comma list of fault phases for the soak "
+                         "waves (KIND@STAGE[:RATE[:COUNT]] or 'off'; "
+                         "wave k runs phase k mod len)")
+    ap.add_argument("--replay", default=None, metavar="TRACE",
+                    help="drive the workload from a recorded "
+                         "trace.jsonl instead of the synthetic "
+                         "generator")
+    ap.add_argument("--record-trace", default=None, metavar="PATH",
+                    help="write the workload's (op, n, nrhs) stream "
+                         "as a --replay trace")
+    ap.add_argument("--mca", action="append", default=[],
+                    metavar="KEY=VAL",
+                    help="pin an MCA knob for the whole bench "
+                         "(repeatable), e.g. serving.max_queue=16")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     ns = ap.parse_args(argv)
+    if ns.chaos and not ns.soak:
+        ap.error("--chaos schedules soak waves: add --soak")
 
     import contextlib
 
@@ -181,9 +380,32 @@ def main(argv=None) -> int:
     from dplasma_tpu.serving.cache import ExecutableCache
     from dplasma_tpu.utils import config as _cfg
 
-    ops = [o.strip() for o in ns.ops.split(",") if o.strip()]
-    sizes = [int(s) for s in ns.sizes.split(",") if s.strip()]
-    reqs = make_workload(ns.requests, ns.seed, ops, sizes, ns.max_nrhs)
+    mca_kv = {}
+    for item in ns.mca:
+        if "=" not in item:
+            ap.error(f"--mca expects KEY=VAL, got {item!r}")
+        k, v = item.split("=", 1)
+        mca_kv[k.strip()] = v.strip()
+    chaos_phases = inject.parse_schedule(ns.chaos, ns.seed) \
+        if ns.chaos else None
+
+    mca_cm = _cfg.override_scope(mca_kv, label="servebench-mca") \
+        if mca_kv else contextlib.nullcontext()
+
+    if ns.replay:
+        reqs = load_trace(ns.replay, ns.seed)
+        ops = sorted({op for op, _, _ in reqs})
+        sizes = sorted({a.shape[0] for _, a, _ in reqs})
+    else:
+        ops = [o.strip() for o in ns.ops.split(",") if o.strip()]
+        sizes = [int(s) for s in ns.sizes.split(",") if s.strip()]
+        reqs = make_workload(ns.requests, ns.seed, ops, sizes,
+                             ns.max_nrhs)
+    if ns.record_trace:
+        record_trace(ns.record_trace, reqs)
+        if ns.verbose:
+            print(f"# trace ({len(reqs)} requests) written to "
+                  f"{ns.record_trace}")
     if any(o.endswith("_ir") for o in ops):
         import jax
         if not jax.config.jax_enable_x64:
@@ -192,176 +414,227 @@ def main(argv=None) -> int:
                 if op.endswith("_ir") else (op, a, b)
                 for op, a, b in reqs]
 
-    report = RunReport("servebench")
-    svc = SolverService(nb=ns.nb, max_batch=ns.max_batch,
-                        max_wait_ms=0.0,
-                        cache=ExecutableCache(metrics=None))
-    svc.metrics = report.metrics
-    svc.cache.metrics = report.metrics
-    if ns.telemetry:
-        svc.telemetry.start_exporter(report.metrics, ns.telemetry)
+    # the MCA pins cover the service's construction-time
+    # admission knobs AND every measured pass
+    with mca_cm:
+        report = RunReport("servebench")
+        svc = SolverService(nb=ns.nb, max_batch=ns.max_batch,
+                            max_wait_ms=0.0,
+                            cache=ExecutableCache(metrics=None))
+        svc.metrics = report.metrics
+        svc.cache.metrics = report.metrics
+        svc.admission.metrics = report.metrics
+        if ns.telemetry:
+            svc.telemetry.start_exporter(report.metrics, ns.telemetry)
 
-    # warmup: populate the executable cache (service) and the
-    # per-shape jit cache (loop) — steady-state is what we measure.
-    # The warmup's latencies are compile time, not service latency:
-    # reset the service's stats (and telemetry — warmup spans/events
-    # are compile noise) so summary() covers measured traffic
-    run_service(svc, reqs)
-    fns: dict = {}
-    run_loop(reqs, ns.nb, fns)
-    svc.reset_stats()
+        # warmup: populate the executable cache (service) and the
+        # per-shape jit cache (loop) — steady-state is what we measure.
+        # The warmup's latencies are compile time, not service latency:
+        # reset the service's stats (and telemetry — warmup spans/events
+        # are compile noise) so summary() covers measured traffic
+        run_service(svc, reqs)
+        fns: dict = {}
+        run_loop(reqs, ns.nb, fns)
+        svc.reset_stats()
 
-    spec = ns.inject or os.environ.get("DPLASMA_INJECT")
-    plan = inject.parse_plan(spec, ns.seed) if spec else None
-    flight = ns.flight or ("flight.json"
-                           if (spec or ns.telemetry) else None)
-    flight_cm = _cfg.override_scope({"telemetry.flight_path": flight},
-                                    label="servebench-flight") \
-        if flight else contextlib.nullcontext()
-    best_svc = best_off = best_loop = float("inf")
-    lats = []          # POOLED over every measured rep (crosscheck /
-    faults = []        # fallback for the histogram percentiles)
-    with flight_cm:
-        # CLEAN measured reps: each pairs one tracing-OFF pass (the
-        # overhead baseline) with one tracing-ON pass (the production
-        # mode the throughput/latency figures describe). Fault
-        # injection runs SEPARATELY below — a remediation walk's solo
-        # recompile would otherwise masquerade as tracing overhead.
-        for _ in range(max(ns.reps, 1)):
-            svc.telemetry.tracer.enabled = False
-            wall_off, _lat_off, _ = run_service(svc, reqs)
-            svc.telemetry.tracer.enabled = True
-            best_off = min(best_off, wall_off)
-            wall, lat, _futs = run_service(svc, reqs)
-            best_svc = min(best_svc, wall)
-            lats.extend(lat)
-            lwall, _ = run_loop(reqs, ns.nb, fns)
-            best_loop = min(best_loop, lwall)
-        # the gated p50/p99 come from the service's bounded telemetry
-        # histogram — the SAME instrument a production scrape reads,
-        # pooled over every clean measured pass (read before the
-        # injected passes so remediation walks don't skew them)
-        lat_h = report.metrics.get("serving_latency_s")
-        if isinstance(lat_h, Histogram) and lat_h.stats()["count"]:
-            p50 = lat_h.percentile(50)
-            p99 = lat_h.percentile(99)
-            lat_src = "telemetry-histogram"
-        else:                  # unreachable with traffic; stay honest
-            slat = sorted(lats)
-            p50, p99 = _pct(slat, 50), _pct(slat, 99)
-            lat_src = "pooled-list"
-        if plan is not None:
-            # injected passes: tracing on (the incident evidence —
-            # flight dump, ladder spans — must come from the
-            # production mode), excluded from the throughput figures
+        spec = ns.inject or os.environ.get("DPLASMA_INJECT")
+        plan = inject.parse_plan(spec, ns.seed) if spec else None
+        flight = ns.flight or ("flight.json"
+                               if (spec or ns.telemetry) else None)
+        flight_cm = _cfg.override_scope({"telemetry.flight_path": flight},
+                                        label="servebench-flight") \
+            if flight else contextlib.nullcontext()
+        best_svc = best_off = best_loop = float("inf")
+        best_admoff = float("inf")
+        lats = []          # POOLED over every measured rep (crosscheck /
+        faults = []        # fallback for the histogram percentiles)
+        with flight_cm:
+            # CLEAN measured reps: each pairs one tracing-OFF pass (the
+            # overhead baseline) with one tracing-ON pass (the production
+            # mode the throughput/latency figures describe), plus one
+            # admission-OFF pass (the overload-hardening analogue of the
+            # tracing baseline). Fault injection runs SEPARATELY below —
+            # a remediation walk's solo recompile would otherwise
+            # masquerade as tracing overhead.
             for _ in range(max(ns.reps, 1)):
-                inject.arm(plan)
-                run_service(svc, reqs)
-                faults += inject.disarm()
-    if ns.spans:
-        svc.telemetry.tracer.save(ns.spans)
+                svc.telemetry.tracer.enabled = False
+                wall_off, _lat_off, _ = run_service(svc, reqs)
+                svc.telemetry.tracer.enabled = True
+                best_off = min(best_off, wall_off)
+                svc.admission.enabled = False
+                wall_aoff, _lat_aoff, _ = run_service(svc, reqs)
+                svc.admission.enabled = True
+                best_admoff = min(best_admoff, wall_aoff)
+                wall, lat, _futs = run_service(svc, reqs)
+                best_svc = min(best_svc, wall)
+                lats.extend(lat)
+                lwall, _ = run_loop(reqs, ns.nb, fns)
+                best_loop = min(best_loop, lwall)
+            # the gated p50/p99 come from the service's bounded telemetry
+            # histogram — the SAME instrument a production scrape reads,
+            # pooled over every clean measured pass (read before the
+            # injected passes so remediation walks don't skew them)
+            lat_h = report.metrics.get("serving_latency_s")
+            if isinstance(lat_h, Histogram) and lat_h.stats()["count"]:
+                p50 = lat_h.percentile(50)
+                p99 = lat_h.percentile(99)
+                lat_src = "telemetry-histogram"
+            else:                  # unreachable with traffic; stay honest
+                slat = sorted(lats)
+                p50, p99 = _pct(slat, 50), _pct(slat, 99)
+                lat_src = "pooled-list"
+            if plan is not None:
+                # injected passes: tracing on (the incident evidence —
+                # flight dump, ladder spans — must come from the
+                # production mode), excluded from the throughput figures
+                for _ in range(max(ns.reps, 1)):
+                    inject.arm(plan)
+                    run_service(svc, reqs)
+                    faults += inject.disarm()
+            audit = run_soak(svc, reqs, chaos_phases,
+                             ns.soak_seconds,
+                             verbose=ns.verbose) if ns.soak else None
+        if ns.spans:
+            svc.telemetry.tracer.save(ns.spans)
 
-    nreq = len(reqs)
-    sps = nreq / best_svc
-    loop_sps = nreq / best_loop
-    speedup = sps / loop_sps if loop_sps else None
-    overhead = max((best_svc - best_off) / best_off, 0.0) \
-        if best_off > 0 else None
-    summary = svc.summary()
-    summary.update({
-        "workload": {"requests": nreq, "ops": ops, "sizes": sizes,
-                     "max_nrhs": ns.max_nrhs, "seed": ns.seed,
-                     "nb": ns.nb, "max_batch": ns.max_batch,
-                     "reps": ns.reps},
-        "solves_per_s": sps, "loop_solves_per_s": loop_sps,
-        "speedup_vs_loop": speedup,
-        "measured_latency_s": {"p50": p50, "p99": p99,
-                               "source": lat_src},
-        "trace_overhead_frac": overhead,
-        "trace_on_s": best_svc, "trace_off_s": best_off,
-        "flight_dump": flight,
-        "injected_faults": len(faults)})
-    report.add_serving(summary)
-    report.add_telemetry(svc.telemetry.summary())
-    hit_rate = summary["cache"]["hit_rate"]
-    entries = [
-        {"metric": "serving.solves_per_s", "value": sps},
-        {"metric": "serving.speedup_vs_loop", "value": speedup},
-        {"metric": "serving.p50_ms", "value": 1e3 * p50,
-         "better": "lower"},
-        {"metric": "serving.p99_ms", "value": 1e3 * p99,
-         "better": "lower"},
-    ]
-    if overhead is not None:
-        entries.append({"metric": "serving.trace_overhead_frac",
-                        "value": overhead, "better": "lower"})
-        if overhead > 0.05:
-            print(f"#! servebench: tracing-on overhead "
-                  f"{100 * overhead:.1f}% exceeds the 5% budget",
-                  file=sys.stderr)
-    if hit_rate is not None:
-        entries.append({"metric": "serving.cache_hit_rate",
-                        "value": hit_rate})
-    report.entries.extend(entries)
+        nreq = len(reqs)
+        sps = nreq / best_svc
+        loop_sps = nreq / best_loop
+        speedup = sps / loop_sps if loop_sps else None
+        overhead = max((best_svc - best_off) / best_off, 0.0) \
+            if best_off > 0 else None
+        adm_overhead = \
+            max((best_svc - best_admoff) / best_admoff, 0.0) \
+            if best_admoff not in (0.0, float("inf")) else None
+        summary = svc.summary()
+        summary.update({
+            "workload": {"requests": nreq, "ops": ops, "sizes": sizes,
+                         "max_nrhs": ns.max_nrhs, "seed": ns.seed,
+                         "nb": ns.nb, "max_batch": ns.max_batch,
+                         "reps": ns.reps},
+            "solves_per_s": sps, "loop_solves_per_s": loop_sps,
+            "speedup_vs_loop": speedup,
+            "measured_latency_s": {"p50": p50, "p99": p99,
+                                   "source": lat_src},
+            "trace_overhead_frac": overhead,
+            "trace_on_s": best_svc, "trace_off_s": best_off,
+            "admission_overhead_frac": adm_overhead,
+            "flight_dump": flight,
+            "injected_faults": len(faults)})
+        report.add_serving(summary)
+        report.add_telemetry(svc.telemetry.summary())
+        adm = svc.admission.summary()
+        if audit is not None:
+            adm["audit"] = audit
+        report.add_admission(adm)
+        hit_rate = summary["cache"]["hit_rate"]
+        entries = [
+            {"metric": "serving.solves_per_s", "value": sps},
+            {"metric": "serving.speedup_vs_loop", "value": speedup},
+            {"metric": "serving.p50_ms", "value": 1e3 * p50,
+             "better": "lower"},
+            {"metric": "serving.p99_ms", "value": 1e3 * p99,
+             "better": "lower"},
+        ]
+        if overhead is not None:
+            entries.append({"metric": "serving.trace_overhead_frac",
+                            "value": overhead, "better": "lower"})
+            if overhead > 0.05:
+                print(f"#! servebench: tracing-on overhead "
+                      f"{100 * overhead:.1f}% exceeds the 5% budget",
+                      file=sys.stderr)
+        if adm_overhead is not None:
+            entries.append(
+                {"metric": "serving.admission_overhead_frac",
+                 "value": adm_overhead, "better": "lower"})
+            if adm_overhead > 0.05:
+                print(f"#! servebench: admission overhead "
+                      f"{100 * adm_overhead:.1f}% exceeds the 5% "
+                      f"budget on the un-stressed path",
+                      file=sys.stderr)
+        if audit is not None:
+            nsub = max(audit["submitted"], 1)
+            entries.append({"metric": "serving.shed_frac",
+                            "value": audit["shed"] / nsub,
+                            "better": "lower"})
+            entries.append(
+                {"metric": "serving.deadline_miss_frac",
+                 "value": audit["deadline_expired"] / nsub,
+                 "better": "lower"})
+        if hit_rate is not None:
+            entries.append({"metric": "serving.cache_hit_rate",
+                            "value": hit_rate})
+        report.entries.extend(entries)
 
-    doc = report.snapshot()
-    doc["bench"] = "servebench"
-    print(json.dumps({"bench": "servebench",
-                      "solves_per_s": round(sps, 2),
-                      "loop_solves_per_s": round(loop_sps, 2),
-                      "speedup_vs_loop": round(speedup, 3),
-                      "p50_ms": round(1e3 * p50, 3),
-                      "p99_ms": round(1e3 * p99, 3),
-                      "trace_overhead_frac":
-                          None if overhead is None
-                          else round(overhead, 4),
-                      "cache_hit_rate": hit_rate,
-                      "remediated": summary["remediated"],
-                      "failed": summary["failed"]}), flush=True)
-    if ns.verbose:
-        print(json.dumps(summary, indent=1, default=str))
-    svc.close()
-
-    if ns.report:
-        report.write(ns.report)
+        doc = report.snapshot()
+        doc["bench"] = "servebench"
+        print(json.dumps({"bench": "servebench",
+                          "solves_per_s": round(sps, 2),
+                          "loop_solves_per_s": round(loop_sps, 2),
+                          "speedup_vs_loop": round(speedup, 3),
+                          "p50_ms": round(1e3 * p50, 3),
+                          "p99_ms": round(1e3 * p99, 3),
+                          "trace_overhead_frac":
+                              None if overhead is None
+                              else round(overhead, 4),
+                          "admission_overhead_frac":
+                              None if adm_overhead is None
+                              else round(adm_overhead, 4),
+                          "cache_hit_rate": hit_rate,
+                          "remediated": summary["remediated"],
+                          "failed": summary["failed"],
+                          "soak_audit":
+                              None if audit is None
+                              else ("balanced" if audit["balanced"]
+                                    else "IMBALANCED")}), flush=True)
         if ns.verbose:
-            print(f"# report written to {ns.report}")
+            print(json.dumps(summary, indent=1, default=str))
+        svc.close()
 
-    import perfdiff
-    history = ns.history or os.environ.get("DPLASMA_BENCH_HISTORY",
-                                           "bench_history.jsonl")
-    prev = None
-    if os.path.exists(history):
+        if ns.report:
+            report.write(ns.report)
+            if ns.verbose:
+                print(f"# report written to {ns.report}")
+
+        import perfdiff
+        history = ns.history or os.environ.get("DPLASMA_BENCH_HISTORY",
+                                               "bench_history.jsonl")
+        prev = None
+        if os.path.exists(history):
+            try:
+                # newest SERVING-family entry (the ledger may interleave
+                # bench.py ladder docs with no common metrics)
+                prev = perfdiff.latest_comparable_entry(history, doc)
+            except (OSError, ValueError) as exc:
+                print(f"#! cannot read bench history: {exc}",
+                      file=sys.stderr)
         try:
-            # newest SERVING-family entry (the ledger may interleave
-            # bench.py ladder docs with no common metrics)
-            prev = perfdiff.latest_comparable_entry(history, doc)
-        except (OSError, ValueError) as exc:
-            print(f"#! cannot read bench history: {exc}",
+            perfdiff.append_ledger(history, doc)
+        except OSError as exc:
+            print(f"#! cannot append bench history: {exc}",
                   file=sys.stderr)
-    try:
-        perfdiff.append_ledger(history, doc)
-    except OSError as exc:
-        print(f"#! cannot append bench history: {exc}",
-              file=sys.stderr)
 
-    rc = 0
-    if ns.gate:
-        if prev is None:
-            print("# servebench --gate: no prior ledger entry "
-                  "(informational first run)")
-        else:
-            res = perfdiff.compare(prev, doc,
-                                   threshold=ns.gate_threshold)
-            for line in perfdiff.format_result(res,
-                                               verbose=ns.verbose > 0):
-                print(line)
-            rc = 0 if res["ok"] else 1
-    if summary["failed"]:
-        print(f"#! {summary['failed']} request(s) failed past the "
-              "remediation ladder", file=sys.stderr)
-        rc = rc or 1
-    return rc
+        rc = 0
+        if ns.gate:
+            if prev is None:
+                print("# servebench --gate: no prior ledger entry "
+                      "(informational first run)")
+            else:
+                res = perfdiff.compare(prev, doc,
+                                       threshold=ns.gate_threshold)
+                for line in perfdiff.format_result(res,
+                                                   verbose=ns.verbose > 0):
+                    print(line)
+                rc = 0 if res["ok"] else 1
+        if summary["failed"]:
+            print(f"#! {summary['failed']} request(s) failed past the "
+                  "remediation ladder", file=sys.stderr)
+            rc = rc or 1
+        if audit is not None and not audit["balanced"]:
+            print(f"#! servebench --soak: conservation audit "
+                  f"IMBALANCED: {json.dumps(audit)}", file=sys.stderr)
+            rc = rc or 1
+        return rc
 
 
 if __name__ == "__main__":
