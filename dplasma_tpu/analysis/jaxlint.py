@@ -50,6 +50,18 @@ Rules:
   :data:`DONATE_ALLOWLIST`. The compiled-artifact twin (a donation
   *requested* but dropped by the compiler) is
   :mod:`dplasma_tpu.analysis.hlocheck`'s donation audit.
+* **J010 full-operand-materialize** — ``jnp.asarray(X)`` /
+  ``jnp.array(X)`` / ``jax.device_put(X)`` on a *whole* host operand
+  (a bare parameter name, or a name bound to a ``np.array``/
+  ``np.asarray`` view of one) inside a ``*_lowmem`` or streaming
+  function in ``kernels/``, ``ops/``, or ``serving/``. The lowmem
+  tiers exist to keep device residency under ``memcheck.hbm_budget``
+  by shipping *chunks* (``jnp.asarray(Ah[s:, j0:j1])``); a
+  full-operand transfer silently reinstates the O(N^2) footprint the
+  tier was built to avoid, bypassing the budget plumbing that
+  :mod:`dplasma_tpu.analysis.memcheck` prices. Subscripted transfers
+  (chunk slices) are the budgeted idiom and stay legal; sanctioned
+  whole-operand choke points go in :data:`J010_ALLOWLIST`.
 
 Traced-ness is a static approximation: the parameters of a
 jit/shard_map-decorated function (minus ``static_argnums`` /
@@ -98,6 +110,12 @@ DONATE_DIRS = ("dplasma_tpu/kernels", "dplasma_tpu/ops",
 #: the operand after the call, so donation would invalidate a live
 #: buffer. Empty today: every in-package rewrite site donates.
 DONATE_ALLOWLIST: set = set()
+
+#: (module, function) pairs allowed to materialize a whole host
+#: operand on device inside a lowmem/streaming path — choke points
+#: that own their budget accounting. Empty today: every in-package
+#: lowmem transfer ships chunk slices.
+J010_ALLOWLIST: set = set()
 
 #: the mesh axis-name literals J008 polices (parallel/mesh.py owns them)
 _AXIS_LITERALS = {"p", "q"}
@@ -266,6 +284,50 @@ def _check_donation(fn, traced: Set[str], rel: str,
                         f"caller reuses the operand"))
 
 
+def _check_lowmem_materialize(fn, rel: str,
+                              out: List[Violation]) -> None:
+    """J010: a ``*_lowmem``/streaming function device-transferring a
+    whole host operand instead of a budgeted chunk slice."""
+    if (rel, fn.name) in J010_ALLOWLIST:
+        return
+    # host-operand names: the parameters, plus names rebound to a
+    # numpy view OF a parameter (still host-side, still whole); a
+    # rebind to anything else makes the name a device value
+    host = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+    for sub in ast.walk(fn):
+        if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)):
+            continue
+        tgt, v = sub.targets[0].id, sub.value
+        still_host = False
+        if isinstance(v, ast.Call):
+            dn = _dotted(v.func)
+            if dn.split(".")[0] in ("np", "numpy") and \
+                    dn.rsplit(".", 1)[-1] in ("array", "asarray") and \
+                    v.args and any(isinstance(n, ast.Name)
+                                   and n.id in host
+                                   for n in ast.walk(v.args[0])):
+                still_host = True
+        (host.add if still_host else host.discard)(tgt)
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        dn = _dotted(sub.func)
+        if dn not in ("jnp.asarray", "jnp.array", "jax.device_put"):
+            continue
+        a0 = sub.args[0] if sub.args else None
+        if isinstance(a0, ast.Name) and a0.id in host:
+            out.append((sub.lineno, "J010",
+                        f"{fn.name} materializes the whole host "
+                        f"operand {a0.id!r} on device via {dn}() — a "
+                        f"lowmem/streaming path must ship budgeted "
+                        f"chunk slices (jnp.asarray(X[i0:i1, ...])) "
+                        f"so residency stays under "
+                        f"memcheck.hbm_budget; allowlist the site in "
+                        f"J010_ALLOWLIST if it owns its own budget "
+                        f"accounting"))
+
+
 def _check_jit_body(fn, traced: Set[str], out: List[Violation]) -> None:
     for sub in ast.walk(fn):
         if isinstance(sub, ast.Call):
@@ -348,6 +410,11 @@ def lint_source(src: str, rel: str) -> List[Violation]:
                     _check_donation(node, traced, rel, out)
             elif node.name in wrapped:
                 _check_jit_body(node, set(params), out)
+            # J010: lowmem/streaming paths in the same hot-path
+            # packages must not re-materialize whole host operands
+            if in_donate and ("_lowmem" in node.name
+                              or "stream" in node.name):
+                _check_lowmem_materialize(node, rel, out)
         # J002: tracer isinstance outside utils.is_concrete
         if isinstance(node, ast.Call) and \
                 isinstance(node.func, ast.Name) and \

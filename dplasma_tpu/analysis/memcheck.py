@@ -1,0 +1,890 @@
+"""Static tile-liveness & HBM-residency verifier + streaming simulator.
+
+dagcheck proves the tile DAG's dataflow, spmdcheck the collective
+schedule, hlocheck the compiled artifact, palcheck the Pallas kernel
+contracts, threadcheck the lock discipline — but nothing above this
+module statically proves a schedule's peak resident bytes FIT the
+device before anything compiles.  The ROADMAP's huge-N item (N=100k is
+an ~80 GB dd operand) names ``hlocheck.hbm_budget`` as the enforcement
+mechanism; this module is the *predictive* instrument in front of it,
+built (like the PR-6 ring simulator before the PR-13 rings) before the
+out-of-core subsystem that will sit on it.  Three parts:
+
+1. **tile-liveness analysis** (:func:`check_schedule`) — over any
+   recorded ``dag()`` schedule, classic or pipelined
+   (``lookahead``/``agg_depth``), the per-tile live interval runs
+   first write -> last read in the priority-wavefront linearization
+   (:meth:`DagRecorder.order` — the same native scheduler the runtime
+   uses).  dagcheck ``reads=``/``writes=`` region splits are honored
+   for ordering but share one buffer for footprint (a region refines
+   conflict detection, not storage).  Per-rank residency follows the
+   block-cyclic owner map (:func:`dagcheck.rank_of_dist`); tile bytes
+   are priced from the (padded) descriptor geometry ``mb*nb*itemsize``
+   with dd-format limb widths added when the Ozaki limb GEMM is
+   active (:func:`effective_itemsize`).  WAW in-place reuse and
+   donation are credited from the J009/hlocheck alias contracts:
+   successive versions of a tile share ONE buffer (the jits donate
+   rewritten operands — jaxlint J009 enforces the request, hlocheck
+   audits the delivery), and the bytes that credit saved are reported
+   (``donated_bytes``).  The structural model per rank is
+
+       resident(r, s) = input(r) + output(r) + live_tiles(r, s)
+
+   — the undonated input operand is resident for the whole
+   executable, the assembled output is conservatively co-resident,
+   and the live set sweeps the interval events.  On top of the
+   structural peak the *predicted HBM peak* adds a documented
+   compiled-staging allowance (``memcheck.staging_factor``): XLA's
+   concat/pad/collective staging multiplies the structural number by
+   an op-shape-stable constant (measured 2.5-11.5x on the golden CPU
+   fixtures; see tests/test_memcheck.py's calibration sweep).
+
+2. **budget gate** — predicted per-device peak vs MCA
+   ``memcheck.hbm_budget``; the diagnostic names the peak-driving
+   task, the largest live tile, and the live set.
+   :func:`cross_validate` reconciles the prediction against
+   hlocheck's *measured* ``memory_analysis`` peak: predicted must
+   dominate measured (a compiled temp the model missed is a named
+   ``missed-temp`` finding) and stay within the documented slack band
+   (``memcheck.slack_band``; above it the model is crying wolf —
+   ``model-slack``).
+
+3. **streaming-schedule simulator** (:func:`plan_stream` /
+   :func:`simulate_stream` — the analogue of spmdcheck's
+   ``simulate_ring``): given a budget below the resident peak, derive
+   the host<->HBM spill/prefetch schedule for the left-looking sweeps
+   with Belady MIN eviction (farthest-next-use — minimal refetch
+   count, the optimal offline policy), where the lookahead window IS
+   the prefetch window.  :func:`simulate_stream` verifies
+   double-buffer feasibility — every prefetch issue step strictly
+   precedes its consume step — and emits deadlock/thrash diagnostics
+   naming kernel/step/tile (``prefetch-order``, ``not-resident``,
+   ``over-budget``, ``dropped-free``, ``thrash``).  Streamed bytes
+   are priced through the roofline ``host`` bound
+   (:func:`StreamPlan.host_seconds`) so ``phase_model`` /
+   ``attribute_phases`` can attribute PCIe-bound phases.
+   :func:`lowmem_plan` rebuilds the exact column schedules the
+   existing lowmem tiers run (``potrf_lowmem`` / ``getrf_lowmem`` /
+   ``geqrf_lowmem``) as stream plans, and :func:`lowmem_blocking`
+   owns the working-set inequality those ops' planners now delegate
+   to — the blocking is DERIVED from this analyzer, not parallel to
+   it.
+
+Wired as ``--memcheck`` on every driver (verify-before-timed-loop,
+abort via :class:`MemCheckError`, run-report schema v16 ``"memcheck"``
+section + ``memcheck_*`` metrics, cross-validated against
+``--hlocheck``'s measured peak when both run), into the serving
+executable cache's admission audit (MCA ``memcheck.serving``), and
+into ``tools/lint_all.py`` as the ``memcheck-smoke`` gate.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dplasma_tpu.utils import config as _cfg
+
+_cfg.mca_register(
+    "memcheck.hbm_budget", "0",
+    "Per-device HBM budget (bytes) the schedule's PREDICTED peak "
+    "resident bytes must fit under; 0 disables the gate. The "
+    "diagnostic names the peak-driving task, tile, and live set. "
+    "This is the static twin of hlocheck.hbm_budget (which checks "
+    "the compiled artifact after the fact).")
+_cfg.mca_register(
+    "memcheck.staging_factor", "8.0",
+    "Compiled-staging allowance: predicted HBM peak = structural "
+    "resident peak x this factor. XLA's concat/pad/collective "
+    "staging multiplies the structural liveness number by an "
+    "op-stable, shape-stable constant (measured 2.5-11.5x vs shard "
+    "bytes on the golden CPU fixtures across N=16..128; the "
+    "tightest golden case, getrf 2x2, needs >= 6.6x the structural "
+    "peak). 8.0 dominates every golden fixture while staying inside "
+    "the memcheck.slack_band cross-validation band.")
+_cfg.mca_register(
+    "memcheck.slack_band", "8.0",
+    "Cross-validation band vs hlocheck's measured memory_analysis "
+    "peak: predicted must be >= measured (below it a compiled temp "
+    "escaped the model: missed-temp) and <= measured x this band "
+    "(above it the model is uselessly loose: model-slack).")
+_cfg.mca_register(
+    "memcheck.serving", "on",
+    "on = audit every executable the serving cache compiles against "
+    "memcheck.hbm_budget using its measured memory_analysis peak "
+    "(recorded in serving_memcheck_* metrics, never fatal); "
+    "off = skip.")
+
+#: double-double mantissa bits the limb plan must carry (one f64)
+_DD_BITS = 53
+
+
+class MemCheckError(ValueError):
+    """A schedule failed static residency verification."""
+
+    def __init__(self, result: "MemResult"):
+        self.result = result
+        lines = [d.message for d in result.diagnostics[:8]]
+        more = len(result.diagnostics) - len(lines)
+        if more > 0:
+            lines.append(f"... and {more} more")
+        super().__init__("memory residency verification failed:\n  " +
+                         "\n  ".join(lines))
+
+
+@dataclass(frozen=True)
+class MemDiagnostic:
+    """One residency failure, naming the driving task/tile/step."""
+
+    kind: str        # hbm-budget|missed-temp|model-slack|
+    #                # prefetch-order|not-resident|over-budget|
+    #                # dropped-free|thrash|corrupt
+    message: str
+    task: str = ""
+    tile: str = ""
+    step: Optional[int] = None
+
+    def as_dict(self) -> dict:
+        return {"kind": self.kind, "message": self.message,
+                "task": self.task, "tile": self.tile or None,
+                "step": self.step}
+
+
+@dataclass
+class MemResult:
+    """Outcome of :func:`check_schedule` (JSON-able via
+    :meth:`summary`)."""
+
+    kernel: str = "dag"
+    ok: bool = True
+    tasks: int = 0
+    tiles: int = 0
+    steps: int = 0
+    itemsize: float = 8.0
+    tile_bytes: int = 0
+    #: structural per-rank peak resident bytes (input + output +
+    #: live set at the worst step)
+    peak_by_rank: Dict[int, int] = field(default_factory=dict)
+    resident_peak_bytes: int = 0
+    predicted_hbm_peak_bytes: int = 0
+    staging_factor: float = 1.0
+    peak_rank: int = 0
+    peak_step: int = 0
+    peak_task: str = ""
+    live_at_peak: int = 0
+    peak_live_preview: List[str] = field(default_factory=list)
+    input_bytes: int = 0
+    output_bytes: int = 0
+    #: WAW versions beyond the first per tile — buffers the J009
+    #: donation contract lets successive versions share
+    reuse_writes: int = 0
+    donated_bytes: int = 0
+    budget: int = 0
+    #: attached when a budget below the resident peak forced a
+    #: streaming plan (see :func:`plan_stream`)
+    stream: Optional[dict] = None
+    skipped: Optional[str] = None
+    diagnostics: List[MemDiagnostic] = field(default_factory=list)
+
+    def add(self, kind: str, message: str, task: str = "",
+            tile: str = "", step=None) -> None:
+        self.ok = False
+        self.diagnostics.append(
+            MemDiagnostic(kind, message, task, tile or "", step))
+
+    @property
+    def counts(self) -> dict:
+        out: dict = {}
+        for d in self.diagnostics:
+            out[d.kind] = out.get(d.kind, 0) + 1
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "ok": self.ok, "tasks": self.tasks, "tiles": self.tiles,
+            "steps": self.steps, "itemsize": self.itemsize,
+            "tile_bytes": self.tile_bytes,
+            "peak_by_rank": {str(r): v for r, v in
+                             sorted(self.peak_by_rank.items())},
+            "peak_bytes": self.resident_peak_bytes,
+            "predicted_hbm_peak_bytes": self.predicted_hbm_peak_bytes,
+            "staging_factor": self.staging_factor,
+            "peak_rank": self.peak_rank, "peak_step": self.peak_step,
+            "peak_task": self.peak_task,
+            "live_at_peak": self.live_at_peak,
+            "peak_live_preview": list(self.peak_live_preview),
+            "input_bytes": self.input_bytes,
+            "output_bytes": self.output_bytes,
+            "reuse_writes": self.reuse_writes,
+            "donated_bytes": self.donated_bytes,
+            "budget": self.budget, "stream": self.stream,
+            "skipped": self.skipped, "counts": self.counts,
+            "diagnostics": [d.as_dict() for d in self.diagnostics]}
+
+    def format(self, name: str = "dag") -> str:
+        head = (f"#+ memcheck[{name}]: {self.tasks} tasks, "
+                f"{self.tiles} tiles, peak "
+                f"{self.resident_peak_bytes}B resident / "
+                f"{self.predicted_hbm_peak_bytes}B predicted "
+                f"(rank {self.peak_rank} @ {self.peak_task or '-'}): "
+                + ("OK" if self.ok else
+                   " ".join(f"{k}={v}" for k, v in
+                            sorted(self.counts.items()))))
+        lines = [head]
+        for d in self.diagnostics:
+            lines.append(f"#! memcheck[{name}]: {d.message}")
+        if self.skipped:
+            lines.append(f"#+ memcheck[{name}]: note: {self.skipped}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------
+# Pricing
+# ---------------------------------------------------------------------
+
+def dd_limb_count(bits: int = _DD_BITS) -> int:
+    """int8 limbs per f64 component under the Ozaki split
+    (:mod:`dplasma_tpu.kernels.dd`'s ``_plan`` inequality: ``W8``
+    payload bits per limb must cover the mantissa + sign)."""
+    from dplasma_tpu.kernels import dd as _dd
+    return int(math.ceil((bits + 1) / _dd.W8))
+
+
+def effective_itemsize(dtype) -> float:
+    """HBM bytes per element, dd-limb priced from the descriptors:
+    when the limb GEMM is active (MCA ``dd_gemm``), an f64 operand
+    also carries its int8 limb cache (``dd_limb_count()`` limbs per
+    component; complex128 carries both components)."""
+    import numpy as np
+    dt = np.dtype(dtype)
+    item = float(dt.itemsize)
+    if dt.kind in "fc" and dt.itemsize in (8, 16):
+        try:
+            import jax.numpy as jnp
+            from dplasma_tpu.kernels import blas as _blas
+            jdt = jnp.complex128 if dt.kind == "c" else jnp.float64
+            if _blas._dd_active(jdt):
+                item += dd_limb_count() * (2 if dt.kind == "c" else 1)
+        except (ImportError, AttributeError):
+            item = float(dt.itemsize)   # no jax/dd backend: plain pricing
+    return item
+
+
+# ---------------------------------------------------------------------
+# Tile-liveness analysis
+# ---------------------------------------------------------------------
+
+def _accesses(task):
+    """Normalized (mat, i, j) read/write tile keys of a recorded
+    task (region splits collapse onto the tile: one buffer)."""
+    from dplasma_tpu.analysis.dagcheck import _norm_access
+    reads, writes = [], []
+    for a in (task.reads or ()):
+        m, i, j, _ = _norm_access(tuple(a))
+        reads.append((m, i, j))
+    for a in (task.writes or ()):
+        m, i, j, _ = _norm_access(tuple(a))
+        writes.append((m, i, j))
+    return reads, writes
+
+
+def check_schedule(rec, *, mb: int, nb: int, itemsize: float,
+                   dist=None, lookahead: int = 0,
+                   kernel: str = "dag", budget: Optional[int] = None,
+                   staging_factor: Optional[float] = None,
+                   derive_streaming: bool = True) -> MemResult:
+    """Tile-liveness analysis + budget gate over a recorded DAG.
+
+    Walks the priority-wavefront linearization the runtime itself
+    executes (``rec.order(lookahead)`` — so a pipelined sweep's
+    deeper panel overlap widens the live window exactly as it does
+    at run time), computes per-tile live intervals and the per-rank
+    structural resident peak under the block-cyclic ``dist``, prices
+    tiles from the padded descriptor geometry (``mb*nb*itemsize``;
+    pass :func:`effective_itemsize` output for dd pricing), and
+    gates the predicted HBM peak against ``budget`` (default MCA
+    ``memcheck.hbm_budget``; 0 disables).  When the budget is
+    exceeded and ``derive_streaming`` is set, a spill/prefetch plan
+    is attached (``res.stream``) showing whether an out-of-core
+    schedule could fit and at what host-traffic cost."""
+    res = MemResult(kernel=kernel, itemsize=float(itemsize))
+    tasks = list(rec.tasks)
+    res.tasks = len(tasks)
+    if budget is None:
+        budget = _cfg.mca_get_int("memcheck.hbm_budget", 0)
+    res.budget = int(budget)
+    if staging_factor is None:
+        staging_factor = _cfg.mca_get_float(
+            "memcheck.staging_factor", 8.0)
+    res.staging_factor = float(staging_factor)
+    if not tasks:
+        res.skipped = "empty recording: nothing to verify"
+        return res
+
+    try:
+        order = list(rec.order(lookahead))
+    except Exception as exc:
+        res.add("corrupt", f"corrupt schedule for {kernel}: "
+                f"wavefront linearization failed ({exc!r})")
+        order = list(range(len(tasks)))
+    pos = {tid: s for s, tid in enumerate(order)}
+    res.steps = len(order)
+
+    tile_b = int(round(mb * nb * itemsize))
+    res.tile_bytes = tile_b
+    if dist is not None:
+        from dplasma_tpu.analysis.dagcheck import rank_of_dist
+        rank_of = rank_of_dist(dist)
+    else:
+        def rank_of(acc):
+            return 0
+
+    INF = 1 << 60
+    rmin: Dict[tuple, int] = {}
+    first: Dict[tuple, int] = {}
+    last: Dict[tuple, int] = {}
+    first_write: Dict[tuple, int] = {}
+    nwrites: Dict[tuple, int] = {}
+    for t in tasks:
+        s = pos.get(t.tid, 0)
+        reads, writes = _accesses(t)
+        for key in reads:
+            rmin[key] = min(rmin.get(key, INF), s)
+            first[key] = min(first.get(key, INF), s)
+            last[key] = max(last.get(key, -1), s)
+        for key in writes:
+            first[key] = min(first.get(key, INF), s)
+            last[key] = max(last.get(key, -1), s)
+            first_write[key] = min(first_write.get(key, INF), s)
+            nwrites[key] = nwrites.get(key, 0) + 1
+    # a tile whose earliest touch is a read (ties included: an
+    # in-place task reads the operand version first) is a driver
+    # input — its buffer predates the schedule
+    read_first = {key: rmin.get(key, INF) <= first_write.get(key, INF)
+                  for key in first}
+    res.tiles = len(first)
+    if not first:
+        res.skipped = ("no declared reads/writes: liveness needs the "
+                       "dag() builders' access declarations")
+        return res
+
+    owner = {key: rank_of((key[0], key[1], key[2], ""))
+             for key in first}
+    # input operand: tiles whose first touch is a read are driver
+    # inputs — the undonated parameter buffer is resident whole-run.
+    # output: every written tile lands in the assembled result,
+    # conservatively co-resident with the live set.
+    in_by_rank: Dict[int, int] = {}
+    out_by_rank: Dict[int, int] = {}
+    for key in first:
+        r = owner[key]
+        if read_first.get(key, True):
+            in_by_rank[r] = in_by_rank.get(r, 0) + tile_b
+        if key in first_write:
+            out_by_rank[r] = out_by_rank.get(r, 0) + tile_b
+    res.input_bytes = sum(in_by_rank.values())
+    res.output_bytes = sum(out_by_rank.values())
+    res.reuse_writes = sum(n - 1 for n in nwrites.values() if n > 1)
+    res.donated_bytes = res.reuse_writes * tile_b
+
+    # event sweep: live interval = first write -> last read for
+    # produced tiles, first touch -> last touch for inputs
+    events: Dict[int, List[Tuple[int, int, tuple]]] = {}
+    for key in first:
+        lo = first_write.get(key, first[key])
+        if read_first.get(key, True):
+            lo = first[key]
+        events.setdefault(lo, []).append((+tile_b, owner[key], key))
+        events.setdefault(last[key] + 1, []).append(
+            (-tile_b, owner[key], key))
+    live: Dict[int, int] = {}
+    live_set: Dict[int, List[tuple]] = {}
+    peak: Dict[int, int] = {r: in_by_rank.get(r, 0) +
+                            out_by_rank.get(r, 0)
+                            for r in set(owner.values())}
+    peak_step: Dict[int, int] = {r: 0 for r in peak}
+    peak_live: Dict[int, List[tuple]] = {r: [] for r in peak}
+    for s in range(res.steps + 1):
+        for delta, r, key in events.get(s, ()):
+            live[r] = live.get(r, 0) + delta
+            if delta > 0:
+                live_set.setdefault(r, []).append(key)
+            else:
+                live_set[r].remove(key)
+        for r in live:
+            tot = (in_by_rank.get(r, 0) + out_by_rank.get(r, 0) +
+                   live[r])
+            if tot > peak.get(r, 0):
+                peak[r] = tot
+                peak_step[r] = s
+                peak_live[r] = list(live_set.get(r, ()))
+    res.peak_by_rank = dict(peak)
+    res.peak_rank = max(peak, key=lambda r: peak[r])
+    res.resident_peak_bytes = peak[res.peak_rank]
+    res.peak_step = min(peak_step[res.peak_rank], res.steps - 1)
+    res.peak_task = tasks[order[res.peak_step]].name
+    worst_live = peak_live[res.peak_rank]
+    res.live_at_peak = len(worst_live)
+    res.peak_live_preview = [
+        f"{m}({i},{j})" for m, i, j in worst_live[:6]]
+    res.predicted_hbm_peak_bytes = int(
+        res.resident_peak_bytes * res.staging_factor)
+
+    if res.budget > 0:
+        for r in sorted(peak):
+            pred = int(peak[r] * res.staging_factor)
+            if pred <= res.budget:
+                continue
+            lv = peak_live[r]
+            preview = ", ".join(f"{m}({i},{j})" for m, i, j in lv[:6])
+            more = max(len(lv) - 6, 0)
+            if more:
+                preview += f", +{more} more"
+            big = "{}({},{})".format(*lv[0]) if lv else ""
+            step = min(peak_step[r], res.steps - 1)
+            tname = tasks[order[step]].name
+            res.add(
+                "hbm-budget",
+                f"hbm-budget: {kernel}: rank {r} predicted peak "
+                f"{pred}B ({peak[r]}B resident x "
+                f"{res.staging_factor:g} staging) exceeds budget "
+                f"{res.budget}B at step {step} task {tname}; "
+                f"live set ({len(lv)} tiles): [{preview}]",
+                task=tname, tile=big, step=step)
+        if not res.ok and derive_streaming:
+            plan = plan_stream(rec, mb=mb, nb=nb, itemsize=itemsize,
+                               lookahead=lookahead, budget=res.budget,
+                               kernel=kernel)
+            feas = not simulate_stream(plan, budget=res.budget,
+                                       kernel=kernel)
+            res.stream = plan.summary()
+            res.stream["feasible"] = feas
+    return res
+
+
+def verify_schedule(rec, **kw) -> MemResult:
+    """:func:`check_schedule` that raises :class:`MemCheckError` on
+    any diagnostic — the driver-facing verify-before-run entry."""
+    res = check_schedule(rec, **kw)
+    if not res.ok:
+        raise MemCheckError(res)
+    return res
+
+
+def cross_validate(predicted: int, measured: int, kernel: str,
+                   band: Optional[float] = None
+                   ) -> List[MemDiagnostic]:
+    """Reconcile the model's predicted HBM peak against hlocheck's
+    *measured* ``memory_analysis`` peak for the same op.  Predicted
+    must dominate measured — a compiled temp the liveness model
+    missed is a named ``missed-temp`` finding — and stay within the
+    documented slack band (MCA ``memcheck.slack_band``): above
+    ``measured * band`` the allowance is uselessly loose
+    (``model-slack``)."""
+    if band is None:
+        band = _cfg.mca_get_float("memcheck.slack_band", 8.0)
+    out: List[MemDiagnostic] = []
+    if measured is None or measured <= 0:
+        return out
+    if predicted < measured:
+        out.append(MemDiagnostic(
+            "missed-temp",
+            f"missed-temp: {kernel}: compiled HBM peak {measured}B "
+            f"exceeds the predicted {predicted}B — a compiled temp "
+            f"the liveness model missed"))
+    elif predicted > measured * band:
+        out.append(MemDiagnostic(
+            "model-slack",
+            f"model-slack: {kernel}: predicted {predicted}B is more "
+            f"than {band:g}x the measured {measured}B — the staging "
+            f"allowance is uselessly loose"))
+    return out
+
+
+# ---------------------------------------------------------------------
+# Streaming-schedule simulator
+# ---------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class StreamOp:
+    """One abstract host<->HBM streaming event (the RingOp of the
+    residency engine).  ``step`` is the engine tick; a ``fetch``'s
+    step is its DMA *issue* step, a ``compute``'s step is when its
+    ``reads`` must be resident, an ``evict``'s step frees (and, when
+    ``dirty``, writes back) its tile."""
+
+    kind: str                 # fetch | compute | evict
+    step: int
+    tile: str = ""
+    bytes: int = 0
+    reads: Tuple[str, ...] = ()
+    dirty: bool = False
+
+    def as_dict(self) -> dict:
+        return {"kind": self.kind, "step": self.step,
+                "tile": self.tile, "bytes": self.bytes,
+                "reads": list(self.reads), "dirty": self.dirty}
+
+
+def fetch(tile: str, nbytes: int, step: int) -> StreamOp:
+    return StreamOp("fetch", step, tile, int(nbytes))
+
+
+def evict(tile: str, nbytes: int, step: int,
+          dirty: bool = False) -> StreamOp:
+    return StreamOp("evict", step, tile, int(nbytes), dirty=dirty)
+
+
+def compute(step: int, *reads: str, label: str = "") -> StreamOp:
+    return StreamOp("compute", step, label, 0, tuple(reads))
+
+
+@dataclass
+class StreamPlan:
+    """A host<->HBM spill/prefetch schedule (JSON-able via
+    :meth:`summary`)."""
+
+    kernel: str = "stream"
+    budget: int = 0
+    window: int = 1           # prefetch window (chunks in flight)
+    ops: List[StreamOp] = field(default_factory=list)
+    peak_bytes: int = 0       # max HBM-resident under the plan
+    streamed_bytes: int = 0   # host->HBM fetches + dirty writebacks
+    refetches: int = 0        # Belady spill refetches (0 = compulsory
+    #                         # traffic only)
+
+    @property
+    def steps(self) -> int:
+        return max((o.step for o in self.ops), default=-1) + 1
+
+    def summary(self) -> dict:
+        return {"kernel": self.kernel, "budget": self.budget,
+                "window": self.window, "steps": self.steps,
+                "ops": len(self.ops),
+                "fetches": sum(1 for o in self.ops
+                               if o.kind == "fetch"),
+                "peak_bytes": self.peak_bytes,
+                "streamed_bytes": self.streamed_bytes,
+                "refetches": self.refetches}
+
+    def host_seconds(self, peaks: Optional[dict] = None) -> float:
+        """Price the plan's host<->HBM traffic through the roofline
+        ``host`` bound — the PCIe time a driver phase attribution
+        would assign to the streaming."""
+        from dplasma_tpu.observability import roofline as _rl
+        return _rl.expected_seconds(host_bytes=self.streamed_bytes,
+                                    peaks=peaks)[0]
+
+
+def simulate_stream(plan: StreamPlan, budget: Optional[int] = None,
+                    kernel: Optional[str] = None
+                    ) -> List[MemDiagnostic]:
+    """Abstractly execute a stream plan and verify the double-buffer
+    contract (the residency analogue of spmdcheck's
+    ``simulate_ring``).  Checks, each with a diagnostic naming
+    kernel/step/tile:
+
+    * ``prefetch-order`` — a prefetch must ISSUE strictly before the
+      step that consumes it (issue == consume means the engine waits
+      on its own DMA: deadlock);
+    * ``not-resident`` — a compute reads a tile no fetch made
+      resident;
+    * ``over-budget`` — resident bytes exceed the budget at a step;
+    * ``thrash`` — a tile is evicted and refetched with no compute
+      in between (the eviction bought nothing);
+    * ``dropped-free`` — a fetched tile is never evicted: the next
+      sweep inherits a grown resident set (the unpaired-semaphore of
+      the residency engine).
+    """
+    budget = plan.budget if budget is None else budget
+    kernel = kernel or plan.kernel
+    diags: List[MemDiagnostic] = []
+    resident: Dict[str, int] = {}
+    fetch_step: Dict[str, int] = {}
+    evict_step: Dict[str, int] = {}
+    evicted_idle: set = set()   # evicted, no compute since
+    total = 0
+    for op in sorted(plan.ops, key=lambda o: o.step):
+        if op.kind == "fetch":
+            if op.tile in evicted_idle:
+                diags.append(MemDiagnostic(
+                    "thrash",
+                    f"thrash: {kernel}: tile {op.tile} evicted at "
+                    f"step {evict_step[op.tile]} and refetched at "
+                    f"step {op.step} with no compute between — the "
+                    f"eviction bought nothing",
+                    tile=op.tile, step=op.step))
+            resident[op.tile] = op.bytes
+            fetch_step[op.tile] = op.step
+            total += op.bytes
+            if budget > 0 and total > budget:
+                diags.append(MemDiagnostic(
+                    "over-budget",
+                    f"over-budget: {kernel}: fetch of tile "
+                    f"{op.tile} at step {op.step} raises the "
+                    f"resident set to {total}B over the {budget}B "
+                    f"budget", tile=op.tile, step=op.step))
+        elif op.kind == "compute":
+            for t in op.reads:
+                if t not in resident:
+                    diags.append(MemDiagnostic(
+                        "not-resident",
+                        f"not-resident: {kernel}: compute at step "
+                        f"{op.step} reads tile {t} which no fetch "
+                        f"made resident", task=op.tile,
+                        tile=t, step=op.step))
+                elif fetch_step.get(t, -1) >= op.step:
+                    diags.append(MemDiagnostic(
+                        "prefetch-order",
+                        f"prefetch-order: {kernel}: prefetch of "
+                        f"tile {t} issues at step {fetch_step[t]} "
+                        f"but its consumer computes at step "
+                        f"{op.step} — the engine deadlocks waiting "
+                        f"on its own DMA", task=op.tile,
+                        tile=t, step=op.step))
+            evicted_idle.clear()
+        elif op.kind == "evict":
+            if op.tile in resident:
+                total -= resident.pop(op.tile)
+                evict_step[op.tile] = op.step
+                evicted_idle.add(op.tile)
+    for t, s in sorted(fetch_step.items()):
+        if t in resident:
+            diags.append(MemDiagnostic(
+                "dropped-free",
+                f"dropped-free: {kernel}: tile {t} fetched at step "
+                f"{s} is never freed — the next sweep inherits a "
+                f"grown resident set", tile=t, step=s))
+    return diags
+
+
+def plan_stream(rec, *, mb: int, nb: int, itemsize: float,
+                budget: int, lookahead: int = 0,
+                kernel: str = "stream") -> StreamPlan:
+    """Derive the minimal host<->HBM spill/prefetch schedule for a
+    recorded DAG under ``budget`` bytes of device residency.  Walks
+    the wavefront order; each task's tile working set is fetched
+    (issue step strictly before the consume step — the prefetch
+    hides behind the preceding compute, the lookahead window being
+    the prefetch window) and capacity is made by evicting the
+    resident tile whose next use is farthest (Belady MIN — the
+    offline-optimal policy, so the refetch count is minimal).
+    Evictions of written tiles are dirty (write back to host) and
+    priced into ``streamed_bytes``."""
+    tasks = list(rec.tasks)
+    try:
+        order = list(rec.order(lookahead))
+    except Exception:
+        order = list(range(len(tasks)))
+    tile_b = int(round(mb * nb * itemsize))
+
+    use_steps: Dict[tuple, List[int]] = {}
+    written: Dict[tuple, bool] = {}
+    sched: List[Tuple[str, List[tuple]]] = []
+    for s, tid in enumerate(order):
+        t = tasks[tid]
+        reads, writes = _accesses(t)
+        keys = list(dict.fromkeys(reads + writes))
+        sched.append((t.name, keys))
+        for key in keys:
+            use_steps.setdefault(key, []).append(s)
+        for key in writes:
+            written[key] = True
+
+    plan = StreamPlan(kernel=kernel, budget=budget,
+                      window=max(lookahead, 1))
+    resident: Dict[tuple, int] = {}
+    nextuse: Dict[tuple, List[int]] = {
+        k: list(reversed(v)) for k, v in use_steps.items()}
+    seen: set = set()
+    step = 0
+    total = 0
+
+    def name(key):
+        m, i, j = key
+        return f"{m}({i},{j})"
+
+    for s, (tname, keys) in enumerate(sched):
+        needed = [k for k in keys if k not in resident]
+        for key in needed:
+            while budget > 0 and total + tile_b > budget and resident:
+                victims = [k for k in resident if k not in keys]
+                if not victims:
+                    break   # working set alone exceeds the budget —
+                #           # simulate_stream names the over-budget
+                victim = max(victims, key=lambda k: (
+                    nextuse[k][-1] if nextuse[k] else 1 << 60))
+                plan.ops.append(evict(
+                    name(victim), tile_b, step,
+                    dirty=written.get(victim, False)))
+                if written.get(victim, False):
+                    plan.streamed_bytes += tile_b
+                total -= resident.pop(victim)
+                step += 1
+            plan.ops.append(fetch(name(key), tile_b, step))
+            plan.streamed_bytes += tile_b
+            if key in seen:
+                plan.refetches += 1
+            seen.add(key)
+            resident[key] = tile_b
+            total += tile_b
+            step += 1
+            plan.peak_bytes = max(plan.peak_bytes, total)
+        plan.ops.append(compute(step, *[name(k) for k in keys],
+                                label=tname))
+        step += 1
+        for key in keys:
+            if nextuse[key] and nextuse[key][-1] == s:
+                nextuse[key].pop()
+            if not nextuse[key]:
+                plan.ops.append(evict(
+                    name(key), tile_b, step,
+                    dirty=written.get(key, False)))
+                if written.get(key, False):
+                    plan.streamed_bytes += tile_b
+                total -= resident.pop(key)
+                step += 1
+    plan.peak_bytes = max(plan.peak_bytes, total)
+    return plan
+
+
+# ---------------------------------------------------------------------
+# The lowmem tiers: blocking inequality + column-schedule plans
+# ---------------------------------------------------------------------
+
+def lowmem_blocking(op: str, N: int, itemsize: float,
+                    budget_bytes: int, nb: int = 512,
+                    align: int = 32) -> dict:
+    """The lowmem tiers' working-set inequality, owned by the
+    analyzer so the ops' planners DERIVE their blocking from the same
+    accounting :func:`lowmem_plan` simulates (it used to live
+    op-by-op in ops/).  Device-resident bytes per panel step:
+
+    * ``potrf``  — one (N, nb) panel + one (N, cw) streamed chunk +
+      update temporaries (~two more panels): ``N*(cw + 3*nb) <=
+      budget``.  Returns ``{"nb", "cw"}`` (the historical
+      ``plan_potrf_lowmem`` split: ``nb = min(512, cols//4)``,
+      ``cw`` the remainder).
+    * ``getrf``  — one full (N, nb) column + one (<=N, cw) streamed
+      block + panel temporaries: ``cw`` is the largest nb-multiple
+      with ``3*N*cw*item <= budget``.  Returns ``{"nb", "cw"}``.
+    * ``geqrf``  — one (N, nb) column + one streamed (V, T) pair +
+      apply temporaries (~3 panels): shrinks ``nb`` to the largest
+      ``align``-multiple with ``3*N*nb*item <= budget``.  Returns
+      ``{"nb", "cw": nb}`` (the V/T stream reuses the panel width).
+    """
+    item = float(itemsize)
+    if op == "potrf":
+        per_col = N * item
+        cols = max(int(budget_bytes // per_col), 4)
+        nbp = max(min(512, cols // 4), 1)
+        cw = max(cols - 3 * nbp, nbp)
+        return {"nb": nbp, "cw": cw}
+    if op == "getrf":
+        cw = max(int(budget_bytes / (3 * N * item)) // nb * nb, nb)
+        return {"nb": nb, "cw": cw}
+    if op == "geqrf":
+        fit = max(align,
+                  int(budget_bytes / (3 * N * item)) // align * align)
+        nbq = min(nb, fit)
+        return {"nb": nbq, "cw": nbq}
+    raise ValueError(f"lowmem_blocking: unknown op {op!r}")
+
+
+def lowmem_plan(op: str, N: int, *, nb: int, cw: Optional[int] = None,
+                itemsize: float = 8.0,
+                kernel: Optional[str] = None) -> StreamPlan:
+    """Rebuild the EXISTING lowmem tier's left-looking column
+    schedule (``potrf_lowmem`` / ``getrf_lowmem`` / ``geqrf_lowmem``
+    in ops/) as an explicit :class:`StreamPlan` — fetch the panel
+    column, stream each finished chunk (prefetch issued strictly
+    before its consuming update: the engine double-buffers), factor,
+    write back.  :func:`simulate_stream` verifying this plan feasible
+    under the :func:`lowmem_blocking` budget is the contract that
+    the shipped loops and this analyzer agree."""
+    kernel = kernel or f"{op}_lowmem"
+    plan = StreamPlan(kernel=kernel, window=2)
+    item = float(itemsize)
+    step = 0
+
+    def emit_fetch(tag, nbytes):
+        nonlocal step
+        plan.ops.append(fetch(tag, int(nbytes), step))
+        plan.streamed_bytes += int(nbytes)
+        step += 1
+
+    def emit_evict(tag, nbytes, dirty=False):
+        nonlocal step
+        plan.ops.append(evict(tag, int(nbytes), step, dirty=dirty))
+        if dirty:
+            plan.streamed_bytes += int(nbytes)
+        step += 1
+
+    def emit_compute(label, *reads):
+        nonlocal step
+        plan.ops.append(compute(step, *reads, label=label))
+        step += 1
+
+    peak = 0
+    if op == "potrf":
+        assert cw is not None, "potrf lowmem plan needs cw"
+        for s in range(0, N, nb):
+            w = min(nb, N - s)
+            colb = (N - s) * w * item
+            col = f"col({s})"
+            emit_fetch(col, colb)
+            for j0 in range(0, s, cw):
+                j1 = min(j0 + cw, s)
+                wb = (N - s) * (j1 - j0) * item
+                W = f"W({s},{j0})"
+                emit_fetch(W, wb)
+                peak = max(peak, colb + wb)
+                emit_compute(f"upd({s},{j0})", col, W)
+                emit_evict(W, wb)
+            emit_compute(f"panel({s})", col)
+            emit_evict(col, colb, dirty=True)
+            peak = max(peak, colb)
+    elif op == "getrf":
+        assert cw is not None, "getrf lowmem plan needs cw"
+        for s in range(0, N, nb):
+            w = min(nb, N - s)
+            colb = N * w * item
+            col = f"col({s})"
+            emit_fetch(col, colb)
+            for j0 in range(0, s, cw):
+                j1 = min(j0 + cw, s)
+                wb = (N - j0) * (j1 - j0) * item
+                W = f"W({s},{j0})"
+                emit_fetch(W, wb)
+                peak = max(peak, colb + wb)
+                emit_compute(f"lu_apply({s},{j0})", col, W)
+                emit_evict(W, wb)
+            emit_compute(f"panel({s})", col)
+            emit_evict(col, colb, dirty=True)
+            peak = max(peak, colb)
+    elif op == "geqrf":
+        KT = -(-N // nb)
+        for kk in range(KT):
+            s = kk * nb
+            w = min(nb, N - s)
+            colb = N * w * item
+            col = f"col({s})"
+            emit_fetch(col, colb)
+            for j in range(kk):
+                s0 = j * nb
+                vb = (N - s0) * nb * item
+                tb = nb * nb * item
+                V, T = f"V({s0})", f"T({s0})"
+                emit_fetch(V, vb)
+                emit_fetch(T, tb)
+                peak = max(peak, colb + vb + tb)
+                emit_compute(f"qr_apply({s},{s0})", col, V, T)
+                emit_evict(V, vb)
+                emit_evict(T, tb)
+            emit_compute(f"panel({s})", col)
+            emit_evict(col, colb, dirty=True)
+            peak = max(peak, colb)
+    else:
+        raise ValueError(f"lowmem_plan: unknown op {op!r}")
+    plan.peak_bytes = peak
+    return plan

@@ -94,6 +94,7 @@ class IParam:
     dagcheck: bool = False           # static dataflow verification
     spmdcheck: bool = False          # SPMD collective-schedule check
     hlocheck: bool = False           # compiled-HLO artifact audit
+    memcheck: bool = False           # static HBM-residency check
     # observability outputs (--profile/--report/--jaxtrace)
     profile: Optional[str] = None    # DTPUPROF1 binary trace
     report: Optional[str] = None     # versioned JSON run-report
@@ -194,6 +195,21 @@ Optional arguments:
                      host-callback / copy-volume anti-patterns;
                      violations abort the run and the summary lands
                      in the run-report (v10)
+ --memcheck        : statically verify the schedule's HBM residency
+                     before anything executes (analysis.memcheck):
+                     per-tile live intervals over the wavefront
+                     linearization, per-rank peak resident bytes
+                     under the block-cyclic distribution (dd limb
+                     widths priced in), predicted HBM peak gated
+                     against MCA memcheck.hbm_budget with the
+                     peak-driving task/tile/live-set named, and a
+                     spill/prefetch streaming plan derived when the
+                     budget forces one; violations abort the run and
+                     the summary lands in the run-report (v16).
+                     With --hlocheck also on, the prediction is
+                     cross-validated against the measured
+                     memory_analysis peak (a compiled temp the model
+                     missed is a named finding)
  --profile[=file]  : write the binary DTPUPROF1 run trace (convert with
                      tools/tracecat.py; default file: run.prof)
  --report[=file]   : write the versioned JSON run-report (timings,
@@ -298,6 +314,7 @@ _LONG = {
     "dagcheck": ("dagcheck", None),
     "spmdcheck": ("spmdcheck", None),
     "hlocheck": ("hlocheck", None),
+    "memcheck": ("memcheck", None),
     "phase-profile": ("phase_profile", None),
     "devprof": ("devprof", None),
     "peaks-file": ("peaks_file", str),
@@ -754,6 +771,47 @@ class Driver:
             raise dc.DagCheckError(res)
         return res
 
+    def _memcheck(self, rec, name):
+        """--memcheck: statically verify the recorded schedule's HBM
+        residency (analysis.memcheck) before the timed loop runs —
+        per-tile live intervals over the wavefront linearization the
+        runtime executes, per-rank peak resident bytes under the
+        block-cyclic distribution with dd limb pricing, and the
+        predicted-HBM-peak gate against MCA ``memcheck.hbm_budget``
+        (the diagnostic names the peak-driving task, tile, and live
+        set; a spill/prefetch streaming plan is derived when the
+        budget forces one). The summary lands in the run-report
+        (schema v16 ``"memcheck"`` section); violations raise
+        MemCheckError so an over-budget schedule never executes.
+        When --hlocheck also runs, its measured memory_analysis peak
+        cross-validates the prediction (see :meth:`_hlocheck`)."""
+        from dplasma_tpu.analysis import memcheck as mc
+        from dplasma_tpu.descriptors import Dist
+        ip = self.ip
+        dist = Dist(P=ip.P, Q=ip.Q, kp=ip.kp, kq=ip.kq)
+        item = mc.effective_itemsize(PRECISIONS[ip.prec])
+        res = mc.check_schedule(
+            rec, mb=max(ip.MB, 1), nb=max(ip.NB, 1), itemsize=item,
+            dist=dist, lookahead=self.pipeline["sweep.lookahead"],
+            kernel=name)
+        entry = self.report.add_memcheck(name, res.summary())
+        self._memcheck_last = (res, entry)
+        lbl = dict(op=name, prec=ip.prec)
+        reg = self.report.metrics
+        reg.counter("memcheck_tiles_total", **lbl).inc(res.tiles)
+        reg.counter("memcheck_diagnostics_total", **lbl).inc(
+            len(res.diagnostics))
+        reg.gauge("memcheck_peak_bytes", **lbl).set(
+            res.resident_peak_bytes)
+        reg.gauge("memcheck_predicted_hbm_peak_bytes", **lbl).set(
+            res.predicted_hbm_peak_bytes)
+        if ip.rank == 0 and (ip.loud >= 2 or not res.ok):
+            print(res.format(name))
+            sys.stdout.flush()
+        if not res.ok:
+            raise mc.MemCheckError(res)
+        return res
+
     def _spmdcheck(self, fn, args, name):
         """--spmdcheck: extract the collective schedule of the program
         about to run (jaxpr-level, no execution) and verify the
@@ -871,6 +929,29 @@ class Driver:
         if res.hbm_peak_bytes is not None:
             reg.gauge("hlocheck_hbm_peak_bytes", **lbl).set(
                 res.hbm_peak_bytes)
+            mem_last = getattr(self, "_memcheck_last", None)
+            if mem_last is not None:
+                # --memcheck ran on this op's recording: reconcile
+                # the static prediction against the MEASURED compiled
+                # peak. A miss (prediction below measurement) is a
+                # named finding — the model let a compiled temp
+                # escape — recorded on the report entry and in
+                # metrics, never fatal (the gate already passed on
+                # the documented model).
+                from dplasma_tpu.analysis import memcheck as mc
+                mres, mentry = mem_last
+                findings = mc.cross_validate(
+                    mres.predicted_hbm_peak_bytes,
+                    res.hbm_peak_bytes, name)
+                mentry["cross"] = {
+                    "measured_hbm_peak_bytes": res.hbm_peak_bytes,
+                    "findings": [d.as_dict() for d in findings]}
+                reg.counter("memcheck_cross_findings_total",
+                            **lbl).inc(len(findings))
+                for d in findings:
+                    sys.stderr.write(
+                        f"#! memcheck[{name}]: {d.message}\n")
+                self._memcheck_last = None
         if ip.rank == 0 and (ip.loud >= 2 or not res.ok):
             print(res.format(name))
             sys.stdout.flush()
@@ -1067,6 +1148,7 @@ class Driver:
                     max(-(-ip.K // max(ip.NB, 1)), 1)
                 want_dag = dag_fn is not None and (
                     ip.dot or ip.dagcheck
+                    or getattr(ip, "memcheck", False)
                     or ((ip.report or ip.loud >= 3)
                         and tiles <= _DAG_TILE_CAP))
                 if want_dag:
@@ -1085,11 +1167,20 @@ class Driver:
                             # violation aborts the run here, before
                             # the timed loop ever dispatches
                             self._dagcheck(rec, name)
+                        if getattr(ip, "memcheck", False):
+                            # residency gate on the same recording:
+                            # an over-budget schedule aborts here,
+                            # before the timed loop ever dispatches
+                            self._memcheck(rec, name)
                         dag_info = dag_stats(rec)
                     if ip.rank == 0 and ip.loud >= 3:
                         print(format_dag_stats(dag_info, name))
                 elif ip.dagcheck and ip.rank == 0 and ip.loud >= 1:
                     print(f"#+ dagcheck[{name}]: no analytic tile-DAG "
+                          f"builder for this op; skipped")
+                elif getattr(ip, "memcheck", False) and ip.rank == 0 \
+                        and ip.loud >= 1:
+                    print(f"#+ memcheck[{name}]: no analytic tile-DAG "
                           f"builder for this op; skipped")
                 if getattr(ip, "spmdcheck", False):
                     # verify the traced SPMD program's collective

@@ -76,6 +76,14 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                    "total_bytes",
                    "diagnostics": [{"kind", "message", "kernel",
                                     "op", "detail"}]}],   # (v10)
+     "memcheck": [{"op", "ok", "kernel", "tasks", "tiles",
+                   "peak_bytes", "predicted_hbm_peak_bytes",
+                   "peak_by_rank": {rank: bytes},
+                   "peak_task", "live_at_peak", "budget",
+                   "staging_factor", "stream",  # plan | null
+                   "counts": {kind: n},
+                   "diagnostics": [{"kind", "message", "task",
+                                    "tile", "step"}]}],   # (v16)
      "tuning": [{"op", "key", "source",  # db|interpolated|default
                  "db",                   # DB path | null
                  "knobs",       # the consulted DB knob vector | null
@@ -199,9 +207,17 @@ conservation audit proving submitted == resolved + shed with zero
 lost futures, reconciled against the flight-recorder ring; perfdiff
 gates ``serving.shed_frac`` and ``serving.deadline_miss_frac``
 lower-better, and servebench's ``"serving"`` entries gain
-``admission_overhead_frac``, gated like ``trace_overhead_frac``).
+``admission_overhead_frac``, gated like ``trace_overhead_frac``);
+16 adds ``"memcheck"`` (the static tile-liveness & HBM-residency
+verification — analysis.memcheck: per-rank structural resident peak
+from the recorded DAG's live intervals, the predicted HBM peak under
+the documented compiled-staging allowance, the budget gate vs MCA
+``memcheck.hbm_budget`` with the peak-driving task/tile/live-set
+diagnostics, and the streaming-simulator plan summary when the
+budget forces spill/prefetch; perfdiff gates
+``memcheck.peak_bytes`` lower-better).
 All additive — v1 readers of the other keys are unaffected; this
-reader accepts <= 15 (:func:`load_report` tolerates every v1-v15
+reader accepts <= 16 (:func:`load_report` tolerates every v1-v16
 vintage, filling the always-present keys).
 """
 from __future__ import annotations
@@ -214,7 +230,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 15
+REPORT_SCHEMA = 16
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -254,6 +270,7 @@ class RunReport:
         self.refine: List[dict] = []    # IR-solver records (v7)
         self.serving: List[dict] = []   # serving-layer records (v8)
         self.hlocheck: List[dict] = []  # --hlocheck audits (v10)
+        self.memcheck: List[dict] = []  # --memcheck residency (v16)
         self.tuning: List[dict] = []    # --autotune consultations (v11)
         self.scaling: List[dict] = []   # per-chip-count curves (v12)
         self.telemetry: Optional[dict] = None  # live instruments (v13)
@@ -325,6 +342,13 @@ class RunReport:
         see analysis.hlocheck.HloResult.summary)."""
         entry = {"op": op, **summary}
         self.hlocheck.append(entry)
+        return entry
+
+    def add_memcheck(self, op: str, summary: dict) -> dict:
+        """Record one --memcheck static residency verification
+        (schema v16; see analysis.memcheck.MemResult.summary)."""
+        entry = {"op": op, **summary}
+        self.memcheck.append(entry)
         return entry
 
     def add_tuning(self, summary: dict) -> dict:
@@ -399,6 +423,8 @@ class RunReport:
             doc["serving"] = self.serving
         if self.hlocheck:
             doc["hlocheck"] = self.hlocheck
+        if self.memcheck:
+            doc["memcheck"] = self.memcheck
         if self.tuning:
             doc["tuning"] = self.tuning
         if self.scaling:
@@ -443,7 +469,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v14) loads: the schema history is purely
+    Every older vintage (v1-v15) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

@@ -44,11 +44,12 @@ DEFAULT_PEAKS = {
     "mxu_gflops": 200.0,   # sustained matmul rate
     "hbm_gbps": 50.0,      # main-memory streaming bandwidth
     "ici_gbps": 10.0,      # cross-chip interconnect bandwidth
+    "host_gbps": 5.0,      # host<->HBM (PCIe) streaming bandwidth
     "latency_us": 50.0,    # per-dispatch overhead
 }
 
 #: resource labels, in tie-break precedence order
-BOUNDS = ("mxu", "hbm", "ici", "latency")
+BOUNDS = ("mxu", "hbm", "ici", "host", "latency")
 
 #: bench peaks-dict key per precision letter (the ladder probes the
 #: f32-HIGHEST GEMM peak and the int8-limb f64-equivalent bound)
@@ -130,18 +131,23 @@ def resolve_peaks(path: Optional[str] = None,
 
 def expected_seconds(flops: float = 0.0, hbm_bytes: float = 0.0,
                      ici_bytes: float = 0.0, dispatches: int = 0,
-                     peaks: Optional[dict] = None):
+                     peaks: Optional[dict] = None,
+                     host_bytes: float = 0.0):
     """Roofline lower bound for one phase/op.
 
     Returns ``(expected_s, bound, components_s)`` where ``bound`` names
     the binding resource and ``components_s`` carries every resource's
     individual bound (so a report reader sees how close the runner-up
-    is)."""
+    is).  ``host_bytes`` is host<->HBM (PCIe) traffic — the lowmem
+    tiers' streamed bytes, priced by memcheck's streaming simulator —
+    so an out-of-core phase can attribute as ``host``-bound."""
     p = peaks or DEFAULT_PEAKS
     comp = {
         "mxu": flops / (p["mxu_gflops"] * 1e9),
         "hbm": hbm_bytes / (p["hbm_gbps"] * 1e9),
         "ici": ici_bytes / (p["ici_gbps"] * 1e9),
+        "host": host_bytes / (p.get("host_gbps",
+                                    DEFAULT_PEAKS["host_gbps"]) * 1e9),
         "latency": dispatches * p["latency_us"] * 1e-6,
     }
     bound = max(BOUNDS, key=lambda b: comp[b])
@@ -260,6 +266,18 @@ def ring_phase_demand(op_class: str, M: int, N: int, nb: int,
     ici = sum(v for k, v in model["bytes_by_collective"].items()
               if "panel" in k and "bcast" in k)
     return {"ici_bytes": float(ici)}
+
+
+def stream_phase_demand(streamed_bytes: float) -> Optional[dict]:
+    """A streaming (lowmem/out-of-core) span's demand: the host<->HBM
+    bytes the memcheck streaming simulator priced for the sweep
+    (:class:`dplasma_tpu.analysis.memcheck.StreamPlan`
+    ``streamed_bytes``), attributed through the roofline ``host``
+    bound — the component that makes ``bound == "host"`` (a
+    PCIe-bound phase) reachable in the phase table."""
+    if not streamed_bytes or streamed_bytes <= 0:
+        return None
+    return {"host_bytes": float(streamed_bytes)}
 
 
 def phase_model(op_class: Optional[str], M: int, N: int, nb: int,
@@ -422,7 +440,10 @@ def attribute_phases(ledger, model: Optional[dict],
     while the residual stays at the dd rate), carry an ``ici_bytes``
     demand (the ``ring`` span of the cyclic kernels — the component
     that makes ``bound == "ici"`` reachable in the phase table; it
-    never was before this join passed ICI bytes through), and declare
+    never was before this join passed ICI bytes through), carry a
+    ``host_bytes`` demand (:func:`stream_phase_demand` — the lowmem
+    tiers' PCIe streaming, making ``bound == "host"`` reachable), and
+    declare
     itself ``inclusive``: its demand covers the whole region
     INCLUDING enclosed child spans (the IR ``factor`` span wraps the
     inner factorization sweep, whose panel/lookahead/... spans carry
@@ -441,7 +462,8 @@ def attribute_phases(ledger, model: Optional[dict],
                 flops=demand.get("flops", 0.0) * scale,
                 hbm_bytes=demand.get("hbm_bytes", 0.0) * scale,
                 ici_bytes=demand.get("ici_bytes", 0.0) * scale,
-                dispatches=row["count"], peaks=pk)
+                dispatches=row["count"], peaks=pk,
+                host_bytes=demand.get("host_bytes", 0.0) * scale)
             if demand.get("inclusive"):
                 meas = row.get("total_s", meas)
         elif demand is not None:

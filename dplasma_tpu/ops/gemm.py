@@ -157,7 +157,7 @@ def gemm_stream(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
     Mp, Kp = a.shape
     Np = bm.shape[1]
     Cp = C.zero_pad()
-    out = Cp.data * jnp.asarray(beta, C.dtype)
+    out = Cp.data * jnp.asarray(beta, C.dtype)  # jaxlint: ok=J010 (scalar)
 
     brow = plan.b * mb            # C block rows
     bcol = plan.c * nb            # C block cols
@@ -168,7 +168,7 @@ def gemm_stream(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
     if ktot != Kp:
         a = jnp.pad(a, ((0, 0), (0, ktot - Kp)))
         bm = jnp.pad(bm, ((0, ktot - Kp), (0, 0)))
-    al = jnp.asarray(alpha, C.dtype)
+    al = jnp.asarray(alpha, C.dtype)  # jaxlint: ok=J010 (scalar)
 
     for i0 in range(0, Mp, brow):
         i1 = min(i0 + brow, Mp)
@@ -238,7 +238,7 @@ def gemm_summa(alpha, A: TileMatrix, B: TileMatrix, beta, C: TileMatrix,
     kb = Kp2 // quant
     nsteps = Kp2 // kb
     kq, kp = Kp2 // Qn, Kp2 // Pn
-    al = jnp.asarray(alpha, C.dtype)
+    al = jnp.asarray(alpha, C.dtype)  # jaxlint: ok=J010 (scalar)
     be = jnp.asarray(beta, C.dtype)
 
     def local(a_loc, b_loc, c_loc):

@@ -823,8 +823,12 @@ def getrf_lowmem(A, nb: int = 512, budget_bytes: int | None = None):
         except ValueError:
             frac = 0.95
         budget_bytes = int(frac * gemm_mod.device_memory_bytes())
+    from dplasma_tpu.analysis import memcheck as _mc
     item = np.dtype(Ah.dtype).itemsize
-    cw = max(int(budget_bytes / (3 * N * item)) // nb * nb, nb)
+    # chunk width from the analyzer's working-set inequality — the
+    # same accounting memcheck.lowmem_plan simulates feasible
+    cw = _mc.lowmem_blocking("getrf", N, item, budget_bytes,
+                             nb=nb)["cw"]
     perm = np.arange(N)
     for s in range(0, N, nb):
         w = min(nb, N - s)

@@ -269,13 +269,15 @@ def plan_potrf_lowmem(N: int, dtype, budget_bytes: int):
     one (N, nb) panel + one (N, cw) finished-column chunk + update
     temporaries (~one more panel) — fits the budget.  Mirrors the
     reference's lowmem blocking inequality (zgemm_wrapper.c:261-305
-    against GPU memory)."""
+    against GPU memory).  The inequality itself lives in
+    :func:`dplasma_tpu.analysis.memcheck.lowmem_blocking` — the
+    blocking is DERIVED from the residency analyzer, which also
+    simulates the resulting column schedule feasible
+    (memcheck.lowmem_plan / simulate_stream)."""
+    from dplasma_tpu.analysis import memcheck as _mc
     item = jnp.dtype(dtype).itemsize
-    per_col = N * item
-    cols = max(int(budget_bytes // per_col), 4)
-    nb = max(min(512, cols // 4), 1)
-    cw = max(cols - 3 * nb, nb)
-    return nb, cw
+    blk = _mc.lowmem_blocking("potrf", N, item, budget_bytes)
+    return blk["nb"], blk["cw"]
 
 
 def potrf_lowmem(A, nb: int | None = None,

@@ -440,9 +440,12 @@ def geqrf_lowmem(A, nb: int = 512, budget_bytes: int | None = None):
     N = Ah.shape[0]
     assert Ah.shape[1] == N, "geqrf_lowmem: square only"
     if budget_bytes is not None:
+        from dplasma_tpu.analysis import memcheck as _mc
         item = np.dtype(Ah.dtype).itemsize
-        fit = max(32, int(budget_bytes / (3 * N * item)) // 32 * 32)
-        nb = min(nb, fit)
+        # panel width from the analyzer's working-set inequality —
+        # the same accounting memcheck.lowmem_plan simulates feasible
+        nb = _mc.lowmem_blocking("geqrf", N, item, budget_bytes,
+                                 nb=nb)["nb"]
     KT = -(-N // nb)
     Ts = np.zeros((nb, KT * nb), Ah.dtype)
     for kk in range(KT):

@@ -305,7 +305,32 @@ class ExecutableCache:
                 "serving_hlocheck_diagnostics_total").inc(
                 len(res.diagnostics))
             sys.stderr.write(res.format(f"serving:{key.op}") + "\n")
+        self._residency_audit(res, key)
         return res.summary()
+
+    def _residency_audit(self, res, key: CacheKey) -> None:
+        """Residency gate on the MEASURED peak of an admitted
+        executable (analysis.memcheck): serving has no recorded tile
+        DAG to predict from, so the audit compares the compiled
+        ``memory_analysis`` peak against MCA ``memcheck.hbm_budget``
+        directly — a long-lived cache must not admit an executable
+        whose working set already busts the device budget. Never
+        fatal: ``serving_memcheck_*`` metrics + stderr (MCA
+        ``memcheck.serving`` = off disables)."""
+        if _cfg.mca_get("memcheck.serving", "on") == "off":
+            return
+        budget = _cfg.mca_get_int("memcheck.hbm_budget", 0)
+        peak = getattr(res, "hbm_peak_bytes", None)
+        if budget <= 0 or peak is None:
+            return
+        self.metrics.counter("serving_memcheck_audits_total").inc()
+        if peak > budget:
+            self.metrics.counter(
+                "serving_memcheck_violations_total").inc()
+            sys.stderr.write(
+                f"#! memcheck[serving:{key.op}]: measured HBM peak "
+                f"{peak}B exceeds memcheck.hbm_budget {budget}B "
+                f"(n={key.n} batch={key.batch})\n")
 
     def invalidate(self, key: CacheKey) -> bool:
         """Drop one entry (a poisoned executable after a detected

@@ -448,7 +448,7 @@ def test_run_report_admission_section_roundtrip(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 15
+    assert doc["schema"] == REPORT_SCHEMA == 16
     assert doc["admission"]["admitted"] == 1
     assert doc["admission"]["audit"]["balanced"] is True
     assert doc["admission"]["retry_budget"] == {"limit": 0, "used": 0}
@@ -476,7 +476,7 @@ def test_servebench_soak_audit_balances_under_chaos(tmp_path):
                           "--mca", "serving.max_queue=4"])
     assert rc == 0
     doc = json.load(open(rep))
-    assert doc["schema"] == 15
+    assert doc["schema"] == 16
     audit = doc["admission"]["audit"]
     assert audit["balanced"] is True
     assert audit["submitted"] == audit["admitted"] + audit["shed"]

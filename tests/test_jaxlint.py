@@ -269,6 +269,83 @@ def test_j009_donated_package_sites_still_clean():
         assert bad, f"{rel}: J009 must fire when donation is removed"
 
 
+def test_j010_full_operand_materialize():
+    """A lowmem/streaming path that device-transfers a WHOLE host
+    operand fires; budgeted chunk slices, device-derived locals, and
+    the allowlist all clear it."""
+    assert _codes("""\
+        import jax.numpy as jnp
+        def potrf_lowmem(Ah, nb):
+            a = jnp.asarray(Ah)
+            return a
+    """) == ["J010"]
+    assert _codes("""\
+        import jax
+        def solve_stream(Ah, b):
+            return jax.device_put(Ah)
+    """) == ["J010"]
+    # a numpy view of a parameter is still the whole host operand
+    assert _codes("""\
+        import numpy as np
+        import jax.numpy as jnp
+        def getrf_lowmem(A, nb):
+            Ah = np.asarray(A)
+            return jnp.asarray(Ah)
+    """) == ["J010"]
+    # chunk slices are the budgeted idiom
+    assert _codes("""\
+        import jax.numpy as jnp
+        def potrf_lowmem(Ah, j0, j1):
+            return jnp.asarray(Ah[j0:, j0:j1])
+    """) == []
+    # names rebound to device values are not host operands
+    assert _codes("""\
+        import jax.numpy as jnp
+        def getrf_lowmem(Ah, j0, j1):
+            col = jnp.tril(jnp.asarray(Ah[:, j0:j1]))
+            return jnp.asarray(col)
+    """) == []
+    # non-lowmem functions and non-hot-path modules are not policed
+    assert _codes("""\
+        import jax.numpy as jnp
+        def solve(Ah):
+            return jnp.asarray(Ah)
+    """) == []
+    assert jaxlint.lint_source(textwrap.dedent("""\
+        import jax.numpy as jnp
+        def potrf_lowmem(Ah):
+            return jnp.asarray(Ah)
+    """), "dplasma_tpu/utils/helpers.py") == []
+    # the allowlist clears a sanctioned choke point
+    src = textwrap.dedent("""\
+        import jax.numpy as jnp
+        def stage_stream(Ah):
+            return jnp.asarray(Ah)
+    """)
+    rel = "dplasma_tpu/ops/x.py"
+    assert [c for _, c, _ in jaxlint.lint_source(src, rel)] == ["J010"]
+    jaxlint.J010_ALLOWLIST.add((rel, "stage_stream"))
+    try:
+        assert jaxlint.lint_source(src, rel) == []
+    finally:
+        jaxlint.J010_ALLOWLIST.discard((rel, "stage_stream"))
+
+
+def test_j010_package_lowmem_sites_ship_chunks():
+    """The real lowmem tiers pass J010 (chunk-slice transfers only);
+    the rule fires if a chunk transfer is widened to the whole host
+    operand."""
+    rel = "dplasma_tpu/ops/lu.py"
+    src = (REPO / rel).read_text()
+    assert [v for v in jaxlint.lint_source(src, rel)
+            if v[1] == "J010"] == []
+    widened = src.replace("jnp.asarray(Ah[j0:, j0:j1])",
+                          "jnp.asarray(Ah)")
+    assert widened != src, "expected getrf_lowmem's chunk transfer"
+    assert [v for v in jaxlint.lint_source(widened, rel)
+            if v[1] == "J010"], "J010 must fire on a widened transfer"
+
+
 def test_suppression_comment():
     assert _codes("""\
         import jax

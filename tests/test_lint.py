@@ -8,6 +8,8 @@
   telemetry concurrency surface with its racefuzz fixed-seed
   schedule smoke + the analysis.palcheck pallas-contract gate + a
   dagcheck smoke pass over tiny DAGs of all four ops + the
+  analysis.memcheck tile-liveness/residency smoke over the same DAGs
+  with its budget-gate mutation + the
   analysis.spmdcheck collective-schedule smoke over the cyclic
   kernels + the analysis.hlocheck compiled-artifact smoke over the
   cyclic kernels' post-GSPMD HLO and one serving executable + the
@@ -99,6 +101,7 @@ def test_lint_all_aggregate_is_clean(capsys):
     assert rc == 0, out.err
     for gate in ("lint_excepts", "jaxlint", "perfdiff-smoke",
                  "threadcheck", "palcheck", "dagcheck-smoke",
+                 "memcheck-smoke",
                  "spmdcheck-smoke", "serving-smoke", "hlocheck-smoke",
                  "ring-smoke", "tune-smoke", "telemetry-smoke",
                  "devprof-smoke", "soak-smoke"):

@@ -1,0 +1,474 @@
+"""Static tile-liveness & HBM-residency verification (analysis.memcheck).
+
+Golden fixtures: the four ops' recorded DAGs analyze clean on 1x1 and
+2x2 grids with a positive per-rank resident peak and a named
+peak-driving task, and the predicted HBM peak DOMINATES the compiled
+kernels' measured ``memory_analysis`` peak while staying inside the
+documented slack band (predicted >= measured and predicted <=
+measured * memcheck.slack_band — the cross-validation contract the
+driver enforces when --memcheck and --hlocheck run together).
+Mutation tests, one per check class: a shrunken budget names the
+peak task AND tile, a prefetch issued at (or past) its consume step
+is a ``prefetch-order`` deadlock finding, a dropped evict is a
+``dropped-free`` leak finding.  The streaming simulator reproduces
+the shipped lowmem tiers' left-looking column schedules as feasible
+plans under the SAME working-set inequality the ops' planners now
+derive their blocking from (the planner-agreement contract).
+"""
+import json
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dplasma_tpu.analysis import hlocheck as hc
+from dplasma_tpu.analysis import memcheck as mc
+from dplasma_tpu.descriptors import Dist, TileMatrix
+from dplasma_tpu.ops import gemm, lu, potrf, qr
+from dplasma_tpu.parallel import cyclic
+from dplasma_tpu.parallel import mesh as pmesh
+from dplasma_tpu.utils.profiling import DagRecorder
+
+NB = 4
+NT = 4
+GRIDS = [(1, 1), (2, 2)]
+OPS = ["potrf", "getrf", "geqrf", "gemm"]
+
+
+def _dag(op, dist, lookahead=0):
+    """Record the analytic tile DAG of ``op`` at NT x NT tiles."""
+    N = NT * NB
+    A = TileMatrix.zeros(N, N, NB, NB, dist=dist)
+    rec = DagRecorder(enabled=True)
+    if op == "potrf":
+        potrf.dag(A, "L", rec, lookahead=lookahead)
+    elif op == "getrf":
+        lu.dag(A, rec, lookahead=lookahead)
+    elif op == "geqrf":
+        qr.dag(A, rec, lookahead=lookahead, agg_depth=1)
+    else:
+        C = TileMatrix.zeros(N, N, NB, NB, dist=dist)
+        gemm.dag(C, A, A, rec)
+    return rec
+
+
+def _measured_peak(op, P_, Q_, devices8):
+    """The compiled cyclic kernel's memory_analysis peak (the
+    test_hlocheck._kernel fixture, reduced to its residency figure)."""
+    m = pmesh.make_mesh(P_, Q_, devices8)
+    desc = cyclic.CyclicDesc(NT * NB, NT * NB, NB, NB,
+                             Dist(P=P_, Q=Q_))
+    data = jnp.zeros((P_, Q_, desc.MTL * NB, desc.NTL * NB),
+                     jnp.float32)
+    if op == "gemm":
+        fn = partial(cyclic._gemm_cyclic_jit, adesc=desc, bdesc=desc,
+                     mesh=m)
+        args = (data, data)
+    else:
+        fn = partial({"potrf": cyclic._potrf_cyclic_jit,
+                      "getrf": cyclic._getrf_cyclic_jit,
+                      "geqrf": cyclic._geqrf_cyclic_jit}[op],
+                     desc=desc, mesh=m, lookahead=1)
+        args = (data,)
+    lowered = jax.jit(fn).lower(*args)
+    res = hc.check_executable(lowered, lowered.compile(),
+                              f"{op}_{P_}x{Q_}", prec="s")
+    assert res.hbm_peak_bytes and res.hbm_peak_bytes > 0
+    return res.hbm_peak_bytes
+
+
+# ------------------------------------------------------- golden sweep
+
+@pytest.mark.parametrize("grid", GRIDS)
+@pytest.mark.parametrize("op", OPS)
+def test_golden_liveness_sweep(op, grid):
+    """Every op's DAG analyzes clean on both grids: positive per-rank
+    peak, a named peak-driving task, and live intervals that close
+    (input + output priced, peak live set non-empty)."""
+    dist = Dist(P=grid[0], Q=grid[1])
+    rec = _dag(op, dist)
+    res = mc.check_schedule(rec, mb=NB, nb=NB, itemsize=4, dist=dist,
+                            kernel=op)
+    assert res.ok, res.format(op)
+    assert res.tasks == len(rec.tasks) and res.tiles > 0
+    assert res.resident_peak_bytes > 0
+    assert res.peak_task and res.live_at_peak > 0
+    assert res.peak_live_preview
+    assert res.predicted_hbm_peak_bytes == int(
+        res.resident_peak_bytes * res.staging_factor)
+    assert len(res.peak_by_rank) == grid[0] * grid[1]
+    assert max(res.peak_by_rank.values()) == res.resident_peak_bytes
+    assert res.input_bytes > 0 and res.output_bytes > 0
+    # the factorizations update in place: WAW reuse must be credited
+    if op != "gemm":
+        assert res.reuse_writes > 0 and res.donated_bytes > 0
+
+
+@pytest.mark.parametrize("op", ["potrf", "getrf"])
+def test_pipelined_ordering_analyzes_clean(op):
+    """The lookahead>0 pipelined DAGs (split-column task classes)
+    carry a wider live window but still analyze clean."""
+    dist = Dist()
+    rec = _dag(op, dist, lookahead=1)
+    res = mc.check_schedule(rec, mb=NB, nb=NB, itemsize=4, dist=dist,
+                            lookahead=1, kernel=op)
+    assert res.ok, res.format(op)
+    assert res.resident_peak_bytes > 0 and res.peak_task
+
+
+@pytest.mark.parametrize("grid", GRIDS)
+@pytest.mark.parametrize("op", OPS)
+def test_golden_predicted_dominates_measured(op, grid, devices8):
+    """The cross-validation contract on the golden sweep: predicted
+    HBM peak >= the compiled kernel's measured memory_analysis peak,
+    and within the documented slack band — so cross_validate returns
+    no findings for any golden case."""
+    dist = Dist(P=grid[0], Q=grid[1])
+    rec = _dag(op, dist)
+    res = mc.check_schedule(rec, mb=NB, nb=NB, itemsize=4, dist=dist,
+                            kernel=op)
+    measured = _measured_peak(op, *grid, devices8)
+    band = 8.0
+    assert res.predicted_hbm_peak_bytes >= measured, \
+        f"{op} {grid}: predicted {res.predicted_hbm_peak_bytes} < " \
+        f"measured {measured} (missed temp)"
+    assert res.predicted_hbm_peak_bytes <= measured * band, \
+        f"{op} {grid}: predicted {res.predicted_hbm_peak_bytes} > " \
+        f"{band}x measured {measured} (uselessly loose)"
+    assert mc.cross_validate(res.predicted_hbm_peak_bytes, measured,
+                             op, band=band) == []
+
+
+def test_cross_validate_names_findings():
+    """A prediction below the measurement is a missed-temp finding; a
+    prediction past the band is model-slack; inside the band is
+    clean."""
+    (d,) = mc.cross_validate(1000, 2000, "potrf", band=8.0)
+    assert d.kind == "missed-temp" and "potrf" in d.message
+    assert "2000" in d.message and "1000" in d.message
+    (d,) = mc.cross_validate(20000, 1000, "potrf", band=8.0)
+    assert d.kind == "model-slack"
+    assert mc.cross_validate(4000, 1000, "potrf", band=8.0) == []
+    assert mc.cross_validate(4000, 0, "potrf") == []
+
+
+def test_summary_round_trips():
+    dist = Dist(P=2, Q=2)
+    res = mc.check_schedule(_dag("potrf", dist), mb=NB, nb=NB,
+                            itemsize=4, dist=dist, kernel="potrf")
+    doc = json.loads(json.dumps(res.summary()))
+    assert doc["ok"] and doc["peak_bytes"] == res.resident_peak_bytes
+    assert doc["peak_task"] == res.peak_task
+    assert doc["peak_by_rank"] == {str(r): v for r, v in
+                                   res.peak_by_rank.items()}
+    assert "OK" in res.format("potrf")
+
+
+# --------------------------------------------------- budget gate
+
+def test_budget_gate_names_task_tile_and_live_set():
+    """Shrinking the budget below the structural peak produces an
+    hbm-budget diagnostic NAMING the peak-driving task and tile, with
+    the live-set preview, and attaches a stream plan showing whether
+    out-of-core execution is feasible."""
+    dist = Dist()
+    rec = _dag("potrf", dist)
+    res = mc.check_schedule(rec, mb=NB, nb=NB, itemsize=4, dist=dist,
+                            kernel="potrf", budget=NB * NB * 4)
+    assert not res.ok
+    hits = [d for d in res.diagnostics if d.kind == "hbm-budget"]
+    assert hits, res.counts
+    d = hits[0]
+    assert d.task and d.tile and d.task in d.message \
+        and d.tile in d.message
+    assert isinstance(res.stream, dict) and "feasible" in res.stream
+    # the driver-facing entry raises with the same diagnostics
+    with pytest.raises(mc.MemCheckError) as ei:
+        mc.verify_schedule(rec, mb=NB, nb=NB, itemsize=4, dist=dist,
+                           kernel="potrf", budget=NB * NB * 4)
+    assert "hbm-budget" in str(ei.value)
+
+
+def test_budget_from_mca_register():
+    """With no explicit budget the gate reads memcheck.hbm_budget (0
+    disables it)."""
+    from tests.conftest import mca_overrides
+    dist = Dist()
+    rec = _dag("potrf", dist)
+    with mca_overrides({"memcheck.hbm_budget": str(NB * NB * 4)}):
+        res = mc.check_schedule(rec, mb=NB, nb=NB, itemsize=4,
+                                dist=dist, kernel="potrf")
+    assert not res.ok and res.counts.get("hbm-budget")
+    res = mc.check_schedule(rec, mb=NB, nb=NB, itemsize=4, dist=dist,
+                            kernel="potrf")
+    assert res.ok
+
+
+# ------------------------------------------- streaming simulator
+
+def _potrf_plan(budget_tiles=4):
+    dist = Dist()
+    rec = _dag("potrf", dist)
+    tile_b = NB * NB * 4
+    return mc.plan_stream(rec, mb=NB, nb=NB, itemsize=4,
+                          budget=budget_tiles * tile_b,
+                          kernel="potrf"), tile_b
+
+
+def test_plan_stream_is_feasible_and_minimal():
+    """The Belady-evicting planner produces a plan the simulator
+    verifies clean: every prefetch issues strictly before its consume
+    step, residency never exceeds the budget, no tile leaks."""
+    plan, tile_b = _potrf_plan(budget_tiles=4)
+    assert plan.peak_bytes <= plan.budget
+    assert plan.streamed_bytes > 0 and plan.ops
+    diags = mc.simulate_stream(plan)
+    assert diags == [], [d.message for d in diags]
+    # a roomier budget never streams more (Belady refetches are
+    # monotone in capacity)
+    roomy, _ = _potrf_plan(budget_tiles=8)
+    assert roomy.refetches <= plan.refetches
+    assert roomy.streamed_bytes <= plan.streamed_bytes
+    doc = json.loads(json.dumps(plan.summary()))
+    assert doc["peak_bytes"] == plan.peak_bytes
+
+
+def test_prefetch_past_consume_is_deadlock():
+    """Mutating one fetch to issue AT its consume step breaks the
+    double-buffer contract: prefetch-order, naming kernel, step, and
+    tile."""
+    plan, _ = _potrf_plan()
+    fi = next(i for i, o in enumerate(plan.ops) if o.kind == "fetch")
+    tile = plan.ops[fi].tile
+    consume = next(o.step for o in plan.ops
+                   if o.kind == "compute" and tile in o.reads)
+    plan.ops[fi] = mc.StreamOp("fetch", consume, tile,
+                               plan.ops[fi].bytes)
+    diags = mc.simulate_stream(plan)
+    kinds = {d.kind for d in diags}
+    assert "prefetch-order" in kinds
+    d = next(d for d in diags if d.kind == "prefetch-order")
+    assert tile in d.message and "potrf" in d.message
+    assert d.step == consume
+
+
+def test_dropped_free_is_a_leak():
+    """Removing an evict leaks the tile: dropped-free names it."""
+    plan, _ = _potrf_plan()
+    # drop a tile's LAST evict (an earlier one may be followed by a
+    # Belady refetch + re-evict, which would legally free it again)
+    ei = max(i for i, o in enumerate(plan.ops) if o.kind == "evict")
+    tile = plan.ops[ei].tile
+    del plan.ops[ei]
+    diags = mc.simulate_stream(plan)
+    hits = [d for d in diags if d.kind == "dropped-free"]
+    assert hits and any(tile in d.message for d in hits)
+
+
+def test_over_budget_fetch_is_flagged():
+    """A working set that cannot fit (budget below one task's tiles)
+    is an over-budget finding, not a silent overrun."""
+    dist = Dist()
+    rec = _dag("potrf", dist)
+    tile_b = NB * NB * 4
+    plan = mc.plan_stream(rec, mb=NB, nb=NB, itemsize=4,
+                          budget=tile_b, kernel="potrf")
+    diags = mc.simulate_stream(plan)
+    assert any(d.kind == "over-budget" for d in diags)
+
+
+# ------------------------------------- lowmem tiers (the contract)
+
+LOWMEM_N = 256
+
+
+def _lowmem_budget(op, blk, item=8.0):
+    nb, cw = blk["nb"], blk["cw"]
+    if op == "potrf":
+        return int(LOWMEM_N * (cw + 3 * nb) * item)
+    if op == "getrf":
+        return int(3 * LOWMEM_N * cw * item)
+    return int(3 * LOWMEM_N * nb * item)
+
+
+@pytest.mark.parametrize("op", ["potrf", "getrf", "geqrf"])
+def test_lowmem_schedule_is_feasible(op):
+    """The shipped lowmem tier's left-looking column schedule,
+    rebuilt as a StreamPlan, simulates feasible under the SAME
+    working-set budget lowmem_blocking derives the blocking from —
+    the streaming simulator reproduces the existing column schedule
+    as a feasible plan."""
+    item = 8.0
+    budget = 64 * 1024
+    blk = mc.lowmem_blocking(op, LOWMEM_N, item, budget, nb=64)
+    plan = mc.lowmem_plan(op, LOWMEM_N, nb=blk["nb"], cw=blk["cw"],
+                          itemsize=item)
+    feas_budget = _lowmem_budget(op, blk, item)
+    diags = mc.simulate_stream(plan, budget=feas_budget)
+    assert diags == [], [d.message for d in diags]
+    assert plan.peak_bytes <= feas_budget
+    assert plan.streamed_bytes >= plan.peak_bytes
+    # the prefetch window is the double-buffer: every chunk fetch
+    # issues strictly before its consuming update
+    assert plan.window >= 2
+
+
+@pytest.mark.parametrize("op", ["potrf", "getrf", "geqrf"])
+def test_lowmem_blocking_satisfies_inequality(op):
+    """The analyzer-owned inequality holds for the blocking it
+    returns, across budgets."""
+    item = 8.0
+    for budget in (32 * 1024, 128 * 1024, 1024 * 1024):
+        blk = mc.lowmem_blocking(op, LOWMEM_N, item, budget, nb=64)
+        assert blk["nb"] >= 1 and blk["cw"] >= 1
+        # a bigger budget never shrinks the blocking
+        blk2 = mc.lowmem_blocking(op, LOWMEM_N, item, 2 * budget,
+                                  nb=64)
+        assert blk2["cw"] >= blk["cw"] and blk2["nb"] >= blk["nb"]
+
+
+def test_lowmem_planners_agree_with_analyzer():
+    """The ops' planners DERIVE their blocking from
+    memcheck.lowmem_blocking — byte-for-byte agreement, so the
+    blocking the loops run is the blocking the analyzer proved
+    feasible."""
+    import numpy as np
+    N, budget = 256, 96 * 1024
+    nb, cw = potrf.plan_potrf_lowmem(N, np.float64, budget)
+    blk = mc.lowmem_blocking("potrf", N, 8, budget)
+    assert (nb, cw) == (blk["nb"], blk["cw"])
+    # getrf/geqrf consult it inline: the tiny factorizations still
+    # agree with the dense references under a forced budget
+    rng = np.random.default_rng(7)
+    A = rng.standard_normal((64, 64))
+    spd = A @ A.T + 64 * np.eye(64)
+    blk_g = mc.lowmem_blocking("getrf", 64, 8,
+                               3 * 64 * 16 * 8, nb=16)
+    assert blk_g["cw"] % 16 == 0 and blk_g["cw"] >= 16
+    blk_q = mc.lowmem_blocking("geqrf", 64, 8, 3 * 64 * 32 * 8,
+                               nb=64)
+    assert blk_q["nb"] == 32     # shrunk to fit the V/T stream
+
+
+# ---------------------------------------------------- dd pricing
+
+def test_effective_itemsize_prices_dd_limbs():
+    """Double-double emulation widens the per-element cost by the
+    int8 limb count; plain dtypes price at their itemsize."""
+    from tests.conftest import mca_overrides
+    assert mc.effective_itemsize("float32") == 4.0
+    assert mc.effective_itemsize("float64") == 8.0
+    assert mc.dd_limb_count() == 8
+    with mca_overrides({"dd_gemm": "always"}):
+        assert mc.effective_itemsize("float64") == 16.0
+        assert mc.effective_itemsize("complex128") == 32.0
+        assert mc.effective_itemsize("float32") == 4.0
+
+
+# ----------------------------------------------- roofline host bound
+
+def test_host_bound_prices_streamed_bytes():
+    """Streamed bytes flow through the roofline's host bound:
+    stream_phase_demand feeds attribute_phases/expected_seconds, and
+    StreamPlan.host_seconds prices the plan's traffic."""
+    from dplasma_tpu.observability import roofline as rl
+    assert "host" in rl.BOUNDS
+    s, bound, comps = rl.expected_seconds(host_bytes=5e9)
+    assert bound == "host" and s == pytest.approx(1.0)
+    assert comps["host"] == pytest.approx(1.0)
+    # zero host traffic keeps legacy callers on their old bound
+    _, bound0, comps0 = rl.expected_seconds(flops=1e12, hbm_bytes=1e9)
+    assert bound0 != "host" and comps0["host"] == 0.0
+    assert rl.stream_phase_demand(0) is None
+    assert rl.stream_phase_demand(4096) == {"host_bytes": 4096.0}
+    plan, _ = _potrf_plan()
+    hs = plan.host_seconds()
+    assert hs > 0
+    assert hs == pytest.approx(
+        plan.streamed_bytes
+        / (rl.DEFAULT_PEAKS["host_gbps"] * 1e9))
+
+
+# ------------------------------------------------- perfdiff gating
+
+def test_perfdiff_gates_memcheck_peak(tmp_path):
+    """memcheck.peak_bytes is a lower-better perfdiff metric: a
+    schedule holding more tiles live regresses."""
+    import sys as _sys
+    _sys.path.insert(0, "tools")
+    import perfdiff
+
+    base = {"schema": 16, "ops": [], "metrics": [],
+            "memcheck": [{"op": "testing_dpotrf", "ok": True,
+                          "peak_bytes": 1000}]}
+    worse = {"schema": 16, "ops": [], "metrics": [],
+             "memcheck": [{"op": "testing_dpotrf", "ok": True,
+                           "peak_bytes": 1500}]}
+    m = perfdiff.extract_metrics(base)
+    assert m["testing_dpotrf.memcheck.peak_bytes"] == {
+        "value": 1000.0, "better": "lower"}
+    res = perfdiff.compare(base, worse)
+    assert not res["ok"]
+    assert res["worst"]["metric"] == "testing_dpotrf.memcheck.peak_bytes"
+    assert perfdiff.compare(worse, base)["ok"]
+
+
+# --------------------------------------------- driver end-to-end
+
+def test_driver_memcheck_end_to_end(tmp_path, capsys):
+    """--memcheck verifies residency before the timed loop and lands
+    in the schema-v16 run-report with its metrics."""
+    from dplasma_tpu.drivers import main
+    rj = str(tmp_path / "r.json")
+    rc = main(["-N", "64", "-t", "16", "--memcheck",
+               f"--report={rj}", "-v=2"], prog="testing_dpotrf")
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "memcheck[testing_dpotrf]" in out and "OK" in out
+    doc = json.load(open(rj))
+    assert doc["schema"] == 16
+    (entry,) = doc["memcheck"]
+    assert entry["ok"] and entry["peak_bytes"] > 0
+    assert entry["peak_task"]
+    assert entry["predicted_hbm_peak_bytes"] >= entry["peak_bytes"]
+    assert any(m["name"] == "memcheck_peak_bytes"
+               for m in doc["metrics"])
+    assert any(m["name"] == "memcheck_tiles_total"
+               for m in doc["metrics"])
+
+
+def test_driver_memcheck_budget_violation_aborts(tmp_path, capsys):
+    """An over-budget schedule never executes: the driver raises
+    MemCheckError naming the peak task."""
+    from tests.conftest import mca_overrides
+    from dplasma_tpu.drivers import main
+    with mca_overrides({"memcheck.hbm_budget": "64"}):
+        with pytest.raises(mc.MemCheckError) as ei:
+            main(["-N", "64", "-t", "16", "--memcheck", "-v=0"],
+                 prog="testing_dpotrf")
+    capsys.readouterr()
+    assert "hbm-budget" in str(ei.value)
+
+
+def test_driver_memcheck_hlocheck_cross_validates(tmp_path, capsys,
+                                                  devices8):
+    """--memcheck + --hlocheck: the measured memory_analysis peak
+    reconciles against the prediction and the report entry carries
+    the cross section (no findings on the golden path)."""
+    from dplasma_tpu.drivers import main
+    rj = str(tmp_path / "r.json")
+    rc = main(["-N", "64", "-t", "16", "-p", "2", "-q", "2",
+               "--memcheck", "--hlocheck", f"--report={rj}",
+               "-v=2"], prog="testing_dpotrf")
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "memcheck[testing_dpotrf]" in out
+    doc = json.load(open(rj))
+    (entry,) = doc["memcheck"]
+    assert entry["ok"]
+    cross = entry.get("cross")
+    assert cross and cross["measured_hbm_peak_bytes"] > 0
+    assert cross["findings"] == []
+    assert any(m["name"] == "memcheck_cross_findings_total"
+               for m in doc["metrics"])

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 15
+    assert doc["schema"] == REPORT_SCHEMA == 16
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -247,6 +247,29 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
                            "shed": 1, "resolved": 63, "lost": 0,
                            "flight_shed_seen": 1, "flight_dropped": 0,
                            "balanced": True}}},
+        16: {"schema": 16, "name": "v16", "ops": [], "metrics": [],
+             "memcheck": [{
+                 "op": "testing_dpotrf", "ok": True,
+                 "kernel": "potrf", "tasks": 14, "tiles": 6,
+                 "steps": 14, "itemsize": 8.0, "tile_bytes": 128.0,
+                 "peak_by_rank": {"0": 768},
+                 "peak_bytes": 768,
+                 "predicted_hbm_peak_bytes": 6144,
+                 "staging_factor": 8.0,
+                 "peak_rank": 0, "peak_step": 3,
+                 "peak_task": "trsm(2,0)",
+                 "live_at_peak": 6,
+                 "peak_live_preview": ["A[0,0]", "A[1,0]", "A[2,0]"],
+                 "input_bytes": 768, "output_bytes": 768,
+                 "reuse_writes": 8, "donated_bytes": 1024,
+                 "budget": 0,
+                 "stream": {"kernel": "potrf", "budget": 512,
+                            "window": 1, "steps": 14, "ops": 18,
+                            "fetches": 8, "peak_bytes": 512,
+                            "streamed_bytes": 2048, "refetches": 2,
+                            "feasible": True},
+                 "skipped": False,
+                 "counts": {}, "diagnostics": []}]},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -502,7 +525,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 15
+    assert doc["schema"] == 16
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

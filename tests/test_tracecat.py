@@ -176,7 +176,7 @@ def test_merge_accepts_report_phases_section(tmp_path):
     """--phases also reads a run-report: each op's phases.spans rows
     become one labelled synthetic lane."""
     _write_profile(tmp_path / "r0.prof", rank=0, tracks=(0,))
-    report = {"schema": 15, "name": "x", "metrics": [],
+    report = {"schema": 16, "name": "x", "metrics": [],
               "ops": [{"label": "testing_dpotrf",
                        "phases": {"spans": [
                            {"phase": "panel", "count": 2,

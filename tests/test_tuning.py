@@ -453,7 +453,7 @@ def test_driver_autotune_consults_db(tmp_path, monkeypatch):
     assert rc == 0
     assert config._MCA_OVERRIDES == before
     doc = json.load(open(rj))
-    assert doc["schema"] == 15
+    assert doc["schema"] == 16
     t = doc["tuning"][0]
     assert t["source"] == "db"
     assert t["key"] == tdb.make_key("potrf", 32, "float32", (1, 1))

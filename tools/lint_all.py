@@ -1,13 +1,14 @@
 #!/usr/bin/env python3
 """Aggregate static-analysis runner: every repo gate with one exit code.
 
-Fourteen passes, in increasing cost order:
+Fifteen passes, in increasing cost order:
 
 1. ``tools/lint_excepts.py`` — no swallowed failures in
    ``dplasma_tpu/``;
 2. ``dplasma_tpu.analysis.jaxlint`` — the JAX/TPU trace-safety rules
    (tracer concretization, mutable defaults, numpy-in-jit, float64
-   literals, kernel nondeterminism, hard-coded mesh axis names);
+   literals, kernel nondeterminism, hard-coded mesh axis names,
+   missed donations, full-operand materialization in lowmem paths);
 3. a ``tools/perfdiff.py`` smoke pass — a report self-compare must
    exit 0 and a synthetically regressed report must exit nonzero with
    the offending metric named (the CI regression gate must itself be
@@ -32,36 +33,44 @@ Fourteen passes, in increasing cost order:
    2x2 grids, plus the IR solvers' factor+solve+refine DAGs
    (posv_ir/gesv_ir, ops.refine.dag), must verify clean, with the
    comm-model reconciliation exact for the owner-computes classes;
-7. a ``dplasma_tpu.analysis.spmdcheck`` smoke pass — the cyclic
+7. a ``dplasma_tpu.analysis.memcheck`` smoke pass — the tile-liveness
+   analyzer over the same four ops' DAGs (3x3 tiles, 1x1 and 2x2
+   grids, wavefront and pipelined orderings) must verify clean with a
+   positive resident peak and a named peak-driving task, and a
+   shrunken ``memcheck.hbm_budget`` mutation must produce an
+   ``hbm-budget`` diagnostic NAMING the peak task and tile plus a
+   feasible spill/prefetch stream plan (the budget gate must itself
+   be gated);
+8. a ``dplasma_tpu.analysis.spmdcheck`` smoke pass — the cyclic
    shard_map kernels (potrf/getrf/geqrf/gemm) traced on tiny shapes
    over 1x1/2x2/1x4 grids must verify clean with the collective
    counts EXACTLY reconciling the analytic comm model, and the
    canonical ring schedule must drain deadlock-free in the abstract
    simulator;
-8. a ``dplasma_tpu.serving`` smoke pass — tiny batched posv/gesv
+9. a ``dplasma_tpu.serving`` smoke pass — tiny batched posv/gesv
    round-trips within the backward-error gate, cache-key determinism,
    and padded-vs-exact solution equivalence on CPU;
-9. a ``dplasma_tpu.analysis.hlocheck`` smoke pass — the COMPILED
+10. a ``dplasma_tpu.analysis.hlocheck`` smoke pass — the COMPILED
    post-GSPMD HLO of the cyclic potrf/getrf/geqrf/gemm kernels on
    the 2x2 CPU mesh must audit clean with the per-kind collective
    counts EXACTLY matching the jaxpr-level schedule (a
    GSPMD-inserted hidden collective fails here before it ever ships
    to hardware), and one serving batched executable must audit clean
    (donation/precision/anti-patterns);
-10. a ``ring-smoke`` pass — every shipped explicit-ICI-ring kernel's
+11. a ``ring-smoke`` pass — every shipped explicit-ICI-ring kernel's
    abstract RingOp schedule (kernels.pallas_ring: panel-broadcast
    ring from every owner column, chunked and unchunked, plus the LU
    winner-row exchange) must drain in ``simulate_ring`` with zero
    deadlock/unpaired-semaphore findings, and ``ring.enable=off`` /
    ``auto`` must be bit-identical to the masked-psum cyclic kernels
    on the 2x2 CPU mesh (CPU always falls back);
-11. a ``dplasma_tpu.tuning`` smoke pass — a tiny 2-config dpotrf
+12. a ``dplasma_tpu.tuning`` smoke pass — a tiny 2-config dpotrf
    sweep on the 1x1 grid must persist a winner to a fresh tuning DB,
    the DB must read back clean (``TuningDB.check``), and a
    subsequent driver ``--autotune`` run must provably consult it
    (v11 ``"tuning"`` report section: source ``db``, the winner's
    tile size applied, scoped overrides restored at close);
-12. a ``telemetry-smoke`` pass — a tiny serving burst with tracing on:
+13. a ``telemetry-smoke`` pass — a tiny serving burst with tracing on:
    the span ledger must balance (every open has a close) and carry
    the per-request span taxonomy, the streaming exporter's file must
    parse as Prometheus text (``telemetry.parse_prometheus_text``)
@@ -69,7 +78,7 @@ Fourteen passes, in increasing cost order:
    must round-trip through the current-schema run-report
    (``report.load_report``) with its submit/dispatch event sequence
    intact;
-13. a ``devprof-smoke`` pass — the measured-attribution engine
+14. a ``devprof-smoke`` pass — the measured-attribution engine
    (``observability.devprof``) on the 2x2 grid: every spmdcheck-
    priced collective class of potrf/getrf/geqrf must appear in the
    ingested timeline with the reconciliation relation ``==`` and the
@@ -78,7 +87,7 @@ Fourteen passes, in increasing cost order:
    timeline mutation dropping one priced class must produce a
    ``missing-collective`` diagnostic NAMING that class, and the
    entry must round-trip through the current-schema run-report;
-14. a ``soak-smoke`` pass — the overload-hardening gate: a tiny
+15. a ``soak-smoke`` pass — the overload-hardening gate: a tiny
    serving burst whose conservation audit must balance (submitted
    == admitted + shed, resolved == admitted, zero lost futures), a
    forced queue-cap shed must raise ``AdmissionError`` AND land a
@@ -287,6 +296,63 @@ def run_dagcheck_smoke() -> int:
                 sys.stderr.write(res.format(
                     f"{op} {dist.P}x{dist.Q}") + "\n")
                 bad += len(res.diagnostics)
+    return bad
+
+
+def run_memcheck_smoke() -> int:
+    """Tile-liveness/residency sweep over the four ops' DAGs (the
+    lint-speed subset of the tests/test_memcheck.py fixtures), plus
+    the budget-gate mutation: a shrunken budget must name the peak
+    task and tile and attach a feasible stream plan."""
+    from dplasma_tpu.analysis import memcheck as mc
+    from dplasma_tpu.descriptors import Dist, TileMatrix
+    from dplasma_tpu.ops import gemm, lu, potrf, qr
+    from dplasma_tpu.utils.profiling import DagRecorder
+
+    nb, nt = 4, 3
+    N = nt * nb
+    bad = 0
+    for dist in (Dist(), Dist(P=2, Q=2)):
+        A = TileMatrix.zeros(N, N, nb, nb, dist=dist)
+        C = TileMatrix.zeros(N, N, nb, nb, dist=dist)
+        cases = [
+            ("potrf", lambda r: potrf.dag(A, "L", r, lookahead=0), 0),
+            ("getrf", lambda r: lu.dag(A, r, lookahead=0), 0),
+            ("geqrf", lambda r: qr.dag(A, r, lookahead=0,
+                                       agg_depth=1), 0),
+            ("gemm", lambda r: gemm.dag(C, A, A, r), 0),
+            # pipelined orderings: the lookahead window reshapes the
+            # live set, the analyzer must still close the intervals
+            ("potrf_pipe", lambda r: potrf.dag(A, "L", r,
+                                               lookahead=1), 1),
+            ("getrf_pipe", lambda r: lu.dag(A, r, lookahead=1), 1),
+        ]
+        for label, build, la in cases:
+            rec = DagRecorder(enabled=True)
+            build(rec)
+            res = mc.check_schedule(rec, mb=nb, nb=nb, itemsize=4,
+                                    dist=dist, lookahead=la,
+                                    kernel=label)
+            if not res.ok or res.resident_peak_bytes <= 0 or \
+                    not res.peak_task:
+                sys.stderr.write(res.format(
+                    f"{label} {dist.P}x{dist.Q}") + "\n")
+                bad += 1
+    # budget-violation mutation: the gate must fire with the peak
+    # task/tile named and a stream plan attached
+    A = TileMatrix.zeros(N, N, nb, nb, dist=Dist())
+    rec = DagRecorder(enabled=True)
+    potrf.dag(A, "L", rec, lookahead=0)
+    res = mc.check_schedule(rec, mb=nb, nb=nb, itemsize=4,
+                            kernel="potrf", budget=nb * nb * 4)
+    hits = [d for d in res.diagnostics if d.kind == "hbm-budget"]
+    if res.ok or not hits or not hits[0].task or not hits[0].tile \
+            or not isinstance(res.stream, dict) \
+            or "feasible" not in res.stream:
+        sys.stderr.write("# memcheck-smoke: budget mutation did not "
+                         "produce a named hbm-budget diagnostic with "
+                         "a stream plan\n")
+        bad += 1
     return bad
 
 
@@ -1041,6 +1107,7 @@ def main(argv=None) -> int:
                      ("threadcheck", run_threadcheck),
                      ("palcheck", run_palcheck),
                      ("dagcheck-smoke", run_dagcheck_smoke),
+                     ("memcheck-smoke", run_memcheck_smoke),
                      ("spmdcheck-smoke", run_spmdcheck_smoke),
                      ("serving-smoke", run_serving_smoke),
                      ("hlocheck-smoke", run_hlocheck_smoke),

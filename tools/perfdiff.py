@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v15), the bench
+(``--report`` from any driver, any schema vintage v1-v16), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -26,6 +26,12 @@ Comparable metrics extracted from each document:
   run-report's ``hlocheck`` section (schema v10) — HBM regressions
   gate like time regressions (``--metric-threshold
   hbm_peak_bytes=FRAC`` for a custom bound);
+* static liveness-model peak memory (``<label>.memcheck.peak_bytes``,
+  lower is better) from a run-report's ``memcheck`` section (schema
+  v16, ``--memcheck`` on any driver) — the structural resident peak
+  the tile-liveness analyzer predicts before any compile, so a
+  schedule change that holds more tiles live gates even on hosts
+  that never compile the kernel;
 * the serving layer's tracing cost
   (``serving.trace_overhead_frac``, lower is better) from a
   run-report's ``serving`` section (schema v13, servebench's
@@ -245,6 +251,18 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
         v = e.get("hbm_peak_bytes")
         if lbl and isinstance(v, (int, float)) and v > 0:
             out[f"{lbl}.hlocheck.hbm_peak_bytes"] = {
+                "value": float(v), "better": "lower"}
+    for e in doc.get("memcheck") or []:
+        # static liveness-model resident peak (schema v16): lower is
+        # better — a grown structural peak means the schedule holds
+        # more tiles live, a residency regression the static verifier
+        # sees before any compile
+        if not isinstance(e, dict):
+            continue
+        lbl = e.get("op") or e.get("kernel")
+        v = e.get("peak_bytes")
+        if lbl and isinstance(v, (int, float)) and v > 0:
+            out[f"{lbl}.memcheck.peak_bytes"] = {
                 "value": float(v), "better": "lower"}
     for e in doc.get("devprof") or []:
         # measured-ICI attribution (schema v14): the WORST per-class
