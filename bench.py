@@ -153,6 +153,22 @@ def bench_gemm(N, dtype=jnp.float32, lo=1, hi=6):
     return 2.0 * N ** 3 / 1e9 / t
 
 
+def bench_i8gemm(N, lo=1, hi=4):
+    """Block-scaled int8 GEMM microbench (kernels.quant.qgemm):
+    quantize + int32-accumulated tile products + block-scale
+    dequantize, priced in GOP/s (2N^3 MACs) against the probed
+    ``int8_gops`` MXU peak. The quantize/dequantize streams ride
+    INSIDE the measured time — the ladder prices the usable
+    block-scaled rate, not the raw systolic peak."""
+    from dplasma_tpu.kernels import quant
+    rng = np.random.default_rng(3872)
+    a = jnp.asarray(rng.standard_normal((N, N)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((N, N)), jnp.float32)
+    t = _per_run_seconds(
+        _op_loop(a, lambda x, bb: quant.qgemm(x, bb), b), lo, hi)
+    return 2.0 * N ** 3 / 1e9 / t
+
+
 def bench_geqrf(N, nb, dtype=jnp.float32, lo=1, hi=4):
     A0 = generators.plrnt(N, N, nb, nb, seed=3872, dtype=dtype)
 
@@ -268,7 +284,9 @@ def bench_ir_solver(kind, N, nb, nrhs=4, precision="f32", lo=1, hi=3):
     return fl / 1e9 / t, rec
 
 
-def bench_ir_factor_rates(N, nb, precisions=("bf16", "f32", "f32x2")):
+def bench_ir_factor_rates(N, nb,
+                          precisions=("int8", "bf16", "f32",
+                                      "f32x2")):
     """Per-precision working-factorization rates (the bench doc's
     ``refine.factor_gflops`` table): one attributed posv_ir factor per
     precision (max_iters=1, no escalation — the factor span is what's
@@ -417,8 +435,14 @@ def main(argv=None) -> int:
                 attempts += 1
                 try:
                     g = fn(**fixed, **kw)
-                    entry = {"metric": f"{name}_gflops_n{kw['N']}",
-                             "value": round(g, 2), "unit": "GFlop/s",
+                    # a name already carrying its unit suffix (the
+                    # i8gemm_gops GOP/s ladder) keeps it verbatim
+                    stem = name if name.endswith("_gops") \
+                        else f"{name}_gflops"
+                    entry = {"metric": f"{stem}_n{kw['N']}",
+                             "value": round(g, 2),
+                             "unit": ("GOP/s" if name.endswith("_gops")
+                                      else "GFlop/s"),
                              "vs_baseline": round((g / bound) / 0.70, 4)}
                     if "nb" in kw:
                         # the per-entry tile size completes the knob
@@ -486,6 +510,8 @@ def main(argv=None) -> int:
         ir_gesv_cfgs = [dict(N=4096, nb=512, cost_s=400),
                         dict(N=2048, nb=512)]
         ir_rates_cfg = dict(N=2048, nb=512)
+        ir_i8_cfgs = [dict(N=2048, nb=512)]
+        i8gemm_cfgs = [dict(N=4096, cost_s=120), dict(N=2048)]
         dd_cost = 420.0
     else:  # CI / smoke path: tiny shapes, same code
         peak32 = measure_peak(n=1024, iters=20, dtype="float32",
@@ -505,6 +531,8 @@ def main(argv=None) -> int:
         ir_posv_cfgs = [dict(N=512, nb=128)]
         ir_gesv_cfgs = [dict(N=512, nb=128)]
         ir_rates_cfg = dict(N=256, nb=64)
+        ir_i8_cfgs = [dict(N=512, nb=128)]
+        i8gemm_cfgs = [dict(N=1024)]
         dd_cost = 60.0
 
     # Peak reads are sanity-gated against known hardware ratios
@@ -549,10 +577,12 @@ def main(argv=None) -> int:
     # doc's "refine" section the per-precision factor rates.
     refine_sec = report.extra.setdefault("refine", {})
 
-    def run_ir_entry(name, kind, cfg_list, cost):
+    def run_ir_entry(name, kind, cfg_list, cost, precision=None):
         recs = {}
 
         def fn(N, nb, **kw):
+            if precision is not None:
+                kw.setdefault("precision", precision)
             g, rec = bench_ir_solver(kind, N, nb, **kw)
             recs[N] = rec
             return g
@@ -579,6 +609,17 @@ def main(argv=None) -> int:
     run_ir_entry("dposv_ir_f64equiv", "posv", ir_posv_cfgs,
                  dd_cost * 0.8)
     run_ir_entry("dgesv_ir_f64equiv", "gesv", ir_gesv_cfgs, dd_cost)
+    # int8 rung: the SAME f64-equivalent solves with block-scaled
+    # quantized trailing updates (kernels.quant) — separate *_i8
+    # ladder names so a rung flip gates same-vs-same, and the
+    # factor-rate entry prices the quantized factorization
+    run_ir_entry("dposv_ir_i8", "posv", ir_i8_cfgs, dd_cost * 0.5,
+                 precision="int8")
+    run_ir_entry("dgesv_ir_i8", "gesv", ir_i8_cfgs, dd_cost * 0.5,
+                 precision="int8")
+    # block-scaled int8 GEMM microbench vs the probed integer peak
+    run_entry("i8gemm_gops", bench_i8gemm, i8gemm_cfgs, i8_peak,
+              cost_s=dd_cost / 3)
     if remaining() > (120.0 if on_tpu else 30.0):
         try:
             refine_sec["factor_gflops"] = dict(

@@ -90,6 +90,21 @@ PRECISION_SITES = [
     "ops/refine.py",
 ]
 
+#: declared float->integer demotions: exact ``(site_suffix, src,
+#: dst)`` triples the precision audit accepts — narrower than
+#: PRECISION_SITES on purpose (a site may quantize f32 to s8 and
+#: nothing else; an f64->s8 convert there is still a bug). The one
+#: registered demotion is the block-scaled int8 quantizer's
+#: round-to-int8 store (kernels.quant, the ir.precision=int8 rung).
+DECLARED_DEMOTIONS = [
+    ("kernels/quant.py", "f32", "s8"),
+]
+
+#: integer dtype -> carried width in bits: a float CONVERTING into one
+#: of these is a precision demotion the audit must see (f32 -> s8 is
+#: the quantizer's defining move — and an accident anywhere else)
+_INT_BITS = {"s8": 8, "u8": 8, "s4": 4, "u4": 4, "s16": 16, "u16": 16}
+
 #: custom-call targets that are host round-trips in disguise
 _CALLBACK_MARKERS = _SHARED_CALLBACK_MARKERS
 
@@ -479,7 +494,11 @@ def check_precision(mod: HloModule, res: HloResult,
     """Every ``convert`` that narrows a float below the route's
     working precision must come from a registered dd/limb site
     (matched on the instruction's ``source_file`` metadata) — the
-    compiled twin of jaxlint J005."""
+    compiled twin of jaxlint J005. Float->INTEGER narrowing (the
+    quantizer's f32 -> s8 store) is held to the stricter
+    :data:`DECLARED_DEMOTIONS` allowlist: the exact (site, src, dst)
+    triple must be declared, so the intentional int8 trailing updates
+    pass while an accidental quantize anywhere else still fails."""
     sites = PRECISION_SITES if sites is None else sites
     for op in mod.ops:
         if op.opcode != "convert":
@@ -488,12 +507,32 @@ def check_precision(mod: HloModule, res: HloResult,
         if ct is None:
             continue
         src, dst = ct
-        sb, db = _FLOAT_BITS.get(src), _FLOAT_BITS.get(dst)
+        sb = _FLOAT_BITS.get(src)
+        db = _FLOAT_BITS.get(dst)
+        source = op.source.replace("\\", "/")
+        if sb is not None and db is None and dst in _INT_BITS:
+            # float -> integer narrowing: declared-demotion triples
+            # only (PRECISION_SITES does not cover these)
+            if _INT_BITS[dst] >= working_bits:
+                continue
+            if any(source.endswith(s) and src == ds and dst == dd
+                   for s, ds, dd in DECLARED_DEMOTIONS):
+                continue
+            where = (f"{source}:{op.source_line}" if source
+                     else "unknown site")
+            res.add("precision-demotion",
+                    f"%{op.name} quantizes {src} -> {dst} below the "
+                    f"route's working precision ({working_bits}-bit) "
+                    f"at {where} — not a declared demotion "
+                    f"(DECLARED_DEMOTIONS)",
+                    op=op.name,
+                    detail={"src": src, "dst": dst, "source": source,
+                            "source_line": op.source_line})
+            continue
         if sb is None or db is None:
             continue               # integer/pred casts are not demotions
         if db >= sb or db >= working_bits:
             continue               # widening, or still at/above working
-        source = op.source.replace("\\", "/")
         if any(source.endswith(s) for s in sites):
             continue
         where = f"{source}:{op.source_line}" if source else "unknown site"

@@ -526,6 +526,7 @@ class Driver:
         # a pure read that may rewrite the UN-pinned tile shape
         # (precedence: CLI flag > DPLASMA_MCA_* env > DB > default)
         self.tuning = None
+        self._autopilot = None   # last precision-autopilot decision
         tune_applied: dict = {}
         if getattr(ip, "autotune", False):
             self.tuning, tune_applied = self._autotune_consult(wants_la)
@@ -679,6 +680,61 @@ class Driver:
                 ip.MB = ip.NB = nb
                 summary["nb"] = nb
         return summary, applied
+
+    def autopilot(self, op: str, a, spd: bool = False):
+        """``--autotune`` precision pre-flight: sketch the concrete
+        operand's condition class and resolve the stored
+        ``ir.precision`` rung for this ``(op, n, dtype, cond_class)``
+        key (:mod:`dplasma_tpu.tuning.autopilot`). A resolved rung
+        pins a scoped MCA frame (popped at close(), innermost —
+        the concrete-operand decision outranks the shape-keyed
+        tuner's knob vector); the decision lands in the v17
+        ``"autopilot"`` report section, ``autopilot_consults_total``,
+        and the flight recorder. Returns the decision summary, or
+        None (no ``--autotune`` / autopilot off / no DB). A later
+        escalation reported through :meth:`report_refine` writes the
+        negative entry back so the DB bucket converges."""
+        import numpy as np
+        from dplasma_tpu.utils import config as _cfg
+        ip = self.ip
+        if not getattr(ip, "autotune", False):
+            return None
+        from dplasma_tpu.tuning import autopilot as _ap
+        try:
+            host = np.asarray(a.to_dense()
+                              if hasattr(a, "to_dense") else a)
+            summary = _ap.consult(op, int(host.shape[-1]),
+                                  PRECISIONS[ip.prec], host, spd=spd,
+                                  grid=(ip.P, ip.Q))
+        except Exception as exc:
+            sys.stderr.write(f"#! autopilot consult failed: {exc}\n")
+            return None
+        if summary is None:
+            return None
+        if summary.get("precision"):
+            self._mca_frames.append(_cfg.push_overrides(
+                {"ir.precision": summary["precision"]},
+                label="autopilot"))
+        self._autopilot = summary
+        self.report.add_autopilot(summary)
+        reg = self.report.metrics
+        reg.counter("autopilot_consults_total", op=op,
+                    source=summary["source"],
+                    cond_class=summary["cond_class"]).inc()
+        if self.telemetry is not None:
+            self.telemetry.flight.record(
+                "autopilot", op=op,
+                precision=summary.get("precision"),
+                cond_class=summary["cond_class"],
+                source=summary["source"])
+        if ip.rank == 0 and ip.loud >= 2:
+            print("#+ autopilot[%s]: cond~%.3e class=%s precision=%s "
+                  "(%s)" % (op, summary["cond_estimate"],
+                            summary["cond_class"],
+                            summary.get("precision") or "default",
+                            summary["source"]))
+            sys.stdout.flush()
+        return summary
 
     def close(self):
         from dplasma_tpu.utils import config as _cfg
@@ -1544,6 +1600,30 @@ class Driver:
         hist = summary.get("backward_errors") or []
         if hist:
             reg.gauge("refine_backward_error", **lbl).set(hist[-1])
+        if summary.get("quant_guard_max") is not None:
+            reg.gauge("quant_guard_max", **lbl).set(
+                summary["quant_guard_max"])
+        # the autopilot's negative write-back: a consulted rung that
+        # escalated stores the next-stronger rung under its cond key
+        ap = getattr(self, "_autopilot", None)
+        if ap is not None and summary.get("escalated")                 and ap.get("precision"):
+            from dplasma_tpu.tuning import autopilot as _ap
+            try:
+                _ap.record_escalation(
+                    ap["op"], ap["n"], ap["dtype"], ap["cond_class"],
+                    ap["precision"],
+                    cond_estimate=ap.get("cond_estimate"),
+                    grid=(self.ip.P, self.ip.Q))
+                reg.counter("autopilot_escalations_total",
+                            op=ap["op"]).inc()
+                if self.telemetry is not None:
+                    self.telemetry.flight.record(
+                        "autopilot_writeback", op=ap["op"],
+                        failed=ap["precision"],
+                        cond_class=ap["cond_class"])
+            except Exception as exc:
+                sys.stderr.write(
+                    f"#! autopilot write-back failed: {exc}\n")
         ip = self.ip
         if ip.rank == 0 and ip.loud >= 2:
             tail = f" bwd={hist[-1]:.3e}" if hist else ""

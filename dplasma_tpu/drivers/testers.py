@@ -634,6 +634,7 @@ def posv_ir(drv: Driver):
     ip = drv.ip
     A0 = _gen(drv, ip.N, ip.N, 0, kind="he")
     B = _gen(drv, ip.N, ip.K, 1)
+    drv.autopilot("posv_ir", A0, spd=True)
     fallbacks = [("posv_dd", lambda a, b: potrf_mod.posv(a, b, "L"))]
     out, _ = drv.progress(
         lambda a, b: refine.posv_ir(a, b, "L"),
@@ -658,6 +659,7 @@ def gesv_ir(drv: Driver):
     ip = drv.ip
     A0 = _gen(drv, ip.N, ip.N)
     B = _gen(drv, ip.N, ip.K, 1)
+    drv.autopilot("gesv_ir", A0)
 
     def _gesv_ptg(a, b):
         # the grid-correct full-precision route (ptgpanel dispatches
@@ -694,6 +696,7 @@ def gels_ir(drv: Driver):
                          "testing_?gels for the minimum-norm path")
     A0 = _gen(drv, ip.M, ip.N)
     B = _gen(drv, ip.M, ip.K, 1)
+    drv.autopilot("gels_ir", A0)
     fallbacks = [("gels_dd", qr.gels)]
     out, _ = drv.progress(
         refine.gels_ir, (_put(drv, A0), _put(drv, B)),

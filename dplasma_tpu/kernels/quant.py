@@ -1,0 +1,210 @@
+"""Block-scaled int8 GEMM: the quantized trailing-update substrate.
+
+The MXU's int8 path (~313 TOP/s on v5e vs ~31 TFLOP/s f32 — BENCH_r05
+``int8_gops`` probe) is a ~10x ceiling the factorization sweeps can tap
+wherever iterative refinement absorbs the rounding: the *trailing
+updates* (far/agg flushes and lookahead rank-nb products of
+``ops/_sweep``-driven potrf/lu/qr) are contractions whose error the
+f64-carry IR loop (ops.refine) corrects, while panels, triangular
+solves and diagonal factorizations stay f32 — they set the pivot/
+reflector structure the updates merely apply.
+
+Scheme: symmetric per-tile scale quantization of BOTH operands. Each
+``quant.tile``-square block gets one power-free scale ``amax/127``;
+``q = round(x/scale)`` in int8. The product runs per K-block as
+``lax.dot_general(..., preferred_element_type=int32)`` — exact integer
+accumulation within a block (127*127*tile << 2^31) — then dequantizes
+by the row-scale x col-scale outer product into an f32 accumulator
+across K blocks. Plain JAX (shape-static, jit-traceable, CPU-runnable);
+a fused Pallas twin is an on-hardware follow-on.
+
+Divergence guard: PR 2's ABFT input-side checksum probe doubles as a
+per-update guard — the ones-vector residual ``|A(Bw) - C_q w|`` of each
+quantized update is recorded into the ambient :func:`update_scope`;
+``ops.refine`` surfaces the max as ``quant_guard_max`` next to the
+backward error, and actual divergence rides IR's non-contraction
+escalation like every other rung.
+
+Routing is *call-site opt-in*: ops pass their update products through
+:func:`update_dot`, which falls through to ``kernels.blas.dot``
+bit-identically unless MCA ``quant.updates=int8`` is active (the
+``ir.precision=int8`` rung's :func:`update_scope`) AND the operands are
+real f32. No global dot hook — panel internals must never quantize.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from dplasma_tpu.utils import config as _cfg
+
+_cfg.mca_register(
+    "quant.tile", "128",
+    "block size of the per-tile scale grid for int8 quantized updates")
+_cfg.mca_register(
+    "quant.updates", "off",
+    "route factorization trailing updates through the block-scaled "
+    "int8 GEMM: off | int8 (set by the ir.precision=int8 rung)")
+_cfg.mca_register(
+    "quant.guard", "probe",
+    "per-update ABFT ones-probe divergence guard on quantized "
+    "updates: probe | off")
+
+
+def quant_params():
+    """Resolve (tile, updates, guard) from MCA."""
+    tile = max(_cfg.mca_get_int("quant.tile", 128), 8)
+    updates = (_cfg.mca_get("quant.updates") or "off").lower()
+    guard = (_cfg.mca_get("quant.guard") or "probe").lower()
+    return tile, updates, guard
+
+
+def _pad_to(x, rows, cols):
+    pr, pc = rows - x.shape[0], cols - x.shape[1]
+    if pr or pc:
+        x = jnp.pad(x, ((0, pr), (0, pc)))
+    return x
+
+
+def quantize(x, tile: Optional[int] = None):
+    """Symmetric per-tile scale quantization.
+
+    Returns ``(q, scales)``: ``q`` int8 of x's shape padded up to tile
+    multiples, ``scales`` f32 of shape (ceil(M/t), ceil(K/t)) with
+    ``scale = amax(block)/127`` (floored at a tiny epsilon so all-zero
+    pad blocks stay exactly zero after round-trip).
+    """
+    t = tile if tile is not None else quant_params()[0]
+    m, n = x.shape
+    mt, nt = -(-m // t), -(-n // t)
+    xp = _pad_to(jnp.asarray(x, jnp.float32), mt * t, nt * t)
+    blocks = xp.reshape(mt, t, nt, t)
+    amax = jnp.max(jnp.abs(blocks), axis=(1, 3))
+    scales = jnp.maximum(amax / 127.0, jnp.float32(1e-30))
+    q = jnp.round(blocks / scales[:, None, :, None])
+    q = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+    return q.reshape(mt * t, nt * t), scales
+
+
+def dequantize(q, scales, tile: Optional[int] = None, shape=None):
+    """Inverse of :func:`quantize` (up to rounding): int8 tiles times
+    their per-tile scales, cropped to ``shape`` when given."""
+    t = tile if tile is not None else quant_params()[0]
+    mt, nt = scales.shape
+    blocks = q.reshape(mt, t, nt, t).astype(jnp.float32)
+    x = (blocks * scales[:, None, :, None]).reshape(mt * t, nt * t)
+    if shape is not None:
+        x = x[:shape[0], :shape[1]]
+    return x
+
+
+def qgemm(a, b, tile: Optional[int] = None):
+    """Block-scaled int8 GEMM: ``a @ b`` with both operands quantized
+    per-tile, int32 MXU accumulation inside each K block, f32
+    dequantized accumulation across K blocks. Result f32, a.shape[0] x
+    b.shape[1]."""
+    t = tile if tile is not None else quant_params()[0]
+    m, kk = a.shape
+    k2, n = b.shape
+    assert kk == k2, (a.shape, b.shape)
+    if m == 0 or n == 0 or kk == 0:
+        return jnp.zeros((m, n), jnp.float32)
+    from dplasma_tpu.observability import phases
+    with phases.span("quantize") as _f:
+        qa, sa = quantize(a, t)
+        qb, sb = quantize(b, t)
+        _f(qa)
+        _f(qb)
+    kt = sa.shape[1]
+    mp, np_ = qa.shape[0], qb.shape[1]
+    acc = jnp.zeros((mp, np_), jnp.float32)
+    for j in range(kt):
+        # exact int32 contraction within one K block ...
+        p = lax.dot_general(
+            qa[:, j * t:(j + 1) * t], qb[j * t:(j + 1) * t, :],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+        # ... dequantized by the row-scale x col-scale outer product
+        with phases.span("dequantize") as _f:
+            rs = jnp.repeat(sa[:, j], t)
+            cs = jnp.repeat(sb[j, :], t)
+            acc = _f(acc + p.astype(jnp.float32)
+                     * rs[:, None] * cs[None, :])
+    return acc[:m, :n]
+
+
+# -- trailing-update routing -------------------------------------------
+
+#: ambient guard-residual collector: a list while an update_scope with
+#: guarding is active, else None (probes skipped entirely)
+_GUARD: Optional[List] = None
+
+
+def updates_active(*dtypes) -> bool:
+    """True when trailing updates should route through :func:`qgemm`:
+    MCA ``quant.updates=int8`` and every operand is real float32 (the
+    rung operates on f32 working matrices; f64/complex never route)."""
+    _, updates, _ = quant_params()
+    if updates != "int8":
+        return False
+    return all(jnp.dtype(d) == jnp.float32 for d in dtypes)
+
+
+def probe_residual(a, b, c):
+    """ABFT input-side ones-probe of one update product: relative
+    residual ``max|a (b w) - c w| / (|a| |b| n eps-floor)`` with w the
+    ones vector — the PR 2 checksum identity specialized to a rank
+    probe, so one narrow matvec pair audits the whole quantized GEMM."""
+    w = jnp.ones((b.shape[1], 1), jnp.float32)
+    ref = jnp.matmul(a, jnp.matmul(b, w),
+                     precision=lax.Precision.HIGHEST)
+    got = jnp.matmul(c, w, precision=lax.Precision.HIGHEST)
+    floor = (jnp.max(jnp.abs(a)) * jnp.max(jnp.abs(b))
+             * jnp.float32(max(b.shape[0], 1)) + jnp.float32(1e-30))
+    return jnp.max(jnp.abs(ref - got)) / floor
+
+
+def update_dot(a, b, *, ta=False, tb=False, conj_a=False, conj_b=False):
+    """Quant-aware trailing-update product: ``op(a) @ op(b)`` through
+    the block-scaled int8 GEMM when :func:`updates_active`, else
+    ``kernels.blas.dot`` verbatim (bit-identical fall-through). The
+    conj flags are identity on the routed (real f32) path but keep the
+    call sites symmetric with ``k.dot``."""
+    from dplasma_tpu.kernels import blas as k
+    if not updates_active(a.dtype, b.dtype):
+        return k.dot(a, b, ta=ta, tb=tb, conj_a=conj_a, conj_b=conj_b)
+    am = a.T if ta else a
+    bm = b.T if tb else b
+    out = qgemm(am, bm)
+    if _GUARD is not None and quant_params()[2] == "probe":
+        _GUARD.append(probe_residual(am, bm, out))
+    return out
+
+
+@contextlib.contextmanager
+def update_scope(guard: bool = True):
+    """Activate the int8 trailing-update route for the block (the
+    ``ir.precision=int8`` factor span): pushes MCA
+    ``quant.updates=int8`` and installs a fresh guard-residual
+    collector, yielded so the caller can fold ``max(residuals)`` into
+    its info dict. Restores both on exit (re-entrant)."""
+    global _GUARD
+    prev = _GUARD
+    collected: List = [] if guard else (prev if prev is not None else [])
+    _GUARD = collected if guard else prev
+    with _cfg.override_scope({"quant.updates": "int8"}, label="quant"):
+        try:
+            yield collected
+        finally:
+            _GUARD = prev
+
+
+def guard_max(residuals):
+    """Reduce collected probe residuals to one scalar (0 when none
+    were recorded — guard off or no routed updates). Traced-safe."""
+    if not residuals:
+        return jnp.float32(0.0)
+    return jnp.max(jnp.stack([jnp.asarray(r, jnp.float32)
+                              for r in residuals]))

@@ -215,9 +215,17 @@ the documented compiled-staging allowance, the budget gate vs MCA
 ``memcheck.hbm_budget`` with the peak-driving task/tile/live-set
 diagnostics, and the streaming-simulator plan summary when the
 budget forces spill/prefetch; perfdiff gates
-``memcheck.peak_bytes`` lower-better).
+``memcheck.peak_bytes`` lower-better);
+17 adds ``"autopilot"`` (the precision-autopilot decision records —
+dplasma_tpu.tuning.autopilot: one entry per consulted IR solve with
+the condest pre-flight estimate, the condition-class bucket, the
+selected ``ir.precision`` rung and its provenance
+(db/interpolated/default), the 5-part ``|cond=<class>`` tuning key,
+and the DB path; drivers under ``--autotune`` and the serving layer
+both emit them, and runtime escalations land back in the tuning DB
+as negative entries so the recorded verdicts converge).
 All additive — v1 readers of the other keys are unaffected; this
-reader accepts <= 16 (:func:`load_report` tolerates every v1-v16
+reader accepts <= 17 (:func:`load_report` tolerates every v1-v17
 vintage, filling the always-present keys).
 """
 from __future__ import annotations
@@ -230,7 +238,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 16
+REPORT_SCHEMA = 17
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -272,6 +280,7 @@ class RunReport:
         self.hlocheck: List[dict] = []  # --hlocheck audits (v10)
         self.memcheck: List[dict] = []  # --memcheck residency (v16)
         self.tuning: List[dict] = []    # --autotune consultations (v11)
+        self.autopilot: List[dict] = []  # precision-autopilot picks (v17)
         self.scaling: List[dict] = []   # per-chip-count curves (v12)
         self.telemetry: Optional[dict] = None  # live instruments (v13)
         self.devprof: List[dict] = []   # measured-timeline attribution (v14)
@@ -357,6 +366,12 @@ class RunReport:
         self.tuning.append(summary)
         return summary
 
+    def add_autopilot(self, summary: dict) -> dict:
+        """Record one precision-autopilot consultation (schema v17;
+        see dplasma_tpu.tuning.autopilot.consult)."""
+        self.autopilot.append(summary)
+        return summary
+
     def add_scaling(self, summary: dict) -> dict:
         """Record one op's per-chip-count scaling curve (schema v12;
         see tools/multichip.py)."""
@@ -427,6 +442,8 @@ class RunReport:
             doc["memcheck"] = self.memcheck
         if self.tuning:
             doc["tuning"] = self.tuning
+        if self.autopilot:
+            doc["autopilot"] = self.autopilot
         if self.scaling:
             doc["scaling"] = self.scaling
         if self.telemetry is not None:
@@ -469,7 +486,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v15) loads: the schema history is purely
+    Every older vintage (v1-v16) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

@@ -70,7 +70,8 @@ _AUX_RATE_KEYS = ("f32_highest_gflops", "bf16_gflops", "int8_gops",
 #: ~31/177/~21 TFLOP/s f32/bf16/f32x2-rung against the 8.7 TFLOP/s
 #: f64-equivalent bound — floored well below the hardware ratios so
 #: the expectation stays a lower bound.
-WP_MXU = {"bf16": ("bf16_gflops", 16.0),
+WP_MXU = {"int8": ("int8_gops", 24.0),
+          "bf16": ("bf16_gflops", 16.0),
           "f32": ("f32_highest_gflops", 3.0),
           "f32x2": ("f32x2_gflops", 2.0)}
 
@@ -203,7 +204,18 @@ def refine_phase_model(op_class: str, M: int, N: int, nrhs: int,
     ``solve``/``residual``/``correct`` are per-dispatch demands
     (``per_count``): :func:`attribute_phases` scales them by the
     measured span count, so the expectation tracks the iterations the
-    engine actually ran rather than a guessed budget."""
+    engine actually ran rather than a guessed budget.
+
+    The ``int8`` rung prices the factor at the probed ``int8_gops``
+    MXU peak (counting the same f32-equivalent flops — the int8 rate
+    strictly dominates, so the expectation stays a lower bound) and
+    adds the quantize/dequantize byte streams the block-scaled
+    trailing updates emit (:mod:`dplasma_tpu.kernels.quant`): the
+    quantize span reads the f32 operands and writes int8 tiles +
+    scales (>= one full-matrix pass, 4+1 bytes/elt), the dequantize
+    span reads the int32 partials and writes the f32 accumulation
+    (>= one full-matrix pass, 4+4 bytes/elt) — aggregate HBM
+    lower bounds, judged against the spans' summed self time."""
     wp = wp_mxu_gflops(peaks, precision)
     n3 = float(N) ** 3
     if op_class == "posv_ir":
@@ -219,7 +231,7 @@ def refine_phase_model(op_class: str, M: int, N: int, nrhs: int,
     resid_fl = (2.0 if op_class != "gels_ir" else 4.0) \
         * float(M) * N * nrhs
     wp_item = 4.0   # the working factor/operands live in f32 storage
-    return {
+    out = {
         # inclusive: the factor span ENCLOSES the inner factorization
         # sweep (whose panel/lookahead/... child spans hold the work),
         # so its n^3 demand must be judged against the inclusive wall
@@ -239,6 +251,12 @@ def refine_phase_model(op_class: str, M: int, N: int, nrhs: int,
                                    + 2.0 * M * nrhs) * itemsize,
                      "per_count": True},
     }
+    if precision == "int8":
+        # block-scaled quantization streams of the int8 trailing
+        # updates (kernels.quant): aggregate >= one full-matrix pass
+        out["quantize"] = {"hbm_bytes": float(M) * N * (4.0 + 1.0)}
+        out["dequantize"] = {"hbm_bytes": float(M) * N * (4.0 + 4.0)}
+    return out
 
 
 def ring_phase_demand(op_class: str, M: int, N: int, nb: int,

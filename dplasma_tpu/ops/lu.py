@@ -40,6 +40,7 @@ from dplasma_tpu import utils
 from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.kernels import blas as k
 from dplasma_tpu.kernels import householder as hh
+from dplasma_tpu.kernels import quant as _quant
 from dplasma_tpu.ops import blas3
 from dplasma_tpu.ops._sweep import assemble_sweep
 from dplasma_tpu.parallel import mesh as pmesh
@@ -94,13 +95,16 @@ def laswp(A: TileMatrix, perm, inverse: bool = False) -> TileMatrix:
 def _lu_apply_block(pan, blk, bw: int, perm=None):
     """Apply one factored LU panel to a column block: optional pivot
     gather, U solve of the top bw rows, rank-bw Schur update below.
-    The shared narrow/wide update of the pipelined sweep."""
+    The shared narrow/wide update of the pipelined sweep; the Schur
+    product routes through the block-scaled int8 GEMM under the
+    ir.precision=int8 rung (kernels.quant.update_scope) — the U solve
+    stays f32, it writes factor output."""
     if perm is not None:
         blk = blk[perm]
     u = k.trsm(pan[:bw], blk[:bw], side="L", lower=True, unit=True)
     below = blk[bw:]
     if below.shape[0]:
-        below = below - k.dot(pan[bw:], u)
+        below = below - _quant.update_dot(pan[bw:], u)
     return u, below
 
 

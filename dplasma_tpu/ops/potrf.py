@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.kernels import blas as k
+from dplasma_tpu.kernels import quant as _quant
 from dplasma_tpu.ops import blas3
 from dplasma_tpu.ops.aux import _tri_mask
 from dplasma_tpu.parallel import mesh as pmesh
@@ -99,15 +100,16 @@ def potrf(A: TileMatrix, uplo: str = "L", *, diag_kernel=None,
                     B = jnp.concatenate(
                         [cols[j][s - j * mb:s - j * mb + mb]
                          for j in range(fresh_from)], axis=1)
-                    col = _f(col - k.dot(W, B, tb=True, conj_b=True))
+                    col = _f(col - _quant.update_dot(
+                        W, B, tb=True, conj_b=True))
             if fresh_from < kk:
                 with phases.span("lookahead") as _f:
                     for j in range(fresh_from, kk):
                         Lj = cols[j]
                         off = s - j * mb
-                        col = col - k.dot(Lj[off:, :],
-                                          Lj[off:off + mb, :],
-                                          tb=True, conj_b=True)
+                        col = col - _quant.update_dot(
+                            Lj[off:, :], Lj[off:off + mb, :],
+                            tb=True, conj_b=True)
                     _f(col)
             with phases.span("panel") as _f:
                 lkk = dk(col[:mb], lower=True)
@@ -127,15 +129,16 @@ def potrf(A: TileMatrix, uplo: str = "L", *, diag_kernel=None,
                     B = jnp.concatenate(
                         [cols[j][:, s - j * mb:s - j * mb + mb]
                          for j in range(fresh_from)], axis=0)
-                    row = _f(row - k.dot(B, W, ta=True, conj_a=True))
+                    row = _f(row - _quant.update_dot(
+                        B, W, ta=True, conj_a=True))
             if fresh_from < kk:
                 with phases.span("lookahead") as _f:
                     for j in range(fresh_from, kk):
                         Uj = cols[j]
                         off = s - j * mb
-                        row = row - k.dot(Uj[:, off:off + mb],
-                                          Uj[:, off:],
-                                          ta=True, conj_a=True)
+                        row = row - _quant.update_dot(
+                            Uj[:, off:off + mb], Uj[:, off:],
+                            ta=True, conj_a=True)
                     _f(row)
             with phases.span("panel") as _f:
                 ukk = dk(row[:, :mb], lower=False)

@@ -30,9 +30,23 @@ from dplasma_tpu import utils
 from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.kernels import blas as k
 from dplasma_tpu.kernels import householder as hh
+from dplasma_tpu.kernels import quant as _quant
 from dplasma_tpu.ops import blas3
 from dplasma_tpu.ops._sweep import assemble_sweep
 from dplasma_tpu.parallel import mesh as pmesh
+
+
+def _quant_apply_q(v, T, c):
+    """Compact-WY trailing apply (Q^H C) with the heavy wide outer
+    product ``V @ (T^H (V^H C))`` routed through the block-scaled int8
+    GEMM under the ir.precision=int8 rung; the two narrow inner
+    products stay f32 — they are rank-nb and set the small coefficient
+    matrix the wide product merely applies. Falls through to
+    hh.apply_q verbatim when the quant route is inactive."""
+    if not _quant.updates_active(v.dtype, c.dtype):
+        return hh.apply_q(v, T, c, trans="C")
+    w = k.dot(T.conj().T, k.dot(v, c, ta=True, conj_a=True))
+    return c - _quant.update_dot(v, w)
 
 
 # -- shape-cached dd QR sweep callbacks (eager) ------------------------
@@ -221,14 +235,14 @@ def geqrf(A: TileMatrix, *, panel_kernel=None, lookahead=None,
     def apply_block(st, blk):
         if eager:
             return _jit_qr_apply(st[0], st[1], blk)
-        out = hh.apply_q(st[0], st[1], blk, trans="C")
+        out = _quant_apply_q(st[0], st[1], blk)
         return out[:nb], out[nb:]
 
     def agg_apply(sts, far):
         if eager:
             new = _jit_qr_agg_apply(far, *[x for vt in sts for x in vt])
         else:
-            new = hh.apply_q(*hh.wy_stack(sts), far, trans="C")
+            new = _quant_apply_q(*hh.wy_stack(sts), far)
         d = len(sts)
         return ([new[i * nb:(i + 1) * nb] for i in range(d)],
                 new[d * nb:])

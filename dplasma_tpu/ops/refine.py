@@ -21,6 +21,12 @@ runs at the working-precision rate.
 
 Working precisions (MCA ``ir.precision``, default ``f32``):
 
+* ``int8`` — the factor's f32 working matrix runs its trailing
+  updates (the sweep's far/agg flushes and lookahead products) through
+  the block-scaled int8 GEMM (:mod:`dplasma_tpu.kernels.quant`) while
+  panels/trsm/diagonal kernels stay f32; per-update ABFT ones-probes
+  guard divergence (surfaced as ``quant_guard_max``), and actual
+  divergence escalates on non-contraction like every other rung;
 * ``bf16`` — operands and factors are *rounded through bf16 storage*
   (compute accumulates in f32, exactly the MXU's bf16-input contract);
   error contracts ~kappa*u_bf16 per step, so more iterations;
@@ -73,20 +79,23 @@ from jax import lax
 from dplasma_tpu import utils
 from dplasma_tpu.descriptors import TileMatrix
 from dplasma_tpu.kernels import dd as _dd
+from dplasma_tpu.kernels import quant as _quant
 from dplasma_tpu.observability import phases
 from dplasma_tpu.ops import blas3, norms
 from dplasma_tpu.utils import config as _cfg
 
 #: supported working precisions, cheapest-to-strongest
-PRECISIONS = ("bf16", "f32", "f32x2")
+PRECISIONS = ("int8", "bf16", "f32", "f32x2")
 
 _cfg.mca_register(
     "ir.precision", "f32",
     "Working precision of the mixed-precision IR solvers "
-    "(posv_ir/gesv_ir/gels_ir): bf16 (operands/factors rounded "
-    "through bf16 storage — the MXU's native input width), f32, or "
-    "f32x2 (double-single: the f32 factor takes one extra refinement "
-    "step on the kernels.dd bits=32 limb ladder rung).")
+    "(posv_ir/gesv_ir/gels_ir): int8 (f32 factor whose trailing "
+    "updates ride the block-scaled int8 GEMM, kernels.quant), bf16 "
+    "(operands/factors rounded through bf16 storage — the MXU's "
+    "native input width), f32, or f32x2 (double-single: the f32 "
+    "factor takes one extra refinement step on the kernels.dd "
+    "bits=32 limb ladder rung).")
 _cfg.mca_register(
     "ir.max_iters", "10",
     "Refinement-iteration budget of the IR solvers; a solve that has "
@@ -126,8 +135,10 @@ def _round_wp(x, precision: str):
     """Round an array through the working precision's STORAGE width.
 
     bf16 rounds through bfloat16 (then holds f32 for the compute
-    kernels — the MXU accumulates bf16 inputs in f32); f32/f32x2 cast
-    to f32 (the f32x2 extra accuracy comes from the factor-refinement
+    kernels — the MXU accumulates bf16 inputs in f32); int8/f32/f32x2
+    cast to f32 (int8's quantization is per-*update*, not storage —
+    kernels.quant quantizes each trailing product's operands on the
+    fly; the f32x2 extra accuracy comes from the factor-refinement
     step, not the storage)."""
     f32 = jnp.float32
     if precision == "bf16":
@@ -338,9 +349,17 @@ def posv_ir(A: TileMatrix, B: TileMatrix, uplo: str = "L", *,
     tiny = float(jnp.finfo(f64t).tiny)
     eager = utils.is_concrete(A.data)
 
+    guards = []
     with phases.span("factor") as _f:
         Aw = _tile(_round_wp(af, prec), A)
-        Lw = potrf_mod.potrf(Aw, "L")
+        if prec == "int8":
+            # int8 rung: trailing updates of the sweep ride the
+            # block-scaled int8 GEMM; panels/trsm stay f32. The scope
+            # yields the ABFT ones-probe residuals per routed update.
+            with _quant.update_scope() as guards:
+                Lw = potrf_mod.potrf(Aw, "L")
+        else:
+            Lw = potrf_mod.potrf(Aw, "L")
         if prec == "bf16":
             Lw = Lw.like(_round_wp(Lw.data, prec))
         elif prec == "f32x2":
@@ -367,6 +386,8 @@ def posv_ir(A: TileMatrix, B: TileMatrix, uplo: str = "L", *,
         correct=solve_w, backward=backward,
         escalate=escalate_fn if escalate else None,
         tol=tol_, max_iters=iters, eager=eager)
+    if prec == "int8":
+        info = dict(info, quant_guard_max=_quant.guard_max(guards))
     return _tile(x, B), info
 
 
@@ -389,9 +410,16 @@ def gesv_ir(A: TileMatrix, B: TileMatrix, *, precision=None,
     tiny = float(jnp.finfo(f64t).tiny)
     eager = utils.is_concrete(A.data)
 
+    guards = []
     with phases.span("factor") as _f:
         Aw = _tile(_round_wp(ad, prec), A)
-        LUw, perm = lu_mod.getrf_ptgpanel(Aw)
+        if prec == "int8":
+            # quantized Schur updates (_lu_apply_block); the panel's
+            # pivot search and U solves stay f32
+            with _quant.update_scope() as guards:
+                LUw, perm = lu_mod.getrf_ptgpanel(Aw)
+        else:
+            LUw, perm = lu_mod.getrf_ptgpanel(Aw)
         if prec == "bf16":
             LUw = LUw.like(_round_wp(LUw.data, prec))
         elif prec == "f32x2":
@@ -437,6 +465,8 @@ def gesv_ir(A: TileMatrix, B: TileMatrix, *, precision=None,
         correct=solve_w, backward=backward,
         escalate=escalate_fn if escalate else None,
         tol=tol_, max_iters=iters, eager=eager)
+    if prec == "int8":
+        info = dict(info, quant_guard_max=_quant.guard_max(guards))
     return _tile(x, B), info
 
 
@@ -463,9 +493,15 @@ def gels_ir(A: TileMatrix, B: TileMatrix, *, precision=None,
     tiny = float(jnp.finfo(f64t).tiny)
     eager = utils.is_concrete(A.data)
 
+    guards = []
     with phases.span("factor") as _f:
         Aw = _tile(_round_wp(ad, prec), A)
-        Afw, Tfw = qr_mod.geqrf(Aw)
+        if prec == "int8":
+            # quantized wide compact-WY applies (ops.qr._quant_apply_q)
+            with _quant.update_scope() as guards:
+                Afw, Tfw = qr_mod.geqrf(Aw)
+        else:
+            Afw, Tfw = qr_mod.geqrf(Aw)
         r32 = jnp.triu(Afw.to_dense()[:N, :N])
         if prec == "bf16":
             r32 = _round_wp(r32, prec)
@@ -506,6 +542,8 @@ def gels_ir(A: TileMatrix, B: TileMatrix, *, precision=None,
         x, residual=residual, correct=snd_solve, backward=backward,
         escalate=escalate_fn if escalate else None,
         tol=tol_, max_iters=iters, eager=eager)
+    if prec == "int8":
+        info = dict(info, quant_guard_max=_quant.guard_max(guards))
     return _tile(x, B), info
 
 
@@ -522,12 +560,18 @@ def summarize(info, *, op: str, precision=None, tol=None) -> dict:
     # of a non-finite measurement); real backward errors are >= 0
     hist = [float(v) for v in np.asarray(info["backward_errors"])
             if v >= 0]
-    return {"op": op, "precision": prec,
-            "iterations": int(np.asarray(info["iterations"])),
-            "backward_errors": hist,
-            "converged": bool(np.asarray(info["converged"])),
-            "escalated": bool(np.asarray(info["escalated"])),
-            "tol": tol_}
+    out = {"op": op, "precision": prec,
+           "iterations": int(np.asarray(info["iterations"])),
+           "backward_errors": hist,
+           "converged": bool(np.asarray(info["converged"])),
+           "escalated": bool(np.asarray(info["escalated"])),
+           "tol": tol_}
+    if "quant_guard_max" in info:
+        # int8 rung: the max ABFT ones-probe residual over the routed
+        # trailing updates (the per-update divergence guard)
+        out["quant_guard_max"] = float(
+            np.asarray(info["quant_guard_max"]))
+    return out
 
 
 # ---------------------------------------------------------------------

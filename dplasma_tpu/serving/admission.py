@@ -15,7 +15,8 @@ retries forever. This module bounds all three:
   :class:`AdmissionError`; an EWMA-smoothed p99 latency tracker fed by
   the ``serving_latency_s`` telemetry histogram (MCA
   ``serving.slo_p99_ms``) *degrades* IR requests to the next-cheaper
-  ``ir.precision`` rung (``bf16 < f32 < f32x2``) before shedding.
+  ``ir.precision`` rung (``int8 < bf16 < f32 < f32x2``) before
+  shedding.
   Every decision lands in the flight recorder as an
   ``admit``/``shed``/``degrade`` event carrying the request id.
 * **deadlines** — ``submit(deadline_s=...)`` (default MCA
@@ -163,7 +164,7 @@ def resolve_deadline(deadline_s: Optional[float],
 
 def degraded_precision() -> Optional[str]:
     """The next-cheaper ``ir.precision`` rung below the ambient one
-    (None at the ``bf16`` floor — nothing left to give up)."""
+    (None at the ``int8`` floor — nothing left to give up)."""
     from dplasma_tpu.ops.refine import PRECISIONS, ir_params
     prec, _, _ = ir_params()
     i = PRECISIONS.index(prec)

@@ -143,6 +143,7 @@ class _Request:
     rid: int = 0           # the stamped request id
     t_submit_ns: int = 0   # wall-clock twin of t_submit (tracing)
     deadline: float = 0.0  # absolute perf_counter expiry; 0 = none
+    autopilot: Optional[dict] = None  # precision pre-flight decision
 
 
 class SolveFuture:
@@ -305,6 +306,13 @@ class SolverService:
         extra = tuple(sorted(kwargs.items()))
         memo = (op, n, nrhs, a.dtype.str, extra)
         deadline = adm_mod.resolve_deadline(deadline_s)
+        # precision-autopilot pre-flight (IR ops, concrete matrix in
+        # hand): condest sketch -> cond class -> stored rung. Runs
+        # BEFORE the lock — O(n^2) host matvecs must not serialize
+        # submission — and folds into the memo/cache key below so each
+        # rung compiles its own executable.
+        ap = self._autopilot_for(op, a) if op.endswith("_ir") else None
+        ap_prec = (ap or {}).get("precision")
         dispatch_now = None
         degrade_prec: Optional[str] = None
         # one critical section per submit: the admission decision, the
@@ -323,14 +331,22 @@ class SolverService:
                 if decision == adm_mod.DEGRADE:
                     # the cheaper-precision executable is a DIFFERENT
                     # program: its own memo slot and cache key (the
-                    # key's precision field pins the compile in _run)
+                    # key's precision field pins the compile in _run).
+                    # An overload degrade outranks the autopilot — it
+                    # is a load-shedding decision, not a tuning one.
                     degrade_prec = adm_mod.degraded_precision()
                     memo = memo + (("degrade", degrade_prec),)
+                elif ap_prec:
+                    # the autopilot's rung lands in the cache key the
+                    # same way: per-rung memo slot, precision-pinned
+                    # compile in _run
+                    memo = memo + (("autopilot", ap_prec),)
                 key = self._keys.get(memo)
                 if key is None:
-                    key = cache_mod.make_key(op, n, a.dtype, 1, nrhs,
-                                             extra=extra,
-                                             precision=degrade_prec)
+                    key = cache_mod.make_key(
+                        op, n, a.dtype, 1, nrhs, extra=extra,
+                        precision=(degrade_prec if degrade_prec
+                                   else ap_prec))
                     self._keys[memo] = key
                 group = key._replace(batch=0)  # batch bucket set at
                 fut = SolveFuture(self, group)  # dispatch
@@ -339,7 +355,7 @@ class SolverService:
                                t_submit=time.perf_counter(),
                                kwargs=dict(kwargs),
                                t_submit_ns=time.time_ns(),
-                               deadline=deadline)
+                               deadline=deadline, autopilot=ap)
                 self._requests += 1
                 req.rid = fut.request_id = rid
                 self.metrics.counter("serving_requests_total",
@@ -374,6 +390,18 @@ class SolverService:
                                  request_id=rid, reason=reason)
         self.telemetry.flight.record("submit", request=rid, op=op,
                                      n=n, nrhs=nrhs)
+        if ap is not None:
+            self.telemetry.flight.record(
+                "autopilot", request=rid, op=op,
+                precision=ap_prec, cond_class=ap["cond_class"],
+                source=ap["source"])
+            self.metrics.counter("serving_autopilot_consults_total",
+                                 source=ap["source"]).inc()
+            if self.verbose >= 1:
+                print(f"#+ serving: req={rid} autopilot "
+                      f"cond_class={ap['cond_class']} "
+                      f"ir.precision={ap_prec or 'ambient'} "
+                      f"({ap['source']})", flush=True)
         if decision == adm_mod.DEGRADE:
             self.telemetry.flight.record(
                 "degrade", request=rid, op=op,
@@ -494,6 +522,57 @@ class SolverService:
                     source=(tune or {}).get("source", "default")).inc()
             self._tuning[key] = tune
             return tune
+
+    def _autopilot_for(self, op: str, a: np.ndarray) -> Optional[dict]:
+        """Precision-autopilot pre-flight of one concrete IR request
+        (:mod:`dplasma_tpu.tuning.autopilot`): condest sketch ->
+        cond-class bucket -> the stored cheapest-converging rung for
+        ``(op, n, dtype, cond_class)``. None when the autopilot is off,
+        no DB is configured, or serving tuning is disabled. Failures
+        degrade to None — a broken pre-flight must never fail a
+        submit."""
+        from dplasma_tpu.tuning import autopilot as ap_mod
+        if _cfg.mca_get("tune.serving", "on") == "off":
+            return None
+        try:
+            return ap_mod.consult(op, a.shape[0], a.dtype, a,
+                                  spd=(op == "posv_ir"))
+        except Exception as exc:
+            import sys
+            sys.stderr.write(f"#! serving: autopilot pre-flight "
+                             f"failed ({exc!r}); ambient rung\n")
+            return None
+
+    def _autopilot_writeback(self, key: cache_mod.CacheKey,
+                             r: _Request) -> None:
+        """The negative write-back: this request's IR solve escalated
+        at runtime, so the rung that ran it is insufficient for its
+        cond class — record the next-stronger rung so the DB
+        converges. Serialized under the service lock (load-modify-save
+        of the JSON document)."""
+        from dplasma_tpu.ops.refine import ir_params
+        from dplasma_tpu.tuning import autopilot as ap_mod
+        ap = r.autopilot
+        ran = key.precision or ir_params()[0]
+        try:
+            with self._lock:
+                ap_mod.record_escalation(
+                    r.op, r.n, r.a.dtype, ap["cond_class"], ran,
+                    cond_estimate=ap.get("cond_estimate"))
+        except Exception as exc:
+            import sys
+            sys.stderr.write(f"#! serving: autopilot write-back "
+                             f"failed ({exc!r})\n")
+            return
+        self.metrics.counter(
+            "serving_autopilot_escalations_total", op=r.op).inc()
+        self.telemetry.flight.record(
+            "autopilot_writeback", request=r.rid, op=r.op,
+            precision=ran, cond_class=ap["cond_class"])
+        if self.verbose >= 1:
+            print(f"#+ serving: req={r.rid} autopilot write-back "
+                  f"(rung {ran} escalated, class "
+                  f"{ap['cond_class']})", flush=True)
 
     def _run(self, key: cache_mod.CacheKey, reqs: List[_Request]):
         """Compile-or-hit + dispatch one bucket-shaped batch; returns
@@ -683,6 +762,18 @@ class SolverService:
                     "bucket": (key.n, key.nrhs, key.batch)}
             if info is not None:
                 meta["refine"] = self._refine_meta(info, i)
+                if r.autopilot is not None:
+                    meta["autopilot"] = r.autopilot
+                    # the batched executables run with in-executable
+                    # escalation OFF (batched.py: a lax.cond under
+                    # vmap would charge the whole batch), so the
+                    # rung-failed verdict is non-convergence — the
+                    # remediation ladder does the actual escalating,
+                    # this records it so the DB converges
+                    if (meta["refine"].get("escalated")
+                            or not meta["refine"].get(
+                                "converged", True)):
+                        self._autopilot_writeback(key, r)
             if rejected:
                 # no response to verify — synthesize a failing health
                 # record and go straight to remediation
@@ -728,6 +819,7 @@ class SolverService:
         hist = [float(v) for v in np.asarray(info["backward_errors"])[i]
                 if v >= 0]
         return {"converged": bool(np.asarray(info["converged"])[i]),
+                "escalated": bool(np.asarray(info["escalated"])[i]),
                 "iterations": int(np.asarray(info["iterations"])[i]),
                 "backward_errors": hist}
 

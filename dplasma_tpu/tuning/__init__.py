@@ -14,7 +14,15 @@ serving layer resolve their knobs from it at dispatch.
 Consultation precedence: CLI flag > ``DPLASMA_MCA_*`` env > DB >
 registered default. ``tools/autotune.py`` is the CLI face (sweep /
 show / prune-report / export / check).
+
+The precision autopilot (:mod:`dplasma_tpu.tuning.autopilot`, DB v2)
+adds ``ir.precision`` to the tuned key space: a condest pre-flight
+buckets concrete IR solves by condition class, the cheapest-converging
+rung per ``(op, n, dtype, cond_class)`` persists under 5-part
+``|cond=<class>`` keys, and runtime escalations write back negative
+entries so the buckets converge.
 """
+from dplasma_tpu.tuning import autopilot
 from dplasma_tpu.tuning.db import (KNOB_NAMES, MCA_KNOBS,
                                    TUNE_DB_SCHEMA, TuningDB,
                                    appliable, consult, db_path,
@@ -28,6 +36,7 @@ from dplasma_tpu.tuning.search import (MEASURABLE_OPS,
                                        select_winner, sweep)
 
 __all__ = [
+    "autopilot",
     "KNOB_NAMES", "MCA_KNOBS", "TUNE_DB_SCHEMA", "TuningDB",
     "appliable", "consult", "db_path", "load_or_empty", "make_key",
     "parse_key", "resolved_knobs",

@@ -39,7 +39,11 @@ from typing import Dict, Optional, Tuple
 from dplasma_tpu.utils import config as _cfg
 
 #: version of the on-disk document; additive changes bump it.
-TUNE_DB_SCHEMA = 1
+#: v2: the precision autopilot (tuning.autopilot) — ``ir.precision``
+#: joins the appliable knob space and keys may carry a 5th
+#: ``cond=<class>`` part (cond-class-bucketed rung winners with
+#: ``cond_class`` + ``autopilot`` provenance fields).
+TUNE_DB_SCHEMA = 2
 
 _cfg.mca_register(
     "tune.db", "",
@@ -68,9 +72,11 @@ _cfg.mca_register(
 #: structurally — tile/grid shape, not MCA state). ``ring.enable``
 #: makes ring-vs-psum panel transfers in the cyclic kernels a tuned,
 #: stored decision per (op, n, dtype, grid) key.
+#: ``ir.precision`` puts the IR working-precision rung in the tuned
+#: key space (the precision autopilot's stored decision).
 MCA_KNOBS = ("sweep.lookahead", "qr.agg_depth", "lu.agg_depth",
              "panel.kernel", "panel.tree_leaf", "panel.rec_base",
-             "ring.enable")
+             "ring.enable", "ir.precision")
 
 #: every key a full resolved knob vector carries (``panel.qr``/
 #: ``panel.lu`` are the per-route resolutions of ``panel.kernel`` —
@@ -88,26 +94,39 @@ def db_path() -> Optional[str]:
     return p or None
 
 
-def make_key(op: str, n: int, dtype, grid: Tuple[int, int]) -> str:
+def make_key(op: str, n: int, dtype, grid: Tuple[int, int],
+             cond: Optional[str] = None) -> str:
     """Canonical tuning key ``op|n=N|dtype|gPxQ`` for one
-    ``(op, n, dtype, grid)`` point of the key space."""
+    ``(op, n, dtype, grid)`` point of the key space; the autopilot's
+    cond-class-bucketed entries append a 5th ``|cond=<class>`` part
+    (v2)."""
     import numpy as _np
     name = _np.dtype(dtype).name if not isinstance(dtype, str) \
         else dtype
     P, Q = int(grid[0]), int(grid[1])
-    return f"{op}|n={int(n)}|{name}|g{P}x{Q}"
+    key = f"{op}|n={int(n)}|{name}|g{P}x{Q}"
+    if cond is not None:
+        key += f"|cond={cond}"
+    return key
 
 
 def parse_key(key: str) -> Optional[dict]:
-    """Invert :func:`make_key`; None for an unparseable key."""
+    """Invert :func:`make_key`; None for an unparseable key. The
+    ``cond`` field is None for classic 4-part keys."""
     parts = key.split("|")
-    if len(parts) != 4 or not parts[1].startswith("n=") \
+    if len(parts) not in (4, 5) or not parts[1].startswith("n=") \
             or not parts[3].startswith("g") or "x" not in parts[3]:
         return None
+    cond = None
+    if len(parts) == 5:
+        if not parts[4].startswith("cond=") or not parts[4][5:]:
+            return None
+        cond = parts[4][5:]
     try:
         P, Q = parts[3][1:].split("x")
         return {"op": parts[0], "n": int(parts[1][2:]),
-                "dtype": parts[2], "grid": (int(P), int(Q))}
+                "dtype": parts[2], "grid": (int(P), int(Q)),
+                "cond": cond}
     except ValueError:
         return None
 
@@ -133,11 +152,22 @@ def resolved_knobs(nb: Optional[int] = None,
         "panel.tree_leaf": _cfg.mca_get_int("panel.tree_leaf", 2),
         "panel.rec_base": _cfg.mca_get_int("panel.rec_base", 8),
         "ring.enable": _cfg.mca_get("ring.enable") or "auto",
+        # the active IR rung: bench/report pipelines carry it so
+        # perfdiff's same-knob-vector baselining compares a rung flip
+        # same-vs-same instead of against the other rung's history
+        "ir.precision": _ir_precision(),
     }
     if nb is not None:
         kv["nb"] = int(nb)
     kv["grid"] = f"{int(grid[0])}x{int(grid[1])}"
     return kv
+
+
+def _ir_precision() -> str:
+    """The resolved ``ir.precision`` rung (lazy import: refine pulls
+    kernels.dd at module load)."""
+    from dplasma_tpu.ops.refine import ir_params
+    return ir_params()[0]
 
 
 def appliable(knobs: dict, skip=()) -> dict:
@@ -268,6 +298,12 @@ class TuningDB:
         best, best_d = None, None
         for entry in self.entries.values():
             if not isinstance(entry, dict):
+                continue
+            if entry.get("cond_class"):
+                # precision-autopilot entries (5-part ``|cond=`` keys)
+                # are condition-class-specific: only autopilot.choose
+                # may interpolate them — a shape-keyed consult must
+                # not apply an ill-bucket rung to a well matrix
                 continue
             if entry.get("op") != op or entry.get("dtype") != dname \
                     or entry.get("grid") != want_grid:

@@ -77,8 +77,11 @@ def test_decide_slo_pressure_degrades_ir_sheds_direct():
         assert adm.degraded_precision() == "bf16"
         # a direct solve has no precision rung -> SHED
         assert c.decide("posv", 0, 0)[0] == adm.SHED
-        # at the bf16 floor there is nothing left to give up -> SHED
+        # bf16 still has the block-scaled int8 rung below it
         with mca_overrides({"ir.precision": "bf16"}):
+            assert adm.degraded_precision() == "int8"
+        # at the int8 floor there is nothing left to give up -> SHED
+        with mca_overrides({"ir.precision": "int8"}):
             assert adm.degraded_precision() is None
             assert c.decide("posv_ir", 0, 0)[0] == adm.SHED
     assert c.metrics.counter("serving_admitted_total").value == 1
@@ -448,7 +451,7 @@ def test_run_report_admission_section_roundtrip(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 16
+    assert doc["schema"] == REPORT_SCHEMA == 17
     assert doc["admission"]["admitted"] == 1
     assert doc["admission"]["audit"]["balanced"] is True
     assert doc["admission"]["retry_budget"] == {"limit": 0, "used": 0}
@@ -476,7 +479,7 @@ def test_servebench_soak_audit_balances_under_chaos(tmp_path):
                           "--mca", "serving.max_queue=4"])
     assert rc == 0
     doc = json.load(open(rep))
-    assert doc["schema"] == 16
+    assert doc["schema"] == 17
     audit = doc["admission"]["audit"]
     assert audit["balanced"] is True
     assert audit["submitted"] == audit["admitted"] + audit["shed"]

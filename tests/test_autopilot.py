@@ -1,0 +1,299 @@
+"""The precision autopilot (tuning.autopilot): ``ir.precision`` in
+the tuned key space, bucketed by a condition pre-flight.
+
+Covers the PR 19 tentpole: the condest sketch is deterministic and
+decade-exact on gap-separated spectra (the documented accuracy
+contract — continuous spectra err toward "well" and the escalation
+write-back corrects the bucket); cond-class bucketing follows the MCA
+thresholds; ``choose`` resolves exact/interpolated/default within a
+cond class only; ``record``/``record_escalation`` store rung verdicts
+with provenance under 5-part ``|cond=<class>`` keys that pass
+``TuningDB.check``; the shape-keyed tuner consult never applies a
+cond-bucketed rung; the serving layer consults the autopilot (flight
+``autopilot`` event, precision-pinned cache key, ``meta.autopilot``)
+and a non-converging rung writes the negative entry back (flight
+``autopilot_writeback``, DB bumped to the next rung); and the driver
+``--autotune`` path lands the decision in the v17 ``"autopilot"``
+report section.
+"""
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dplasma_tpu.tuning import autopilot as ap
+from dplasma_tpu.tuning import db as tdb
+from dplasma_tpu.utils import config as _cfg
+
+
+@pytest.fixture
+def dbp(tmp_path, monkeypatch):
+    p = str(tmp_path / "tune_db.json")
+    monkeypatch.setenv("DPLASMA_TUNE_DB", p)
+    return p
+
+
+def _gapped_spd(n, target, seed=3):
+    """SPD with a gap-separated spectrum: ones plus ONE eigenvalue at
+    1/target — the regime where the sketch is decade-exact."""
+    rng = np.random.default_rng(seed)
+    Q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    d = np.ones(n)
+    d[-1] = 1.0 / target
+    return (Q * d) @ Q.T
+
+
+# ------------------------------------------------------- the sketch
+
+def test_cond_class_buckets_and_thresholds():
+    assert ap.cond_class(10.0) == "well"
+    assert ap.cond_class(1e6) == "moderate"
+    assert ap.cond_class(1e9) == "ill"
+    assert ap.cond_class(float("inf")) == "ill"
+    assert ap.cond_class(float("nan")) == "ill"
+    _cfg.mca_set("autopilot.cond_well", "1e2")
+    try:
+        assert ap.cond_class(1e3) == "moderate"
+    finally:
+        _cfg.mca_unset("autopilot.cond_well")
+
+
+def test_condest_sketch_deterministic_decade_exact():
+    for target, cls in ((1e2, "well"), (1e6, "moderate"),
+                        (1e10, "ill")):
+        a = _gapped_spd(48, target)
+        est1 = ap.condest_sketch(a, spd=True)
+        est2 = ap.condest_sketch(a, spd=True)
+        assert est1 == est2          # bit-identical: fixed start
+        assert est1 == pytest.approx(target, rel=1e-6)
+        assert ap.cond_class(est1) == cls
+
+
+def test_condest_sketch_general_via_gram():
+    # a general matrix routes through the Gram operator; the identity
+    # sketches to kappa ~ 1 either way
+    est = ap.condest_sketch(np.eye(32), spd=False)
+    assert est == pytest.approx(1.0, rel=1e-6)
+    assert ap.preflight(np.eye(32))[1] == "well"
+
+
+def test_next_rung_ladder():
+    assert ap.next_rung("int8") == "bf16"
+    assert ap.next_rung("bf16") == "f32"
+    assert ap.next_rung("f32") == "f32x2"
+    assert ap.next_rung("f32x2") is None
+
+
+# ------------------------------------------------------- the DB face
+
+def test_cond_keys_parse_and_check_clean(dbp):
+    k = tdb.make_key("posv_ir", 64, "float64", (1, 1), cond="well")
+    assert k.endswith("|cond=well")
+    parsed = tdb.parse_key(k)
+    assert parsed["cond"] == "well" and parsed["n"] == 64
+    assert tdb.parse_key("posv_ir|n=64|float64|g1x1")["cond"] is None
+    assert tdb.parse_key("a|n=1|f|g1x1|cond=") is None
+    ap.record("posv_ir", 64, "float64", "well", "int8",
+              converged=True, cond_estimate=12.0, path=dbp)
+    db = tdb.TuningDB.load(dbp)
+    assert db.check() == []
+    (key,) = db.entries
+    e = db.entries[key]
+    assert key == k
+    assert e["knobs"]["ir.precision"] == "int8"
+    assert e["cond_class"] == "well"
+    assert e["autopilot"]["verdict"] == "converged"
+    assert e["autopilot"]["cond_estimate"] == 12.0
+
+
+def test_choose_exact_interpolated_default(dbp):
+    # empty DB: default
+    prec, source, key, _ = ap.choose("posv_ir", 64, "float64", "well",
+                                     path=dbp)
+    assert prec is None and source == "default"
+    ap.record("posv_ir", 64, "float64", "well", "int8",
+              converged=True, path=dbp)
+    prec, source, _, _ = ap.choose("posv_ir", 64, "float64", "well",
+                                   path=dbp)
+    assert (prec, source) == ("int8", "db")
+    # same class, different n: nearest-n interpolation
+    prec, source, _, _ = ap.choose("posv_ir", 128, "float64", "well",
+                                   path=dbp)
+    assert (prec, source) == ("int8", "interpolated")
+    # different cond class: never borrows across buckets
+    prec, source, _, _ = ap.choose("posv_ir", 64, "float64", "ill",
+                                   path=dbp)
+    assert prec is None and source == "default"
+
+
+def test_record_escalation_bumps_rung_with_provenance(dbp):
+    ap.record("gesv_ir", 96, "float64", "ill", "int8",
+              converged=True, path=dbp)
+    ap.record_escalation("gesv_ir", 96, "float64", "ill", "int8",
+                         cond_estimate=3e9, path=dbp)
+    db = tdb.TuningDB.load(dbp)
+    key = tdb.make_key("gesv_ir", 96, "float64", (1, 1), cond="ill")
+    e = db.entries[key]
+    assert e["knobs"]["ir.precision"] == "bf16"
+    assert e["autopilot"]["verdict"] == "escalated"
+    assert "int8" in e["autopilot"]["rejected"]
+    assert db.check() == []
+    # escalating again climbs the ladder and keeps the rejected set
+    ap.record_escalation("gesv_ir", 96, "float64", "ill", "bf16",
+                         path=dbp)
+    e = tdb.TuningDB.load(dbp).entries[key]
+    assert e["knobs"]["ir.precision"] == "f32"
+    assert set(e["autopilot"]["rejected"]) >= {"int8", "bf16"}
+
+
+def test_consult_summary_shape(dbp):
+    ap.record("posv_ir", 48, "float64", "well", "int8",
+              converged=True, path=dbp)
+    dec = ap.consult("posv_ir", 48, "float64",
+                     _gapped_spd(48, 1e2), spd=True, path=dbp)
+    assert dec["precision"] == "int8" and dec["source"] == "db"
+    assert dec["cond_class"] == "well"
+    assert dec["cond_estimate"] == pytest.approx(1e2, rel=1e-6)
+    assert dec["key"].endswith("|cond=well")
+    # autopilot off: consult is inert
+    _cfg.mca_set("autopilot.enable", "off")
+    try:
+        assert ap.consult("posv_ir", 48, "float64",
+                          np.eye(48), path=dbp) is None
+    finally:
+        _cfg.mca_unset("autopilot.enable")
+
+
+def test_shape_keyed_consult_ignores_cond_entries(dbp):
+    """The classic tuner lookup must NOT interpolate a cond-bucketed
+    rung — an ill-bucket decision applied to a well matrix (or vice
+    versa) bypasses the pre-flight entirely."""
+    ap.record("posv_ir", 64, "float64", "ill", "f32x2",
+              converged=True, path=dbp)
+    entry, source = tdb.TuningDB.load(dbp).lookup(
+        "posv_ir", 64, "float64", (1, 1))
+    assert entry is None and source == "default"
+
+
+# ------------------------------------------------- serving integration
+
+def _spd_operands(n, cond=None, dtype=np.float64):
+    if cond is None:
+        rng = np.random.default_rng(7)
+        g = rng.standard_normal((n, n))
+        a = (g @ g.T + n * np.eye(n)).astype(dtype)
+    else:
+        a = _gapped_spd(n, cond).astype(dtype)
+    b = np.random.default_rng(8).standard_normal(n).astype(dtype)
+    return a, b
+
+
+def test_serving_picks_stored_rung(dbp):
+    from dplasma_tpu.serving import SolverService
+    n = 32
+    ap.record("posv_ir", n, "float64", "well", "int8",
+              converged=True, path=dbp)
+    svc = SolverService(nb=16, max_batch=2, max_wait_ms=0)
+    a, b = _spd_operands(n)
+    fut = svc.submit("posv_ir", a, b)
+    svc.flush()
+    x, meta = fut.result(120.0), fut.meta
+    # the decision rode the request into meta
+    assert meta["autopilot"]["precision"] == "int8"
+    assert meta["autopilot"]["source"] == "db"
+    assert meta["autopilot"]["cond_class"] == "well"
+    assert meta["refine"]["converged"]
+    np.testing.assert_allclose(a @ np.asarray(x), b, atol=1e-8)
+    # the rung pinned the cache key (per-rung executable)
+    assert any(k.precision == "int8" for k in svc._keys.values())
+    # flight + counter
+    kinds = [e["kind"] for e in svc.telemetry.flight.events()]
+    assert "autopilot" in kinds
+    assert sum(m["value"] for m in svc.metrics.snapshot()
+               if m["name"] == "serving_autopilot_consults_total") >= 1
+
+
+def test_serving_writeback_on_nonconverging_rung(dbp):
+    """An ill seed with a stored (too-cheap) int8 rung: the batched
+    executable runs escalate=False, so the verdict is non-convergence
+    — serving must write the negative entry back (DB bumped to bf16,
+    ``autopilot_writeback`` flight event) and still deliver a usable
+    answer through the remediation ladder."""
+    from dplasma_tpu.serving import SolverService
+    n = 32
+    ap.record("posv_ir", n, "float64", "ill", "int8",
+              converged=True, path=dbp)
+    svc = SolverService(nb=16, max_batch=2, max_wait_ms=0)
+    a, b = _spd_operands(n, cond=1e10)
+    fut = svc.submit("posv_ir", a, b)
+    svc.flush()
+    x = fut.result(240.0)
+    meta = fut.meta
+    assert meta["autopilot"]["precision"] == "int8"
+    assert meta["autopilot"]["cond_class"] == "ill"
+    key = tdb.make_key("posv_ir", n, "float64", (1, 1), cond="ill")
+    e = tdb.TuningDB.load(dbp).entries[key]
+    assert e["knobs"]["ir.precision"] == "bf16"
+    assert "int8" in e["autopilot"]["rejected"]
+    kinds = [ev["kind"] for ev in svc.telemetry.flight.events()]
+    assert "autopilot_writeback" in kinds
+    assert sum(m["value"] for m in svc.metrics.snapshot()
+               if m["name"]
+               == "serving_autopilot_escalations_total") >= 1
+    assert np.all(np.isfinite(np.asarray(x)))
+
+
+def test_serving_autopilot_off_without_db(tmp_path, monkeypatch):
+    from dplasma_tpu.serving import SolverService
+    monkeypatch.delenv("DPLASMA_TUNE_DB", raising=False)
+    svc = SolverService(nb=16, max_batch=2, max_wait_ms=0)
+    a, b = _spd_operands(32)
+    fut = svc.submit("posv_ir", a, b)
+    svc.flush()
+    fut.result(120.0)
+    assert "autopilot" not in fut.meta
+    assert all(k.precision != "int8" for k in svc._keys.values())
+
+
+# --------------------------------------------------- driver integration
+
+def test_driver_autotune_consults_autopilot(dbp, tmp_path, capsys):
+    from dplasma_tpu.drivers import main
+    ap.record("posv_ir", 64, "float64", "well", "int8",
+              converged=True, path=dbp)
+    rj = str(tmp_path / "r.json")
+    rc = main(["-N", "64", "-t", "32", "-K", "2", "-x", "--autotune",
+               f"--report={rj}", "-v=2"], prog="testing_dposv_ir")
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "#+ autopilot[posv_ir]" in out
+    doc = json.load(open(rj))
+    assert doc["schema"] == 17
+    (dec,) = doc["autopilot"]
+    assert dec["precision"] == "int8" and dec["source"] == "db"
+    assert dec["cond_class"] == "well"
+    (ref,) = doc["refine"]
+    assert ref["precision"] == "int8" and ref["converged"]
+    assert ref["quant_guard_max"] > 0
+    assert any(m["name"] == "autopilot_consults_total"
+               for m in doc["metrics"])
+    # the decision steered the actual solve: nothing escalated, and
+    # no negative entry was written back
+    db = tdb.TuningDB.load(dbp)
+    key = tdb.make_key("posv_ir", 64, "float64", (1, 1), cond="well")
+    assert db.entries[key]["knobs"]["ir.precision"] == "int8"
+
+
+def test_driver_without_autotune_skips_autopilot(tmp_path, capsys,
+                                                monkeypatch):
+    from dplasma_tpu.drivers import main
+    monkeypatch.delenv("DPLASMA_TUNE_DB", raising=False)
+    rj = str(tmp_path / "r.json")
+    rc = main(["-N", "64", "-t", "32", f"--report={rj}"],
+              prog="testing_dposv_ir")
+    assert rc == 0
+    doc = json.load(open(rj))
+    assert "autopilot" not in doc
+    (ref,) = doc["refine"]
+    assert ref["precision"] == "f32"      # the default rung, untouched

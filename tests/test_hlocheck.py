@@ -311,6 +311,49 @@ def test_demotion_allowed_at_registered_site():
     assert res2.diagnostics[0].detail["source"].endswith("ops/lu.py")
 
 
+def test_declared_demotion_quantizer_site_both_directions():
+    """Float->INTEGER narrowing is held to DECLARED_DEMOTIONS, not
+    PRECISION_SITES: the block-scaled quantizer's f32 -> s8 store
+    (kernels/quant.py) passes, while the SAME convert from any
+    undeclared site — or a different triple at the declared site —
+    still fails the audit."""
+    text = (
+        'HloModule jit_q, entry_computation_layout='
+        '{(f32[4,4]{1,0})->s8[4,4]{1,0}}\n\n'
+        'ENTRY %main (p0: f32[4,4]) -> s8[4,4] {\n'
+        '  %p0 = f32[4,4]{1,0} parameter(0)\n'
+        '  %convert.1 = s8[4,4]{1,0} convert(f32[4,4]{1,0} %p0), '
+        'metadata={op_name="q" source_file='
+        '"/repo/dplasma_tpu/kernels/quant.py" source_line=77}\n'
+        '  ROOT %r = s8[4,4]{1,0} copy(s8[4,4]{1,0} %convert.1)\n'
+        '}\n')
+    assert ("kernels/quant.py", "f32", "s8") in hc.DECLARED_DEMOTIONS
+    mod = hc.parse_module(text)
+    res = hc.HloResult(kernel="quant-site")
+    hc.check_precision(mod, res, working_bits=32)
+    assert res.ok, res.summary()
+    # the identical quantize at an UNDECLARED site fails — even a
+    # registered PRECISION_SITES member does not cover f32 -> s8
+    mod2 = hc.parse_module(text.replace("kernels/quant.py",
+                                        "kernels/dd.py"))
+    res2 = hc.HloResult(kernel="undeclared-site")
+    hc.check_precision(mod2, res2, working_bits=32)
+    assert not res2.ok
+    d = res2.diagnostics[0]
+    assert d.kind == "precision-demotion"
+    assert "DECLARED_DEMOTIONS" in d.message
+    assert d.detail["src"] == "f32" and d.detail["dst"] == "s8"
+    # a DIFFERENT triple at the declared site fails too: the
+    # allowlist is exact (site, src, dst), not per-file
+    mod3 = hc.parse_module(
+        text.replace("f32[4,4]", "f64[4,4]").replace(
+            "(p0: f32", "(p0: f64"))
+    res3 = hc.HloResult(kernel="wrong-triple")
+    hc.check_precision(mod3, res3, working_bits=64)
+    assert not res3.ok
+    assert res3.diagnostics[0].detail["src"] == "f64"
+
+
 def test_mutation_shrunk_hbm_budget_names_worst_buffer(devices8):
     """Peak bytes over hlocheck.hbm_budget fails naming the largest
     temp buffer in the module."""
@@ -425,7 +468,7 @@ def test_driver_hlocheck_end_to_end(prog, tmp_path, capsys, devices8):
     assert rc == 0
     assert f"hlocheck[{prog}]" in out and "OK" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 16
+    assert doc["schema"] == 17
     (entry,) = doc["hlocheck"]
     assert entry["ok"] and entry["op"] == prog
     assert entry["relation"] in ("gspmd", "==", ">=",

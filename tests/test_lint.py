@@ -103,6 +103,7 @@ def test_lint_all_aggregate_is_clean(capsys):
                  "threadcheck", "palcheck", "dagcheck-smoke",
                  "memcheck-smoke",
                  "spmdcheck-smoke", "serving-smoke", "hlocheck-smoke",
-                 "ring-smoke", "tune-smoke", "telemetry-smoke",
+                 "ring-smoke", "tune-smoke", "quant-smoke",
+                 "telemetry-smoke",
                  "devprof-smoke", "soak-smoke"):
         assert f"# {gate}: OK" in out.out

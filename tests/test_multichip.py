@@ -74,7 +74,7 @@ def test_main_end_to_end_report_ledger_and_gate(tmp_path, capsys,
                          "--report", rj, "--history", hist])
     assert rc == 0
     doc = json.load(open(rj))
-    assert doc["schema"] == 16
+    assert doc["schema"] == 17
     (sec,) = doc["scaling"]
     assert [p["chips"] for p in sec["points"]] == [1, 2]
     assert doc["ops"] and doc["entries"]

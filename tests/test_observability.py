@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 16
+    assert doc["schema"] == REPORT_SCHEMA == 17
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -270,6 +270,13 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
                             "feasible": True},
                  "skipped": False,
                  "counts": {}, "diagnostics": []}]},
+        17: {"schema": 17, "name": "v17", "ops": [], "metrics": [],
+             "autopilot": [{
+                 "op": "posv_ir", "n": 4096, "dtype": "float32",
+                 "cond_estimate": 312.4, "cond_class": "well",
+                 "precision": "int8", "source": "db",
+                 "key": "posv_ir|n=4096|float32|g1x1|cond=well",
+                 "db": "tune_db.json"}]},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -525,7 +532,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 16
+    assert doc["schema"] == 17
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

@@ -252,7 +252,7 @@ def test_driver_phase_profile_acceptance(tmp_path, capsys, prog):
     overhead) to the attributed run time."""
     doc = _phase_run(tmp_path, prog)
     out = capsys.readouterr().out
-    assert doc["schema"] == 16
+    assert doc["schema"] == 17
     (op,) = doc["ops"]
     ph = op["phases"]
     spans = ph["spans"]

@@ -1,0 +1,315 @@
+"""Block-scaled int8 trailing updates (kernels.quant) + the int8 IR
+rung (ops.refine ``ir.precision=int8``).
+
+Covers the PR 19 tentpole: symmetric per-tile scale quantization
+round-trips within the half-step bound at any per-tile dynamic range;
+the per-K-block ``preferred_element_type=int32`` accumulation is EXACT
+on adversarial integer inputs; qgemm matches its eager self under
+jit (allclose — XLA fusion reorders the f32 cross-block accumulate);
+:func:`~dplasma_tpu.kernels.quant.update_dot` is a bit-identical
+fall-through to ``kernels.blas.dot`` unless the scope opts in AND the
+operands are real f32; the factorization sweeps route their trailing
+updates through it (panels stay exact); and the int8 IR rung
+converges to the f64-equivalent backward-error gate on
+well-conditioned seeds, surfaces the ABFT ``quant_guard_max``, and
+deterministically escalates on a cond~1e9 seed. Heavy all-op sweeps
+are ``slow``-marked.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import mca_overrides
+
+from dplasma_tpu.descriptors import TileMatrix
+from dplasma_tpu.kernels import blas as kb
+from dplasma_tpu.kernels import quant
+from dplasma_tpu.ops import generators, refine
+
+mca = mca_overrides
+
+
+# ------------------------------------------------- quantize round-trip
+
+def test_quantize_roundtrip_half_step_bound(rng):
+    tile = 32
+    x = (rng.standard_normal((96, 64)).astype(np.float32)
+         * rng.choice([1e-2, 1.0, 1e2], size=(96, 64))
+         .astype(np.float32))
+    q, sc = quant.quantize(x, tile)
+    assert np.asarray(q).dtype == np.int8
+    y = np.asarray(quant.dequantize(q, sc, tile, x.shape))
+    step = np.repeat(np.repeat(np.asarray(sc), tile, 0), tile, 1)
+    assert np.all(np.abs(y - x) <= 0.5 * step[:96, :64] * (1 + 1e-6))
+
+
+def test_quantize_extreme_dynamic_range(rng):
+    """Per-tile scales keep BOTH a ~1e6 tile and a ~1e-6 tile at full
+    int8 resolution — the one-scale-per-matrix scheme would flush the
+    small tile to zero entirely."""
+    tile = 32
+    x = np.zeros((64, 64), np.float32)
+    x[:32, :32] = (rng.standard_normal((32, 32)) * 1e6).astype(
+        np.float32)
+    x[32:, 32:] = (rng.standard_normal((32, 32)) * 1e-6).astype(
+        np.float32)
+    q, sc = quant.quantize(x, tile)
+    y = np.asarray(quant.dequantize(q, sc, tile, x.shape))
+    for r, c in ((slice(0, 32), slice(0, 32)),
+                 (slice(32, 64), slice(32, 64))):
+        amax = np.max(np.abs(x[r, c]))
+        err = np.max(np.abs(y[r, c] - x[r, c]))
+        # half a quantization step, relative to the TILE's own amax
+        assert err <= 0.5 * amax / 127.0 * (1 + 1e-6)
+    # the small tile did NOT flush to zero
+    assert np.any(y[32:, 32:] != 0)
+
+
+def test_quantize_pads_to_tile_multiples(rng):
+    x = rng.standard_normal((40, 24)).astype(np.float32)
+    q, sc = quant.quantize(x, 32)
+    assert np.asarray(q).shape == (64, 32)
+    assert np.asarray(sc).shape == (2, 1)
+    y = np.asarray(quant.dequantize(q, sc, 32, x.shape))
+    assert y.shape == x.shape
+
+
+# ------------------------------------------------------------- qgemm
+
+def test_qgemm_int32_accumulation_exact(rng):
+    """Adversarial integer inputs: every tile carries a ±127 so the
+    symmetric scale is exactly 1.0 — the quantization is the identity
+    and the int32 tile products must match the f64 reference EXACTLY
+    (the accumulation is integer inside a K block; products stay far
+    below 2^24, so even the f32 carry is exact)."""
+    tile = 32
+    a = rng.integers(-127, 128, (64, 32)).astype(np.float32)
+    b = rng.integers(-127, 128, (32, 48)).astype(np.float32)
+    a[0, 0] = a[32, 0] = 127.0
+    b[0, 0] = b[0, 32] = 127.0
+    got = np.asarray(quant.qgemm(a, b, tile))
+    ref = a.astype(np.float64) @ b.astype(np.float64)
+    assert np.array_equal(got.astype(np.float64), ref)
+
+
+def test_qgemm_tracks_f32_reference(rng):
+    a = rng.standard_normal((64, 96)).astype(np.float32)
+    b = rng.standard_normal((96, 80)).astype(np.float32)
+    ref = a @ b
+    got = np.asarray(quant.qgemm(a, b, 32))
+    rel = np.max(np.abs(got - ref)) / np.max(np.abs(ref))
+    assert rel < 5e-2
+
+
+def test_qgemm_traced_matches_eager(rng):
+    a = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+    eager = np.asarray(quant.qgemm(a, b, 32))
+    traced = np.asarray(jax.jit(lambda x, y: quant.qgemm(x, y, 32))(
+        a, b))
+    # fusion may reorder the f32 cross-block accumulate: allclose,
+    # not bitwise (the int32 block products themselves are exact)
+    np.testing.assert_allclose(traced, eager, rtol=1e-4, atol=1e-4)
+
+
+def test_qgemm_zero_dim():
+    a = jnp.zeros((0, 8), jnp.float32)
+    b = jnp.zeros((8, 4), jnp.float32)
+    assert np.asarray(quant.qgemm(a, b, 8)).shape == (0, 4)
+
+
+# ------------------------------------------------- update_dot routing
+
+def test_update_dot_is_bit_identical_fall_through(rng):
+    a = jnp.asarray(rng.standard_normal((32, 16)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((32, 24)), jnp.float32)
+    # no scope active: exact fall-through to kernels.blas.dot
+    assert np.array_equal(
+        np.asarray(quant.update_dot(a, b, ta=True)),
+        np.asarray(kb.dot(a, b, ta=True)))
+    # scope active but f64 operands: still a fall-through (the rung
+    # only quantizes real f32 working data)
+    a64, b64 = a.astype(jnp.float64), b.astype(jnp.float64)
+    with quant.update_scope():
+        assert not quant.updates_active(a64.dtype, b64.dtype)
+        assert np.array_equal(
+            np.asarray(quant.update_dot(a64, b64, ta=True)),
+            np.asarray(kb.dot(a64, b64, ta=True)))
+
+
+def test_update_dot_quantizes_under_scope(rng):
+    a = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((32, 64)), jnp.float32)
+    exact = np.asarray(kb.dot(a, b))
+    with mca({"quant.tile": "32"}):
+        with quant.update_scope() as guards:
+            assert quant.updates_active(a.dtype, b.dtype)
+            got = np.asarray(quant.update_dot(a, b))
+    # quantized: close to exact but not equal, and the ABFT ones-probe
+    # recorded a finite nonzero residual for the update
+    assert not np.array_equal(got, exact)
+    rel = np.max(np.abs(got - exact)) / np.max(np.abs(exact))
+    assert rel < 5e-2
+    assert len(guards) == 1
+    gm = float(np.asarray(quant.guard_max(guards)))
+    assert 0 < gm < 1e-1
+    # guard_max of an empty scope is a well-defined zero
+    assert float(np.asarray(quant.guard_max([]))) == 0.0
+
+
+def test_update_dot_transposes_route(rng):
+    a = jnp.asarray(rng.standard_normal((32, 48)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((64, 48)), jnp.float32)
+    exact = np.asarray(kb.dot(a, b, tb=True))
+    with mca({"quant.tile": "16"}):
+        with quant.update_scope(guard=False):
+            got = np.asarray(quant.update_dot(a, b, tb=True))
+    rel = np.max(np.abs(got - exact)) / np.max(np.abs(exact))
+    assert rel < 5e-2
+
+
+def test_update_scope_restores_config():
+    from dplasma_tpu.utils import config as _cfg
+    assert (_cfg.mca_get("quant.updates") or "off") == "off"
+    with quant.update_scope():
+        assert _cfg.mca_get("quant.updates") == "int8"
+    assert (_cfg.mca_get("quant.updates") or "off") == "off"
+    assert not quant.updates_active(jnp.float32)
+
+
+# ------------------------------------- factorization update routing
+
+def test_potrf_quantized_updates_stay_close(rng):
+    """potrf under the int8 update scope: trailing updates quantize
+    (the factor moves), panels stay exact — and with the scope off
+    the run is bit-identical to the baseline (no global hook)."""
+    from dplasma_tpu.ops import potrf as potrf_mod
+    A = generators.plghe(96.0, 96, 32, seed=11, dtype=jnp.float32)
+    base = np.asarray(potrf_mod.potrf(A, "L").data)
+    again = np.asarray(potrf_mod.potrf(A, "L").data)
+    assert np.array_equal(base, again)
+    with mca({"quant.tile": "32"}):
+        with quant.update_scope(guard=False):
+            qd = np.asarray(potrf_mod.potrf(A, "L").data)
+    assert not np.array_equal(qd, base)
+    rel = np.linalg.norm(qd - base) / np.linalg.norm(base)
+    assert rel < 5e-2
+
+
+@pytest.mark.slow
+def test_all_ops_quantized_updates_sweep(rng):
+    """Heavy: potrf/getrf/geqrf trailing updates under the int8 scope
+    across sizes — factors stay within a coarse relative band of the
+    exact route (refinement owns the rest)."""
+    from dplasma_tpu.ops import lu
+    from dplasma_tpu.ops import potrf as potrf_mod
+    from dplasma_tpu.ops import qr
+    for n, nb in ((96, 32), (128, 32)):
+        A = generators.plghe(float(n), n, nb, seed=7,
+                             dtype=jnp.float32)
+        G = generators.plrnt(n, n, nb, nb, seed=8, dtype=jnp.float32,
+                             diagdom=True)
+        with mca({"quant.tile": "32"}):
+            with quant.update_scope(guard=False):
+                qc = np.asarray(potrf_mod.potrf(A, "L").data)
+                qlu = np.asarray(lu.getrf_ptgpanel(G)[0].data)
+                qqr = np.asarray(qr.geqrf(G)[0].data)
+        for got, ref in (
+                (qc, np.asarray(potrf_mod.potrf(A, "L").data)),
+                (qlu, np.asarray(lu.getrf_ptgpanel(G)[0].data)),
+                (qqr, np.asarray(qr.geqrf(G)[0].data))):
+            rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+            assert rel < 0.1
+
+
+# --------------------------------------------------- the int8 IR rung
+
+def test_ir_precisions_include_int8():
+    assert refine.PRECISIONS[0] == "int8"
+    assert refine.ir_params("int8")[0] == "int8"
+
+
+def test_posv_ir_int8_converges_with_guard():
+    A = generators.plghe(96.0, 96, 32, seed=3872, dtype=jnp.float64)
+    B = generators.plrnt(96, 2, 32, 32, seed=3873, dtype=jnp.float64)
+    X, info = refine.posv_ir(A, B, "L", precision="int8")
+    summ = refine.summarize(info, op="posv_ir",
+                            precision="int8")
+    assert summ["precision"] == "int8"
+    assert summ["converged"] and not summ["escalated"]
+    assert summ["backward_errors"][-1] <= summ["tol"]
+    # the ABFT ones-probe guard surfaced next to the backward error
+    assert summ["quant_guard_max"] > 0
+    # the solve is f64-equivalent
+    Ad = np.asarray(A.to_dense())
+    Bd = np.asarray(B.to_dense())
+    Xd = np.asarray(X.to_dense())
+    r = np.linalg.norm(Bd - Ad @ Xd) / (
+        np.linalg.norm(Ad) * np.linalg.norm(Xd))
+    assert r < 1e-13
+
+
+def test_gesv_ir_int8_converges():
+    A = generators.plrnt(96, 96, 32, 32, seed=3874, dtype=jnp.float64,
+                         diagdom=True)
+    B = generators.plrnt(96, 2, 32, 32, seed=3875, dtype=jnp.float64)
+    _, info = refine.gesv_ir(A, B, precision="int8")
+    summ = refine.summarize(info, op="gesv_ir",
+                            precision="int8")
+    assert summ["converged"] and not summ["escalated"]
+    assert summ["backward_errors"][-1] <= summ["tol"]
+    assert "quant_guard_max" in summ
+
+
+def test_posv_ir_int8_escalates_deterministically():
+    """cond~1e9 SPD seed: the quantized factor cannot contract — the
+    rung must escalate through the existing non-contraction machinery
+    and the dd route must still deliver the accurate solve."""
+    n, nb = 64, 32
+    rng = np.random.default_rng(5)
+    Q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    d = np.logspace(0.0, -9.0, n)
+    A = TileMatrix.from_dense(jnp.asarray((Q * d) @ Q.T, jnp.float64),
+                              nb, nb)
+    B = generators.plrnt(n, 1, nb, nb, seed=6, dtype=jnp.float64)
+    outs = []
+    for _ in range(2):
+        X, info = refine.posv_ir(A, B, "L", precision="int8")
+        summ = refine.summarize(info, op="posv_ir",
+                                precision="int8")
+        assert summ["escalated"]
+        outs.append(np.asarray(X.to_dense()))
+    # deterministic: both escalated runs produce the same answer
+    assert np.array_equal(outs[0], outs[1])
+    Ad = np.asarray(A.to_dense())
+    Bd = np.asarray(B.to_dense())
+    r = np.linalg.norm(Bd - Ad @ outs[0]) / (
+        np.linalg.norm(Ad) * np.linalg.norm(outs[0]))
+    assert r < 1e-10
+
+
+def test_posv_ir_int8_traced_matches_eager(ir_iters3):
+    A = generators.plghe(64.0, 64, 32, seed=9, dtype=jnp.float64)
+    B = generators.plrnt(64, 1, 32, 32, seed=10, dtype=jnp.float64)
+    Xe, ie = refine.posv_ir(A, B, "L", precision="int8")
+
+    def run(a, b):
+        X, info = refine.posv_ir(TileMatrix(a, A.desc),
+                                 TileMatrix(b, B.desc), "L",
+                                 precision="int8", escalate=False)
+        return X.data, info["converged"], info["iterations"]
+
+    xt, conv, _ = jax.jit(run)(A.data, B.data)
+    assert bool(np.asarray(conv))
+    np.testing.assert_allclose(np.asarray(xt), np.asarray(Xe.data),
+                               rtol=1e-8, atol=1e-10)
+
+
+# the traced-loop fixture from test_refine, re-declared locally so
+# this module stands alone
+@pytest.fixture
+def ir_iters3():
+    from dplasma_tpu.utils import config as _cfg
+    _cfg.mca_set("ir.max_iters", 3)
+    yield
+    _cfg.mca_unset("ir.max_iters")

@@ -568,7 +568,7 @@ def test_run_report_serving_section(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 16
+    assert doc["schema"] == REPORT_SCHEMA == 17
     (s,) = doc["serving"]
     assert s["requests"] == 1 and s["batches"] == 1
     assert s["cache"]["misses"] == 1
@@ -592,7 +592,7 @@ def test_servebench_e2e_throughput_and_gate(tmp_path):
                           "--gate"])
     assert rc == 0
     doc = json.load(open(rep))
-    assert doc["schema"] == 16
+    assert doc["schema"] == 17
     (s,) = doc["serving"]
     assert s["speedup_vs_loop"] >= 2.0, \
         f"batched speedup {s['speedup_vs_loop']} < 2x"

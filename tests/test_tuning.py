@@ -105,7 +105,8 @@ def test_db_roundtrip(tmp_path):
     e = back.get("potrf", 64, "float32", (1, 1))
     assert e["knobs"] == {"nb": 16, "sweep.lookahead": 1}
     assert e["measured_s"] == pytest.approx(1e-3)
-    assert e["source"] == "measured" and e["schema"] == 1
+    assert e["source"] == "measured" \
+        and e["schema"] == tdb.TUNE_DB_SCHEMA
     assert back.check() == []
 
 
@@ -453,7 +454,7 @@ def test_driver_autotune_consults_db(tmp_path, monkeypatch):
     assert rc == 0
     assert config._MCA_OVERRIDES == before
     doc = json.load(open(rj))
-    assert doc["schema"] == 16
+    assert doc["schema"] == 17
     t = doc["tuning"][0]
     assert t["source"] == "db"
     assert t["key"] == tdb.make_key("potrf", 32, "float32", (1, 1))

@@ -731,6 +731,103 @@ def run_tune_smoke() -> int:
     return bad
 
 
+def run_quant_smoke() -> int:
+    """The block-scaled int8 gate, CPU-fast: a quantize/dequantize
+    round-trip must stay within the per-tile half-step bound, the
+    block-scaled GEMM must track the f32 reference, the int8 IR rung
+    must converge to the f64-equivalent backward-error gate on a
+    well-conditioned seed, and the precision-autopilot DB must
+    round-trip a stored rung plus an escalation write-back with a
+    clean schema check."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from dplasma_tpu.ops.generators import plghe, plrnt
+    from dplasma_tpu.kernels import quant
+    from dplasma_tpu.ops import refine
+    from dplasma_tpu.tuning import TuningDB
+    from dplasma_tpu.tuning import autopilot as _ap
+
+    if not jax.config.jax_compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(_ROOT / ".jax_cache"))
+    jax.config.update("jax_enable_x64", True)
+    bad = 0
+    rng = np.random.default_rng(3872)
+    tile = 32
+    # (a) quantize/dequantize round-trip: symmetric per-tile scales —
+    # every element lands within half a quantization step of its tile
+    x = (rng.standard_normal((96, 64)).astype(np.float32)
+         * rng.choice([1e-3, 1.0, 1e3], size=(96, 64))
+         .astype(np.float32))
+    q, sc = quant.quantize(x, tile)
+    y = np.asarray(quant.dequantize(q, sc, tile, x.shape))
+    err = np.abs(y - x)
+    step = np.repeat(np.repeat(np.asarray(sc), tile, 0), tile, 1)
+    if not np.all(err <= 0.5 * step[:96, :64] * (1 + 1e-6)):
+        sys.stderr.write("quant-smoke: round-trip exceeds the "
+                         "half-step bound\n")
+        bad += 1
+    # (b) block-scaled GEMM vs the f32 reference
+    a = rng.standard_normal((64, 48)).astype(np.float32)
+    b = rng.standard_normal((48, 80)).astype(np.float32)
+    ref = a @ b
+    got = np.asarray(quant.qgemm(a, b, tile))
+    rel = np.max(np.abs(got - ref)) / max(np.max(np.abs(ref)), 1e-30)
+    if rel > 5e-2:
+        sys.stderr.write(f"quant-smoke: qgemm relative error {rel:.3e}"
+                         " exceeds 5e-2\n")
+        bad += 1
+    # (c) int8 IR rung: posv/gesv on well-conditioned seeds must hit
+    # the f64-equivalent backward-error gate without escalating
+    n, nb = 96, 32
+    A0 = plghe(float(n), n, nb, seed=3872, dtype=np.float64)
+    B0 = plrnt(n, 2, nb, nb, seed=3873, dtype=np.float64)
+    for op, solve in (("posv_ir",
+                       lambda: refine.posv_ir(A0, B0, "L",
+                                              precision="int8")),
+                      ("gesv_ir",
+                       lambda: refine.gesv_ir(
+                           plrnt(n, n, nb, nb, seed=3874,
+                                 dtype=np.float64, diagdom=True), B0,
+                           precision="int8"))):
+        _, info = solve()
+        summ = refine.summarize(info, op=op)
+        if not summ["converged"] or summ["escalated"] \
+                or summ["backward_errors"][-1] > summ["tol"]:
+            sys.stderr.write(f"quant-smoke: int8-rung {op} missed the "
+                             f"backward-error gate: {summ}\n")
+            bad += 1
+    # (d) autopilot DB round-trip + escalation write-back
+    with tempfile.TemporaryDirectory() as td:
+        dbp = f"{td}/tune_db.json"
+        _ap.record("posv_ir", n, "float64", "well", "int8",
+                   converged=True, cond_estimate=10.0, path=dbp)
+        dec = _ap.consult("posv_ir", n, "float64",
+                          cond=10.0, path=dbp)
+        if dec is None or dec["precision"] != "int8" \
+                or dec["source"] != "db":
+            sys.stderr.write(f"quant-smoke: autopilot consult did not "
+                             f"return the stored rung: {dec}\n")
+            bad += 1
+        _ap.record_escalation("posv_ir", n, "float64", "well", "int8",
+                              cond_estimate=10.0, path=dbp)
+        dec2 = _ap.consult("posv_ir", n, "float64", cond=10.0,
+                           path=dbp)
+        if dec2 is None or dec2["precision"] != "bf16":
+            sys.stderr.write(f"quant-smoke: escalation write-back did "
+                             f"not bump the rung: {dec2}\n")
+            bad += 1
+        problems = TuningDB.load(dbp).check()
+        if problems:
+            sys.stderr.write("quant-smoke: DB check: "
+                             + "; ".join(problems) + "\n")
+            bad += len(problems)
+    return bad
+
+
 def run_telemetry_smoke() -> int:
     """The live-telemetry gate, CPU-fast: a tiny serving burst with
     tracing ON must leave a balanced span ledger carrying the
@@ -1113,6 +1210,7 @@ def main(argv=None) -> int:
                      ("hlocheck-smoke", run_hlocheck_smoke),
                      ("ring-smoke", run_ring_smoke),
                      ("tune-smoke", run_tune_smoke),
+                     ("quant-smoke", run_quant_smoke),
                      ("telemetry-smoke", run_telemetry_smoke),
                      ("devprof-smoke", run_devprof_smoke),
                      ("soak-smoke", run_soak_smoke)):

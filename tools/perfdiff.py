@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v16), the bench
+(``--report`` from any driver, any schema vintage v1-v17), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -18,9 +18,13 @@ Comparable metrics extracted from each document:
 * per-op timing medians/bests (``<label>.median_s``/``.best_s``,
   lower is better) and achieved ``<label>.gflops`` (higher is
   better) from a run-report's ``ops`` section;
-* bench ladder entries (``<metric>`` GFlop/s values, higher is
-  better unless the entry declares ``"better": "lower"`` — e.g. the
-  IR solvers' iteration counts) from ``entries``/``ladder``;
+* bench ladder entries (``<metric>`` GFlop/s values — including
+  the block-scaled int8 ``i8gemm_gops_n*`` / ``*_i8`` rung entries —
+  higher is better unless the entry declares ``"better": "lower"``,
+  e.g. the IR solvers' iteration counts) from ``entries``/``ladder``;
+  same-knob-vector baselining keys on the full resolved knob vector
+  including the active ``ir.precision`` rung, so a rung flip
+  compares same-vs-same;
 * compiled-artifact peak memory
   (``<label>.hlocheck.hbm_peak_bytes``, lower is better) from a
   run-report's ``hlocheck`` section (schema v10) — HBM regressions
