@@ -365,6 +365,12 @@ def main(argv=None) -> int:
     from dplasma_tpu.tuning import resolved_knobs
     pipeline = resolved_knobs(grid=(1, 1))
     report.pipeline = pipeline
+    # schema v18: attribution stamp (git SHA, jax/jaxlib, backend,
+    # active MCA overrides) — rides the report AND every ledger doc
+    # so the trend observatory can answer "what changed at this
+    # changepoint" without forensic archaeology
+    provenance = report.stamp_provenance(
+        family="bench", mesh_shape=[1, 1], peaks_source="bench")
 
     def remaining():
         return deadline - time.monotonic()
@@ -394,6 +400,8 @@ def main(argv=None) -> int:
             "ladder": ladder,
             "peaks": peaks,
             "pipeline": pipeline,
+            "family": "bench",
+            "provenance": provenance,
         }
         if report.extra.get("refine"):
             # IR-solver convergence record (iterations, per-precision

@@ -763,6 +763,15 @@ class Driver:
                 sys.stderr.write(f"#! cannot write profile: {exc}\n")
         if getattr(ip, "report", None):
             try:
+                # schema v18 attribution stamp: whose code, whose
+                # mesh, whose peaks — collected at close() so the
+                # MCA snapshot reflects the knobs the run ended with
+                self.report.stamp_provenance(
+                    family=self.report.name,
+                    mesh_shape=[ip.P, ip.Q],
+                    peaks_source=("file"
+                                  if getattr(ip, "peaks_file", None)
+                                  else "default"))
                 self.report.write(ip.report)
                 if ip.rank == 0 and ip.loud >= 1:
                     print(f"#+ run-report written to {ip.report}")

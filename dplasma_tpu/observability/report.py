@@ -1,4 +1,4 @@
-"""The versioned JSON run-report (``"schema": 15``).
+"""The versioned JSON run-report (``"schema": 18``).
 
 One report per driver invocation (``--report[=file]``): the machine-
 readable record of everything the ``[****] TIME(s)`` line summarizes
@@ -151,6 +151,15 @@ Schema (stable keys; additive changes bump ``REPORT_SCHEMA``)::
                                  # posture's end-of-run record; the
                                  # audit subkey is servebench --soak's
                                  # conservation proof)
+     "provenance": {"schema": 1, "family",
+                    "git": {"sha", "dirty"} | null,
+                    "jax", "jaxlib", "backend", "device_count",
+                    "mesh_shape": [P, Q]?, "peaks_source"?,
+                    "mca": {...} | null},            # (v18,
+                                 # observability.trend
+                                 # .collect_provenance — every probe
+                                 # guarded; absent when the writer
+                                 # never stamped)
      "extra": {...}}               # free-form (bench ladder, peaks)
 
 Schema history: 2 adds the ``"checks"`` and ``"resilience"``
@@ -223,9 +232,18 @@ selected ``ir.precision`` rung and its provenance
 (db/interpolated/default), the 5-part ``|cond=<class>`` tuning key,
 and the DB path; drivers under ``--autotune`` and the serving layer
 both emit them, and runtime escalations land back in the tuning DB
-as negative entries so the recorded verdicts converge).
+as negative entries so the recorded verdicts converge);
+18 adds ``"provenance"`` (the attribution stamp —
+observability.trend.collect_provenance: git SHA + dirty flag,
+jax/jaxlib versions, backend platform + device count + mesh shape,
+the peaks source (bench/default/file), the active MCA override
+snapshot, and the ladder family; written by ``bench.py``,
+``tools/servebench.py``, ``tools/multichip.py``, and the drivers'
+``Driver.close``, so every ledger entry is attributable and the
+trend observatory splits series on real config changes instead of
+silently mixing them).
 All additive — v1 readers of the other keys are unaffected; this
-reader accepts <= 17 (:func:`load_report` tolerates every v1-v17
+reader accepts <= 18 (:func:`load_report` tolerates every v1-v18
 vintage, filling the always-present keys).
 """
 from __future__ import annotations
@@ -238,7 +256,7 @@ from typing import List, Optional
 
 from dplasma_tpu.observability.metrics import Histogram, MetricsRegistry
 
-REPORT_SCHEMA = 17
+REPORT_SCHEMA = 18
 
 
 def run_stats(runs_s: List[float]) -> dict:
@@ -286,6 +304,7 @@ class RunReport:
         self.devprof: List[dict] = []   # measured-timeline attribution (v14)
         self.admission: Optional[dict] = None  # overload posture (v15)
         self.pipeline: Optional[dict] = None  # sweep pipeline shape (v4)
+        self.provenance: Optional[dict] = None  # attribution stamp (v18)
         self.roofline: List[dict] = []  # per-op roofline entries (v5)
         self.extra: dict = {}
         self._t0 = time.time_ns()
@@ -401,6 +420,17 @@ class RunReport:
         self.admission = summary
         return summary
 
+    def stamp_provenance(self, **kw) -> dict:
+        """Collect and attach the attribution stamp (schema v18; see
+        observability.trend.collect_provenance — git SHA + dirty
+        flag, jax/jaxlib versions, platform + mesh shape, peaks
+        source, active MCA snapshot, ladder family). Keyword
+        arguments pass through (``family=``, ``mesh_shape=``,
+        ``peaks_source=``)."""
+        from dplasma_tpu.observability.trend import collect_provenance
+        self.provenance = collect_provenance(**kw)
+        return self.provenance
+
     def add_roofline(self, entry: dict) -> dict:
         """Record one per-op roofline ledger entry (schema v5; see
         observability.roofline.op_roofline)."""
@@ -454,6 +484,8 @@ class RunReport:
             doc["admission"] = self.admission
         if self.pipeline is not None:
             doc["pipeline"] = self.pipeline
+        if self.provenance is not None:
+            doc["provenance"] = self.provenance
         if self.roofline:
             doc["roofline"] = self.roofline
         if self.entries:
@@ -486,7 +518,7 @@ def load_report(path: str) -> dict:
     """Read a run-report back; raises on schema mismatch newer than
     this reader.
 
-    Every older vintage (v1-v16) loads: the schema history is purely
+    Every older vintage (v1-v17) loads: the schema history is purely
     additive, so an old doc is a valid new doc minus the sections its
     writer didn't know about. The always-present keys (``schema``,
     ``ops``, ``metrics``) are filled with safe defaults when absent,

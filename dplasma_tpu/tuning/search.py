@@ -366,7 +366,7 @@ def trial_ledger_doc(op: str, n: int, dtype, key: str, knobs: dict,
     dname = np.dtype(dtype).name
     metric = f"tune_{op}_{dname}_n{n}"
     return {"metric": metric, "value": round(gflops, 3),
-            "unit": "GFlop/s", "tuning": True,
+            "unit": "GFlop/s", "tuning": True, "family": "tuning",
             "pipeline": dict(knobs),
             "ladder": [{"metric": metric, "value": round(gflops, 3),
                         "unit": "GFlop/s", "tuning": True,

@@ -123,6 +123,14 @@ def mca_unset(name: str) -> None:
     _MCA_OVERRIDES.pop(name, None)
 
 
+def mca_snapshot() -> dict:
+    """The ACTIVE override set (explicit overrides only — registered
+    defaults are code, not run configuration). This is what the
+    run-report's v18 ``"provenance"`` section records, so a ledger
+    entry measured under ``--mca panel.qr chain`` is attributable."""
+    return dict(sorted(_MCA_OVERRIDES.items()))
+
+
 def mca_get(name: str, default=None) -> Optional[str]:
     """Resolution order: explicit override > env DPLASMA_MCA_<NAME>
     (dots → underscores) > registered default > ``default``."""

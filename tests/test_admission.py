@@ -451,7 +451,7 @@ def test_run_report_admission_section_roundtrip(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 17
+    assert doc["schema"] == REPORT_SCHEMA == 18
     assert doc["admission"]["admitted"] == 1
     assert doc["admission"]["audit"]["balanced"] is True
     assert doc["admission"]["retry_budget"] == {"limit": 0, "used": 0}
@@ -479,7 +479,7 @@ def test_servebench_soak_audit_balances_under_chaos(tmp_path):
                           "--mca", "serving.max_queue=4"])
     assert rc == 0
     doc = json.load(open(rep))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     audit = doc["admission"]["audit"]
     assert audit["balanced"] is True
     assert audit["submitted"] == audit["admitted"] + audit["shed"]

@@ -269,7 +269,7 @@ def test_driver_autotune_consults_autopilot(dbp, tmp_path, capsys):
     assert rc == 0, out
     assert "#+ autopilot[posv_ir]" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     (dec,) = doc["autopilot"]
     assert dec["precision"] == "int8" and dec["source"] == "db"
     assert dec["cond_class"] == "well"

@@ -364,7 +364,7 @@ def test_driver_dagcheck_end_to_end(tmp_path, capsys):
     assert "dagcheck[testing_dpotrf]" in out and "OK" in out
     assert "#+ pipeline: sweep.lookahead=1" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     assert doc["pipeline"]["sweep.lookahead"] == 1
     (entry,) = doc["dagcheck"]
     # pipelined potrf DAG at nt=4, la=1: 4 panels + 3 narrow lookahead

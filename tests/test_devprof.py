@@ -196,7 +196,7 @@ def test_driver_devprof_end_to_end(tmp_path, capsys, devices8,
     assert rc == 0
     assert f"#+ devprof[{prog}]:" in out
     doc = load_report(rj)
-    assert doc["schema"] == REPORT_SCHEMA == 17
+    assert doc["schema"] == REPORT_SCHEMA == 18
     (entry,) = doc["devprof"]
     assert entry["label"] == prog and entry["ok"]
     assert entry["backend"] == "synthetic"       # CPU mesh

@@ -468,7 +468,7 @@ def test_driver_hlocheck_end_to_end(prog, tmp_path, capsys, devices8):
     assert rc == 0
     assert f"hlocheck[{prog}]" in out and "OK" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     (entry,) = doc["hlocheck"]
     assert entry["ok"] and entry["op"] == prog
     assert entry["relation"] in ("gspmd", "==", ">=",

@@ -105,5 +105,5 @@ def test_lint_all_aggregate_is_clean(capsys):
                  "spmdcheck-smoke", "serving-smoke", "hlocheck-smoke",
                  "ring-smoke", "tune-smoke", "quant-smoke",
                  "telemetry-smoke",
-                 "devprof-smoke", "soak-smoke"):
+                 "devprof-smoke", "soak-smoke", "trend-smoke"):
         assert f"# {gate}: OK" in out.out

@@ -399,10 +399,10 @@ def test_perfdiff_gates_memcheck_peak(tmp_path):
     _sys.path.insert(0, "tools")
     import perfdiff
 
-    base = {"schema": 17, "ops": [], "metrics": [],
+    base = {"schema": 18, "ops": [], "metrics": [],
             "memcheck": [{"op": "testing_dpotrf", "ok": True,
                           "peak_bytes": 1000}]}
-    worse = {"schema": 17, "ops": [], "metrics": [],
+    worse = {"schema": 18, "ops": [], "metrics": [],
              "memcheck": [{"op": "testing_dpotrf", "ok": True,
                            "peak_bytes": 1500}]}
     m = perfdiff.extract_metrics(base)
@@ -427,7 +427,7 @@ def test_driver_memcheck_end_to_end(tmp_path, capsys):
     assert rc == 0
     assert "memcheck[testing_dpotrf]" in out and "OK" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     (entry,) = doc["memcheck"]
     assert entry["ok"] and entry["peak_bytes"] > 0
     assert entry["peak_task"]

@@ -74,13 +74,18 @@ def test_main_end_to_end_report_ledger_and_gate(tmp_path, capsys,
                          "--report", rj, "--history", hist])
     assert rc == 0
     doc = json.load(open(rj))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     (sec,) = doc["scaling"]
     assert [p["chips"] for p in sec["points"]] == [1, 2]
     assert doc["ops"] and doc["entries"]
     with open(hist) as f:
         entries = [json.loads(ln) for ln in f if ln.strip()]
     assert len(entries) == 1
+    # v18 ledger envelope + attribution stamp
+    assert entries[0]["family"] == "multichip"
+    prov = entries[0]["provenance"]
+    assert prov["schema"] == 1 and prov["family"] == "multichip"
+    assert prov["mesh_shape"] and doc["provenance"] == prov
     # seed an impossible baseline: the second run regresses on every
     # metric — on the CPU mesh the gate is informational (exit 0)
     boosted = json.loads(json.dumps(entries[0]))

@@ -64,7 +64,7 @@ def test_run_report_schema_and_stats(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 17
+    assert doc["schema"] == REPORT_SCHEMA == 18
     assert doc["ops"][0]["timings"]["runs_s"] == [0.4, 0.2, 0.3]
     assert doc["metrics"][0]["value"] == 7.0
     assert doc["env"]["backend"] == "cpu"
@@ -277,6 +277,14 @@ def test_load_report_tolerates_v1_to_current(tmp_path):
                  "precision": "int8", "source": "db",
                  "key": "posv_ir|n=4096|float32|g1x1|cond=well",
                  "db": "tune_db.json"}]},
+        18: {"schema": 18, "name": "v18", "ops": [], "metrics": [],
+             "provenance": {
+                 "schema": 1, "family": "bench",
+                 "git": {"sha": "0123abcd" * 5, "dirty": False},
+                 "jax": "0.4.35", "jaxlib": "0.4.35",
+                 "backend": "tpu", "device_count": 8,
+                 "mesh_shape": [2, 4], "peaks_source": "bench",
+                 "mca": {"sweep.lookahead": "2"}}},
     }
     assert set(vintages) == set(range(1, REPORT_SCHEMA + 1))
     for v, doc in vintages.items():
@@ -532,7 +540,7 @@ def test_driver_report_and_profile_end_to_end(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 0
     doc = load_report(rj)
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     assert doc["iparam"]["N"] == 512 and doc["iparam"]["prec"] == "d"
     (op,) = doc["ops"]
     t = op["timings"]

@@ -252,7 +252,7 @@ def test_driver_phase_profile_acceptance(tmp_path, capsys, prog):
     overhead) to the attributed run time."""
     doc = _phase_run(tmp_path, prog)
     out = capsys.readouterr().out
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     (op,) = doc["ops"]
     ph = op["phases"]
     spans = ph["spans"]
@@ -388,10 +388,10 @@ def test_perfdiff_gflops_drop_is_regression(tmp_path, capsys):
 
 
 def test_perfdiff_bench_ledger_newest_entry(tmp_path, capsys):
-    bench_old = {"metric": "x", "ladder": [
+    bench_old = {"metric": "x", "family": "bench", "ladder": [
         {"metric": "spotrf_gflops_n2048", "value": 100.0,
          "unit": "GFlop/s", "vs_baseline": 1.0}]}
-    bench_new = {"metric": "x", "ladder": [
+    bench_new = {"metric": "x", "family": "bench", "ladder": [
         {"metric": "spotrf_gflops_n2048", "value": 200.0,
          "unit": "GFlop/s", "vs_baseline": 2.0}]}
     ledger = tmp_path / "bench_history.jsonl"
@@ -466,10 +466,13 @@ def test_perfdiff_latest_comparable_entry(tmp_path):
     servebench runs would compare cross-family forever (compared==0,
     informational pass) and never gate a real regression."""
     ledger = str(tmp_path / "h.jsonl")
-    e1 = {"ladder": [{"metric": "a_gflops", "value": 10.0}]}
-    e2 = {"entries": [{"metric": "serving.p50_ms", "value": 5.0,
+    e1 = {"family": "bench",
+          "ladder": [{"metric": "a_gflops", "value": 10.0}]}
+    e2 = {"family": "servebench",
+          "entries": [{"metric": "serving.p50_ms", "value": 5.0,
                        "better": "lower"}]}
-    e3 = {"ladder": [{"metric": "a_gflops", "value": 11.0}]}
+    e3 = {"family": "bench",
+          "ladder": [{"metric": "a_gflops", "value": 11.0}]}
     for e in (e1, e2, e3):
         perfdiff.append_ledger(ledger, e)
     cand = {"entries": [{"metric": "serving.p50_ms", "value": 6.0,
@@ -480,6 +483,32 @@ def test_perfdiff_latest_comparable_entry(tmp_path):
     # nothing comparable (or no metrics at all): newest raw entry,
     # so the callers' vacuous-gate handling still engages
     assert perfdiff.latest_comparable_entry(ledger, {"ops": []}) == e3
+
+
+def test_perfdiff_skips_envelope_less_fragments(tmp_path, capsys):
+    """The ledger envelope contract (schema v18): entries carrying
+    neither a ``"family"`` key nor a run-report ``"schema"`` are
+    fragments from pre-contract writers — they are skipped as
+    baselines with a NAMED note pointing at tools/ledger_backfill.py,
+    never silently compared."""
+    ledger = str(tmp_path / "h.jsonl")
+    frag = {"ladder": [{"metric": "a_gflops", "value": 10.0}]}
+    good = {"family": "bench",
+            "ladder": [{"metric": "a_gflops", "value": 11.0}]}
+    perfdiff.append_ledger(ledger, frag)
+    perfdiff.append_ledger(ledger, good)
+    perfdiff.append_ledger(ledger, frag)  # newest entry: a fragment
+    cand = {"family": "bench",
+            "ladder": [{"metric": "a_gflops", "value": 12.0}]}
+    base = perfdiff.latest_comparable_entry(ledger, cand)
+    assert base == good  # the fragment after it was skipped
+    err = capsys.readouterr().err
+    assert "envelope-less ledger fragment" in err
+    assert "ledger_backfill" in err and ":3:" in err
+    # a ledger of ONLY fragments yields no baseline at all
+    ledger2 = str(tmp_path / "frags.jsonl")
+    perfdiff.append_ledger(ledger2, frag)
+    assert perfdiff.latest_comparable_entry(ledger2, cand) is None
 
 
 def test_perfdiff_baseline_prefers_same_pipeline(tmp_path):
@@ -493,9 +522,9 @@ def test_perfdiff_baseline_prefers_same_pipeline(tmp_path):
             "panel.kernel": "auto", "panel.qr": "tree",
             "panel.lu": "rec"}
     chain = dict(tree, **{"panel.qr": "chain", "panel.lu": "chain"})
-    e_tree = {"pipeline": tree,
+    e_tree = {"family": "bench", "pipeline": tree,
               "ladder": [{"metric": "a_gflops", "value": 10.0}]}
-    e_chain = {"pipeline": chain,
+    e_chain = {"family": "bench", "pipeline": chain,
                "ladder": [{"metric": "a_gflops", "value": 7.0}]}
     for e in (e_tree, e_chain):
         perfdiff.append_ledger(ledger, e)

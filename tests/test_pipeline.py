@@ -271,7 +271,7 @@ def test_report_pipeline_section_schema_v6(tmp_path, capsys):
     assert rc == 0
     assert "#+ pipeline: sweep.lookahead=" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     # since v11 the section carries the FULL resolved knob vector
     # (autotuner evidence; --autotune runs add "tuning.source")
     assert set(doc["pipeline"]) == {"sweep.lookahead", "qr.agg_depth",

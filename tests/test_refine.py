@@ -373,7 +373,7 @@ def test_driver_posv_ir_acceptance(tmp_path, capsys, ir_iters3):
     assert "[SUCCESS] POSV_IR backward error" in out
     assert "#+ refine[testing_dposv_ir]" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     # v7 refine section: the solve's convergence record
     (ref,) = doc["refine"]
     assert ref["op"] == "testing_dposv_ir"

@@ -291,7 +291,7 @@ def test_driver_inject_detect_remediate_report(tmp_path, capsys):
     assert "#+ resilience: injected nan at trsm" in out
     assert "outcome remediated" in out
     doc = json.load(open(rep))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     r = doc["resilience"][0]
     assert r["injection"]["plan"].startswith("nan@trsm")
     assert len(r["injection"]["faults"]) == 1

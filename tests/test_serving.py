@@ -568,7 +568,7 @@ def test_run_report_serving_section(tmp_path):
     p = str(tmp_path / "r.json")
     rep.write(p)
     doc = load_report(p)
-    assert doc["schema"] == REPORT_SCHEMA == 17
+    assert doc["schema"] == REPORT_SCHEMA == 18
     (s,) = doc["serving"]
     assert s["requests"] == 1 and s["batches"] == 1
     assert s["cache"]["misses"] == 1
@@ -592,7 +592,7 @@ def test_servebench_e2e_throughput_and_gate(tmp_path):
                           "--gate"])
     assert rc == 0
     doc = json.load(open(rep))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     (s,) = doc["serving"]
     assert s["speedup_vs_loop"] >= 2.0, \
         f"batched speedup {s['speedup_vs_loop']} < 2x"
@@ -703,7 +703,7 @@ def test_servebench_gate_tolerates_serving_free_baseline(tmp_path):
     from tools import perfdiff, servebench
     hist = str(tmp_path / "hist.jsonl")
     perfdiff.append_ledger(hist, {
-        "bench": "dplasma-tpu",
+        "bench": "dplasma-tpu", "family": "bench",
         "ladder": [{"metric": "sgemm_n4096", "value": 100.0}]})
     rc = servebench.main(["--requests", "6", "--sizes", "12",
                           "--max-nrhs", "2", "--ops", "posv",

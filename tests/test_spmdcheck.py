@@ -430,7 +430,7 @@ def test_driver_spmdcheck_end_to_end(tmp_path, capsys, devices8):
     assert rc == 0
     assert "spmdcheck[testing_dpotrf]" in out and "OK" in out
     doc = json.load(open(rj))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     (entry,) = doc["spmdcheck"]
     assert entry["ok"] and entry["op"] == "testing_dpotrf"
     assert entry["relation"] in ("no-collectives", "structural")

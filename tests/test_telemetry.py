@@ -467,7 +467,7 @@ def test_driver_telemetry_e2e(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "#+ telemetry:" in out
     doc = load_report(rj)
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     t = doc["telemetry"]
     assert t["exporter"]["path"] == prom and t["exporter"]["flushes"] >= 1
     kinds = [e["kind"] for e in t["flight_recorder"]["events"]]

@@ -379,10 +379,12 @@ def test_trial_ledger_doc_knob_vector_and_tuning_mark(tmp_path):
     doc = search.trial_ledger_doc("potrf", 64, "float32", "k", knobs,
                                   1e-3, 5.0, {"nb": 16})
     assert doc["tuning"] is True
+    assert doc["family"] == "tuning"  # ledger envelope contract (v18)
     assert doc["pipeline"]["nb"] == 16
     assert doc["ladder"][0]["nb"] == 16
     ledger = str(tmp_path / "h.jsonl")
-    good = {"ladder": [{"metric": "tune_potrf_float32_n64",
+    good = {"family": "bench",
+            "ladder": [{"metric": "tune_potrf_float32_n64",
                         "value": 9.0}]}
     perfdiff.append_ledger(ledger, good)
     perfdiff.append_ledger(ledger, doc)
@@ -454,7 +456,7 @@ def test_driver_autotune_consults_db(tmp_path, monkeypatch):
     assert rc == 0
     assert config._MCA_OVERRIDES == before
     doc = json.load(open(rj))
-    assert doc["schema"] == 17
+    assert doc["schema"] == 18
     t = doc["tuning"][0]
     assert t["source"] == "db"
     assert t["key"] == tdb.make_key("potrf", 32, "float32", (1, 1))

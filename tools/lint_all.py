@@ -1195,6 +1195,88 @@ def run_devprof_smoke() -> int:
     return bad
 
 
+def run_trend_smoke() -> int:
+    """The perf-observatory invariants that must hold on EVERY
+    commit: the trend model ingests the repo's own ledger and every
+    committed artifact without error; the changepoint detector finds
+    a clean synthetic step at exactly its index (and nothing else);
+    perfboard renders the dashboard and its ``--check`` gate is
+    green on the repo ledger. A red gate here means the repo itself
+    carries an unexplained regression — that is a lint failure, not
+    background noise."""
+    import importlib.util
+    import tempfile
+
+    def _load(name, rel):
+        mod = sys.modules.get(name)
+        if mod is not None:
+            return mod
+        spec = importlib.util.spec_from_file_location(
+            name, _ROOT / rel)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+    trend = _load("_lint_trend", "dplasma_tpu/observability/trend.py")
+    perfboard = _load("_lint_perfboard", "tools/perfboard.py")
+    bad = 0
+    # 1) every committed artifact loads (or is skipped with a note)
+    for path in sorted(_ROOT.glob("*.json")):
+        if path.name == "BASELINE.json":
+            continue
+        try:
+            docs, notes = trend.load_artifact(path)
+        except (OSError, ValueError) as exc:
+            sys.stderr.write(f"trend-smoke: {path.name}: {exc}\n")
+            bad += 1
+            continue
+        if not docs and not notes:
+            sys.stderr.write(f"trend-smoke: {path.name}: neither "
+                             f"docs nor a skip note\n")
+            bad += 1
+    # 2) the repo ledger ingests; fragments are named, never fatal
+    ledger = _ROOT / "bench_history.jsonl"
+    if ledger.exists():
+        try:
+            series, notes = trend.ingest_ledger(ledger)
+        except (OSError, ValueError) as exc:
+            sys.stderr.write(f"trend-smoke: ledger ingestion failed: "
+                             f"{exc}\n")
+            return bad + 1
+        if not series:
+            sys.stderr.write("trend-smoke: repo ledger produced no "
+                             "series\n")
+            bad += 1
+    # 3) detector golden: a clean 20% step at index 12, found once
+    values = [100.0 + (0.4 if i % 2 else -0.4) for i in range(12)] \
+        + [80.0 + (0.4 if i % 2 else -0.4) for i in range(8)]
+    cps = trend.changepoints(values)
+    if [c["index"] for c in cps] != [12]:
+        sys.stderr.write(f"trend-smoke: step-at-12 golden found "
+                         f"{[c['index'] for c in cps]}\n")
+        bad += 1
+    # 4) perfboard renders and the CI gate is green on the repo ledger
+    if ledger.exists():
+        with tempfile.TemporaryDirectory() as td:
+            out = f"{td}/pb.html"
+            rc = perfboard.main(["--ledger", str(ledger),
+                                 "--check", "--out", out])
+            if rc != 0:
+                sys.stderr.write(f"trend-smoke: perfboard --check "
+                                 f"rc={rc} on the repo ledger\n")
+                bad += 1
+            else:
+                with open(out) as f:
+                    html_text = f.read()
+                if "<svg" not in html_text \
+                        or "perfboard" not in html_text:
+                    sys.stderr.write("trend-smoke: dashboard HTML "
+                                     "missing sparklines\n")
+                    bad += 1
+    return bad
+
+
 def main(argv=None) -> int:
     pkg = _ROOT / "dplasma_tpu"
     bad = 0
@@ -1213,7 +1295,8 @@ def main(argv=None) -> int:
                      ("quant-smoke", run_quant_smoke),
                      ("telemetry-smoke", run_telemetry_smoke),
                      ("devprof-smoke", run_devprof_smoke),
-                     ("soak-smoke", run_soak_smoke)):
+                     ("soak-smoke", run_soak_smoke),
+                     ("trend-smoke", run_trend_smoke)):
         n = fn()
         print(f"# {name}: {'OK' if n == 0 else f'{n} violation(s)'}")
         bad += n

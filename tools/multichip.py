@@ -172,10 +172,12 @@ def run_scaling(ops, n: int, nb: int, chips_list, nruns: int = 3,
     return out
 
 
-def ledger_doc(scaling, n: int) -> dict:
+def ledger_doc(scaling, n: int, provenance=None) -> dict:
     """The one-line ``bench_history.jsonl`` document: higher-better
     GFlop/s + parallel-efficiency entries per (op, chip count), under
-    metric names perfdiff compares across runs."""
+    metric names perfdiff compares across runs. Carries the
+    ``"family"`` envelope key (ledger contract since schema v18) and,
+    when given, the attribution ``provenance`` stamp."""
     from dplasma_tpu.tuning import db as tdb
     entries = []
     any_placeholder = False
@@ -202,7 +204,10 @@ def ledger_doc(scaling, n: int) -> dict:
                 entries.append(row)
     doc = {"metric": "multichip_scaling", "value": len(entries),
            "unit": "points", "ladder": entries,
+           "family": "multichip",
            "pipeline": tdb.resolved_knobs(grid=(1, 1))}
+    if provenance is not None:
+        doc["provenance"] = provenance
     if any_placeholder:
         doc["placeholder"] = True
     return doc
@@ -264,7 +269,15 @@ def main(argv=None) -> int:
 
     scaling = run_scaling(ns.ops, ns.n, ns.nb, chips, ns.nruns,
                           devprof=ns.devprof)
-    doc = ledger_doc(scaling, ns.n)
+    # schema v18 attribution stamp: the largest mesh actually
+    # measured is the run's identity (a 2x4 scaling sweep and a 1x1
+    # smoke are different experiments)
+    from dplasma_tpu.observability.trend import collect_provenance
+    from dplasma_tpu.parallel import mesh as pmesh
+    prov = collect_provenance(
+        family="multichip",
+        mesh_shape=list(pmesh.square_grid(max(chips))))
+    doc = ledger_doc(scaling, ns.n, provenance=prov)
 
     rc = 0
     if ns.history:
@@ -303,6 +316,7 @@ def main(argv=None) -> int:
                 if pt.get("devprof") is not None:
                     rep.add_devprof(pt["devprof"])
         rep.entries.extend(doc["ladder"])
+        rep.provenance = prov
         rep.write(ns.report)
         print(f"# multichip: run-report written to {ns.report}")
     return rc

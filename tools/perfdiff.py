@@ -2,7 +2,7 @@
 """perfdiff: cross-run performance regression gate.
 
 Compares two performance documents — versioned JSON run-reports
-(``--report`` from any driver, any schema vintage v1-v17), the bench
+(``--report`` from any driver, any schema vintage v1-v18), the bench
 one-line JSON doc, or a ``bench_history.jsonl`` ledger (the newest
 entry is used) — metric by metric, with per-metric relative
 thresholds. A regression beyond threshold names the offending metric
@@ -12,6 +12,29 @@ thresholds. A regression beyond threshold names the offending metric
     python tools/perfdiff.py bench_history.jsonl report.json
     python tools/perfdiff.py old.json new.json --threshold 0.05 \\
         --metric-threshold testing_dgetrf.median_s=0.25
+    python tools/perfdiff.py bench_history.jsonl new.json \\
+        --auto-threshold
+
+``--auto-threshold`` consults the longitudinal noise model
+(:mod:`dplasma_tpu.observability.trend`) instead of the fixed
+fractions: when the baseline is a ``.jsonl`` ledger, each candidate
+metric's matching series (same family/knob-vector/platform/
+placeholder identity) yields a rolling-MAD noise sigma, and the gate
+bound becomes ``max(z * sigma, AUTO_FLOOR)`` — a compile-noise-
+dominated series earns a wide bound, a quiet series a tight one.
+Below the model's minimum history (``trend.MIN_HISTORY`` points) the
+fixed fractions stand unchanged, so a young ledger gates exactly as
+before. Auto-gated rows (and the ``--json`` verdict) carry
+``sigma`` / ``effect_sigma`` (the regression in noise-sigma units) /
+``auto_threshold``, and a regression names the series changepoint
+index the median-shift detector finds.
+
+Ledger envelope: every current writer stamps its documents with a
+``"family"`` key (run-reports carry ``schema`` + ``name`` instead).
+Envelope-less fragments from pre-envelope vintages are skipped by
+:func:`latest_comparable_entry` with a named note on stderr — never
+crashed on, never silently adopted as a baseline
+(``tools/ledger_backfill.py`` upgrades an old ledger in place).
 
 Comparable metrics extracted from each document:
 
@@ -89,11 +112,35 @@ and ``bench.py --gate``.
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
+import pathlib
 import sys
 from typing import Dict, Optional
 
 DEFAULT_THRESHOLD = 0.10   # 10% relative regression
+
+
+def _trend():
+    """dplasma_tpu/observability/trend.py loaded by file path — the
+    noise/changepoint model is stdlib-only like this tool, and a
+    by-path load keeps the jax-heavy package root out of the gate."""
+    mod = sys.modules.get("dplasma_tpu.observability.trend")
+    if mod is not None:
+        return mod
+    mod = sys.modules.get("_perfdiff_trend")
+    if mod is not None:
+        return mod
+    path = pathlib.Path(__file__).resolve().parent.parent \
+        / "dplasma_tpu" / "observability" / "trend.py"
+    spec = importlib.util.spec_from_file_location(
+        "_perfdiff_trend", path)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load trend from {path}")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_perfdiff_trend"] = mod
+    spec.loader.exec_module(mod)
+    return mod
 
 #: per-metric-suffix default thresholds (caller --metric-threshold
 #: still wins): trace overhead and cross-rank skew are near-zero,
@@ -133,7 +180,11 @@ def latest_comparable_entry(path: str, doc: dict) -> Optional[dict]:
     that is NOT itself a tuning trial never baselines against one.
     With no shared-metric entry (or a candidate with no metrics at
     all) this falls back to the newest raw non-tuning entry,
-    preserving the callers' vacuous-gate handling."""
+    preserving the callers' vacuous-gate handling.
+    Envelope-less fragments (no ``family`` and no ``schema`` key —
+    pre-envelope vintages wrote them) are SKIPPED with a named stderr
+    note: a fragment is unattributable, so it must neither crash the
+    scan nor silently become a baseline."""
     want = set(extract_metrics(doc))
     pipe = doc.get("pipeline")
     # the trial MARKER is the literal `true` — a v11 run-report's
@@ -142,7 +193,7 @@ def latest_comparable_entry(path: str, doc: dict) -> Optional[dict]:
     tuning_doc = doc.get("tuning") is True
     best = best_pipe = last = None
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             if not line.strip():
                 continue
             try:
@@ -150,6 +201,12 @@ def latest_comparable_entry(path: str, doc: dict) -> Optional[dict]:
             except ValueError:
                 continue
             if not isinstance(entry, dict):
+                continue
+            if "family" not in entry and "schema" not in entry:
+                sys.stderr.write(
+                    f"perfdiff: note: {path}:{lineno}: envelope-less "
+                    f"ledger fragment (no family/schema key) skipped "
+                    f"as baseline; run tools/ledger_backfill.py\n")
                 continue
             if entry.get("tuning") is True and not tuning_doc:
                 # a production gate must never baseline against a
@@ -325,17 +382,25 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
 
 def compare(old_doc: dict, new_doc: dict,
             threshold: float = DEFAULT_THRESHOLD,
-            per_metric: Optional[Dict[str, float]] = None) -> dict:
+            per_metric: Optional[Dict[str, float]] = None,
+            auto: Optional[Dict[str, dict]] = None) -> dict:
     """Compare every metric present in both documents.
 
     The per-metric regression ratio is positive-when-worse regardless
     of direction: ``(new-old)/old`` for lower-is-better timings,
     ``(old-new)/old`` for higher-is-better rates. ``per_metric`` maps
     a full metric name (or its bare suffix, e.g. ``median_s``) to a
-    custom threshold. Returns ``{"ok", "compared", "rows",
-    "regressions", "worst"}`` with rows sorted worst-first.
+    custom threshold; ``auto`` (built by :func:`auto_thresholds` from
+    a ledger baseline) maps a metric to its noise-calibrated
+    ``{"threshold", "sigma", "changepoint"}`` — an explicit
+    ``per_metric`` override still wins. Returns ``{"ok", "compared",
+    "rows", "regressions", "worst"}`` with rows sorted worst-first;
+    every row carries the noise-model fields (``sigma`` /
+    ``effect_sigma`` / ``auto_threshold``, null/false where the model
+    had no series history).
     """
     per_metric = per_metric or {}
+    auto = auto or {}
     old_m, new_m = extract_metrics(old_doc), extract_metrics(new_doc)
     rows = []
     for name in sorted(set(old_m) & set(new_m)):
@@ -354,13 +419,23 @@ def compare(old_doc: dict, new_doc: dict,
             ratio = (nv - ov) / ov if better == "lower" \
                 else (ov - nv) / ov
         suffix = name.rsplit(".", 1)[-1]
-        th = per_metric.get(
-            name, per_metric.get(
-                suffix, DEFAULT_METRIC_THRESHOLDS.get(
-                    suffix, threshold)))
+        th = per_metric.get(name, per_metric.get(suffix))
+        noise = auto.get(name)
+        used_auto = False
+        if th is None and noise is not None:
+            th = noise["threshold"]
+            used_auto = True
+        if th is None:
+            th = DEFAULT_METRIC_THRESHOLDS.get(suffix, threshold)
+        sigma = noise["sigma"] if noise else None
         rows.append({"metric": name, "old": ov, "new": nv,
                      "better": better, "regression": ratio,
-                     "threshold": th, "worse": ratio > th})
+                     "threshold": th, "worse": ratio > th,
+                     "sigma": sigma,
+                     "effect_sigma": ratio / sigma if sigma else None,
+                     "auto_threshold": used_auto,
+                     "changepoint": noise.get("changepoint")
+                     if noise else None})
     rows.sort(key=lambda r: -r["regression"])
     regs = [r for r in rows if r["worse"]]
     # baseline metrics with no candidate counterpart: an op that
@@ -377,19 +452,72 @@ def compare(old_doc: dict, new_doc: dict,
             "missing": missing, "new": new_only}
 
 
+def auto_thresholds(path: str, doc: dict,
+                    z: Optional[float] = None) -> Dict[str, dict]:
+    """Noise-calibrated per-metric thresholds from a ledger baseline
+    (``--auto-threshold``): each candidate metric's matching series
+    (exact family/knob/platform/placeholder identity, else the
+    longest same-family series of that metric) yields
+    ``{"threshold": max(z * sigma, AUTO_FLOOR), "sigma", "changepoint"}``.
+    Metrics whose series is shorter than the noise model's minimum
+    history are ABSENT — the fixed fractions stand for them, so a
+    young ledger gates exactly as without the flag."""
+    tr = _trend()
+    series, _ = tr.ingest_ledger(path)
+    fam = tr.doc_family(doc)
+    platform = tr.doc_platform(doc)
+    out: Dict[str, dict] = {}
+    for metric, row in tr.iter_points(doc):
+        s = None
+        if fam is not None:
+            s = series.get(tr.series_key(
+                fam, metric, row["knobs"], platform,
+                row["placeholder"]))
+        if s is None:
+            cands = [x for x in series.values()
+                     if x["metric"] == metric
+                     and x["placeholder"] == row["placeholder"]
+                     and (fam is None or x["family"] == fam)]
+            s = max(cands, key=lambda x: len(x["points"]),
+                    default=None)
+        if s is None:
+            continue
+        values = [p["value"] for p in s["points"]]
+        sigma = tr.noise_sigma(values)
+        if sigma is None:
+            continue
+        cps = tr.changepoints(values + [row["value"]])
+        out[metric] = {
+            "threshold": max((z or tr.Z_SIGMA) * sigma,
+                             tr.AUTO_FLOOR),
+            "sigma": sigma,
+            "changepoint": cps[-1]["index"] if cps else None}
+    return out
+
+
 def format_result(res: dict, verbose: bool = False) -> list:
     """Human lines: every regression (worst first), the worst offender
-    named, one summary line; ``verbose`` adds all compared rows."""
+    named, one summary line; ``verbose`` adds all compared rows.
+    Auto-gated rows show the effect size in noise-sigma units, and a
+    regression names the changepoint index the median-shift detector
+    placed in its series."""
     lines = []
     shown = res["rows"] if verbose else res["regressions"]
     for r in shown:
         tag = "REGRESSION" if r["worse"] else "ok        "
+        extra = ""
+        if r.get("auto_threshold"):
+            extra = " auto"
+            if r.get("effect_sigma") is not None:
+                extra += ", %.1f sigma" % r["effect_sigma"]
+            if r.get("changepoint") is not None and r["worse"]:
+                extra += ", changepoint @%d" % r["changepoint"]
         lines.append(
             "perfdiff: %s %s %.6g -> %.6g (%+.1f%% %s, threshold "
-            "%.1f%%)" % (tag, r["metric"], r["old"], r["new"],
-                         100.0 * r["regression"],
-                         "worse" if r["regression"] > 0 else "change",
-                         100.0 * r["threshold"]))
+            "%.1f%%%s)" % (tag, r["metric"], r["old"], r["new"],
+                           100.0 * r["regression"],
+                           "worse" if r["regression"] > 0 else "change",
+                           100.0 * r["threshold"], extra))
     if res["worst"] is not None:
         lines.append("perfdiff: worst offender: %s (%+.1f%%)"
                      % (res["worst"]["metric"],
@@ -431,6 +559,7 @@ def verdict_doc(res: dict, exit_code: int, threshold: float,
     that mirrors the process exit code."""
     return {"perfdiff": 1, "ok": res["ok"], "exit_code": exit_code,
             "threshold": threshold,
+            "auto_threshold": bool(res.get("auto_threshold")),
             "baseline": baseline, "candidate": candidate,
             "compared": res["compared"], "rows": res["rows"],
             "regressions": [r["metric"] for r in res["regressions"]],
@@ -463,6 +592,15 @@ def main(argv=None) -> int:
                     metavar="NAME=FRAC",
                     help="per-metric threshold override (full name or "
                          "bare suffix, e.g. median_s=0.25); repeatable")
+    ap.add_argument("--auto-threshold", action="store_true",
+                    help="noise-calibrated per-metric thresholds from "
+                         "the baseline ledger's series history "
+                         "(observability.trend); metrics below the "
+                         "minimum history keep the fixed fractions. "
+                         "Needs a .jsonl ledger baseline")
+    ap.add_argument("--z-sigma", type=float, default=None,
+                    help="auto-threshold bound in noise-sigma units "
+                         "(default trend.Z_SIGMA)")
     ap.add_argument("--json", nargs="?", const="-", default=None,
                     metavar="PATH", dest="json_out",
                     help="write the machine-readable verdict JSON to "
@@ -500,7 +638,21 @@ def main(argv=None) -> int:
                 "missing_metrics": [], "new_metrics": [],
                 "error": str(exc)})
         return 2
-    res = compare(old_doc, new_doc, ns.threshold, per)
+    auto = None
+    if ns.auto_threshold:
+        if ns.old.endswith(".jsonl"):
+            try:
+                auto = auto_thresholds(ns.old, new_doc, z=ns.z_sigma)
+            except (OSError, ValueError, ImportError) as exc:
+                sys.stderr.write(f"perfdiff: note: auto-threshold "
+                                 f"unavailable ({exc}); fixed "
+                                 f"thresholds in effect\n")
+        else:
+            sys.stderr.write("perfdiff: note: --auto-threshold needs "
+                             "a .jsonl ledger baseline; fixed "
+                             "thresholds in effect\n")
+    res = compare(old_doc, new_doc, ns.threshold, per, auto=auto)
+    res["auto_threshold"] = bool(auto)
     for line in format_result(res, verbose=ns.verbose):
         print(line)
     if res["compared"] == 0:

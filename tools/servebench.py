@@ -418,6 +418,10 @@ def main(argv=None) -> int:
     # admission knobs AND every measured pass
     with mca_cm:
         report = RunReport("servebench")
+        # schema v18 attribution stamp — taken INSIDE the MCA context
+        # so the snapshot records the admission knobs this run
+        # actually served under
+        report.stamp_provenance(family="servebench", mesh_shape=[1, 1])
         svc = SolverService(nb=ns.nb, max_batch=ns.max_batch,
                             max_wait_ms=0.0,
                             cache=ExecutableCache(metrics=None))
